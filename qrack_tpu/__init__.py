@@ -1,0 +1,18 @@
+"""qrack_tpu: a TPU-native quantum-computer simulation framework.
+
+Brand-new design with the capabilities of unitaryfoundation/qrack
+(see SURVEY.md at the repo root): a universal gate-level QInterface API
+over interchangeable simulation engines — dense state vector on CPU
+(numpy oracle) and TPU (JAX/XLA/Pallas), paged/sharded distribution over
+TPU meshes, Schmidt-decomposition QUnit factoring, stabilizer tableau,
+light-cone circuit buffering — composed into runtime-configurable
+stacks by a factory.
+"""
+
+from .interface import QInterface  # noqa: F401
+from .engines import QEngine, QEngineCPU  # noqa: F401
+from .pauli import Pauli  # noqa: F401
+from .config import get_config, set_config  # noqa: F401
+from .hamiltonian import HamiltonianOp, uniform_hamiltonian_op  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from .qengine import QEngine  # noqa: F401
+from .cpu import QEngineCPU  # noqa: F401

@@ -1,0 +1,568 @@
+"""QEngine: abstract dense ("Schrödinger") state-vector engine.
+
+Re-design of the reference's QEngine contract (reference:
+include/qengine.hpp:31-299 — Apply2x2/ApplyM/ProbReg/ProbMask/
+GetAmplitudePage/SetAmplitudePage/ShuffleBuffers/CloneEmpty/queued-norm;
+common measurement logic src/qengine/qengine.cpp). A concrete engine
+(numpy oracle, JAX/TPU) implements the `_k_*` kernel contract below;
+everything else — the whole QInterface surface, the ALU, parity,
+sampling — is provided here once, shared by all dense backends.
+
+Kernel contract (the analogue of the reference's OCLAPI enum,
+include/common/oclapi.hpp:19-99):
+
+  _k_apply_2x2(m2, target, controls, perm)     generic 2x2 (apply2x2*)
+  _k_apply_diag(d0, d1, target, controls, perm) phase fast path (phase/z)
+  _k_gather(src_idx)                            basis permutation (ALU, xmask, rol)
+  _k_out_of_place(src, dst, passthrough)        mul/div/*modnout scatter
+  _k_diag_fn(fn, *args)                         diagonal multiply (phaseflips, parity rz)
+  _k_probs()                                    |amp|^2 vector (host numpy)
+  _k_prob_mask(mask, perm)                      masked-probability reduce
+  _k_collapse(mask, val, nrm_sq)                projective collapse (applym/applymreg)
+  _k_compose(other, start)                      tensor product (compose kernel)
+  _k_decompose(start, length) -> dest_state     split separable subsystem
+  _k_dispose(start, length, perm)               drop separable subsystem
+  _k_allocate(start, length)                    insert |0> qubits
+  _k_normalize(nrm_sq)                          nrmlze kernel
+  _k_sum_sqr_diff(other)                        approxcompare kernel
+  _k_swap_bits(q1, q2)                          swap as index relabel
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config import FP_NORM_EPSILON
+from ..interface import QInterface
+from ..ops import alu_kernels as alu
+from .. import matrices as mat
+from ..utils.bits import bit_reg_mask, log2, is_pow2
+
+
+class QEngine(QInterface):
+    """Dense-ket engine base; see module docstring for the kernel contract."""
+
+    # numpy-compatible module used by index kernels (jnp for the TPU engine)
+    _xp = np
+
+    # ------------------------------------------------------------------
+    # gate primitive dispatch
+    # ------------------------------------------------------------------
+
+    def MCMtrxPerm(self, controls, mtrx, target, perm) -> None:
+        self._check_qubit(target)
+        for c in controls:
+            self._check_qubit(c)
+        m = np.asarray(mtrx, dtype=np.complex128).reshape(2, 2)
+        if mat.is_identity(m) and abs(m[0, 0] - 1.0) <= 1e-14:
+            return
+        if mat.is_phase(m):
+            self._k_apply_diag(m[0, 0], m[1, 1], target, tuple(controls), perm)
+        else:
+            self._k_apply_2x2(m, target, tuple(controls), perm)
+
+    # fast paths: X on many bits is one gather; Z/phase masks are diagonal
+    # (reference kernels xmask/phasemask, src/common/qengine.cl:266-340)
+
+    def XMask(self, mask: int) -> None:
+        if not mask:
+            return
+        self._k_gather(lambda idx: idx ^ mask)
+
+    def ZMask(self, mask: int) -> None:
+        if not mask:
+            return
+
+        def fn(xp, idx, state):
+            par = self._parity_of(xp, idx, mask)
+            return xp.where(par == 1, -state, state)
+
+        self._k_diag_fn(fn)
+
+    @staticmethod
+    def _parity_of(xp, idx, mask):
+        v = idx & mask
+        # O(log n) parity fold (works for numpy and jax int64)
+        for s in (32, 16, 8, 4, 2, 1):
+            v = v ^ (v >> s)
+        return v & 1
+
+    def PhaseParity(self, radians: float, mask: int) -> None:
+        if not mask:
+            return
+        half = complex(math.cos(radians / 2), math.sin(radians / 2))
+
+        def fn(xp, idx, state):
+            par = self._parity_of(xp, idx, mask)
+            return state * xp.where(par == 1, half, np.conj(half))
+
+        self._k_diag_fn(fn)
+
+    def Swap(self, q1: int, q2: int) -> None:
+        if q1 == q2:
+            return
+        self._k_swap_bits(q1, q2)
+
+    def Apply4x4(self, m: np.ndarray, q1: int, q2: int) -> None:
+        self._k_apply_4x4(np.asarray(m, dtype=np.complex128), q1, q2)
+
+    def _k_apply_4x4(self, m4, q1, q2) -> None:
+        # default: two-level synthesis (engines override with tensor op)
+        from ..interface.synth import apply_small_unitary_via_primitive
+
+        apply_small_unitary_via_primitive(self, m4, (q1, q2))
+
+    # ------------------------------------------------------------------
+    # probability / measurement
+    # ------------------------------------------------------------------
+
+    def Prob(self, q: int) -> float:
+        self._check_qubit(q)
+        return self._k_prob_mask(1 << q, 1 << q)
+
+    def ProbAll(self, perm: int) -> float:
+        return abs(self.GetAmplitude(perm)) ** 2
+
+    def ProbReg(self, start: int, length: int, perm: int) -> float:
+        return self._k_prob_mask(bit_reg_mask(start, length), perm << start)
+
+    def ProbMask(self, mask: int, perm: int) -> float:
+        return self._k_prob_mask(mask, perm)
+
+    def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
+        self._check_qubit(q)
+        prob_one = self.Prob(q)
+        if do_force:
+            res = bool(result)
+        else:
+            res = self.Rand() <= prob_one
+            # guard against numerically-impossible branches
+            if prob_one >= 1.0 - FP_NORM_EPSILON:
+                res = True
+            elif prob_one <= FP_NORM_EPSILON:
+                res = False
+        nrm_sq = prob_one if res else (1.0 - prob_one)
+        if nrm_sq <= 0.0:
+            raise RuntimeError("ForceM: forced result has zero probability")
+        if do_apply:
+            self._k_collapse(1 << q, (1 << q) if res else 0, nrm_sq)
+        return res
+
+    def ForceMParity(self, mask: int, result: bool, do_force: bool = True) -> bool:
+        odd_prob = self.ProbParity(mask)
+        if not do_force:
+            result = self.Rand() <= odd_prob
+            if odd_prob >= 1.0 - FP_NORM_EPSILON:
+                result = True
+            elif odd_prob <= FP_NORM_EPSILON:
+                result = False
+        nrm_sq = odd_prob if result else (1.0 - odd_prob)
+        if nrm_sq <= 0.0:
+            raise RuntimeError("ForceMParity: forced result has zero probability")
+        want = 1 if result else 0
+
+        def fn(xp, idx, state):
+            par = self._parity_of(xp, idx, mask)
+            return xp.where(par == want, state / math.sqrt(nrm_sq), xp.zeros_like(state))
+
+        self._k_diag_fn(fn)
+        return bool(result)
+
+    def MAll(self) -> int:
+        """Vectorized full measurement: sample one index from |amp|^2 and
+        collapse (reference: per-engine MAll / SetPermutation)."""
+        probs = self._k_probs()
+        result = int(self.rng.choice_from_probs(probs, 1)[0])
+        self.SetPermutation(result)
+        return result
+
+    def MultiShotMeasureMask(self, q_powers: Sequence[int], shots: int) -> dict:
+        """Sampling without collapse via the masked marginal distribution
+        (reference: src/qinterface/qinterface.cpp:807, engine-vectorized)."""
+        bits = [log2(p) for p in q_powers]
+        dist = self.ProbBitsAll(bits)
+        draws = self.rng.choice_from_probs(dist, shots)
+        out: dict = {}
+        for d in draws:
+            d = int(d)
+            out[d] = out.get(d, 0) + 1
+        return out
+
+    def GetProbs(self) -> np.ndarray:
+        return self._k_probs()
+
+    # ------------------------------------------------------------------
+    # ALU overrides: vectorized index-map kernels
+    # (reference: qheader_alu.cl via src/qengine/arithmetic.cpp)
+    # ------------------------------------------------------------------
+
+    def INC(self, to_add: int, start: int, length: int) -> None:
+        if not length:
+            return
+        self._check_range(start, length)
+        to_add &= (1 << length) - 1
+        if not to_add:
+            return
+        self._k_gather(lambda idx: alu.inc_src(self._xp, idx, to_add, start, length))
+
+    def CINC(self, to_add: int, start: int, length: int, controls) -> None:
+        controls = tuple(controls)
+        if not controls:
+            return self.INC(to_add, start, length)
+        if not length:
+            return
+        to_add &= (1 << length) - 1
+        if not to_add:
+            return
+        perm = (1 << len(controls)) - 1
+        self._k_gather(
+            lambda idx: alu.inc_src(self._xp, idx, to_add, start, length, controls, perm)
+        )
+
+    def INCDECC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
+        if not length:
+            return
+        to_add &= (1 << (length + 1)) - 1
+        if not to_add:
+            return
+        self._k_gather(lambda idx: alu.incdecc_src(self._xp, idx, to_add, start, length, carry_index))
+
+    def INCS(self, to_add: int, start: int, length: int, overflow_index: int) -> None:
+        if not length:
+            return
+        self._k_gather(lambda idx: alu.incs_src(self._xp, idx, to_add, start, length, overflow_index))
+
+    def INCDECSC(self, to_add: int, start: int, length: int, *flags) -> None:
+        if not length:
+            return
+        if len(flags) == 2:
+            overflow_index, carry_index = flags
+        else:
+            overflow_index, carry_index = None, flags[0]
+        self._k_gather(
+            lambda idx: alu.incdecsc_src(
+                self._xp, idx, to_add, start, length, carry_index, overflow_index
+            )
+        )
+
+    def ROL(self, shift: int, start: int, length: int) -> None:
+        if length < 2 or not (shift % length):
+            return
+        self._k_gather(lambda idx: alu.rol_src(self._xp, idx, shift % length, start, length))
+
+    def ROR(self, shift: int, start: int, length: int) -> None:
+        self.ROL(length - (shift % length) if length else 0, start, length)
+
+    def MUL(self, to_mul: int, in_out_start: int, carry_start: int, length: int) -> None:
+        if to_mul == 1 or not length:
+            return
+        src, dst = alu.mul_pair(self._xp, self.qubit_count, to_mul, in_out_start, carry_start, length)
+        self._k_out_of_place(src, dst, None)
+
+    def DIV(self, to_div: int, in_out_start: int, carry_start: int, length: int) -> None:
+        if to_div == 1 or not length:
+            return
+        src, dst = alu.mul_pair(self._xp, self.qubit_count, to_div, in_out_start, carry_start, length)
+        self._k_out_of_place(dst, src, None)
+
+    def CMUL(self, to_mul, in_out_start, carry_start, length, controls) -> None:
+        controls = tuple(controls)
+        if not controls:
+            return self.MUL(to_mul, in_out_start, carry_start, length)
+        if to_mul == 1 or not length:
+            return
+        src, dst = alu.mul_pair(self._xp, self.qubit_count, to_mul, in_out_start, carry_start, length)
+        self._ctrl_out_of_place(src, dst, controls)
+
+    def CDIV(self, to_div, in_out_start, carry_start, length, controls) -> None:
+        controls = tuple(controls)
+        if not controls:
+            return self.DIV(to_div, in_out_start, carry_start, length)
+        if to_div == 1 or not length:
+            return
+        src, dst = alu.mul_pair(self._xp, self.qubit_count, to_div, in_out_start, carry_start, length)
+        self._ctrl_out_of_place(dst, src, controls)
+
+    def _ctrl_out_of_place(self, src, dst, controls) -> None:
+        """Restrict an out-of-place map to the control-matching subspace;
+        everything else passes through (reference kernels cmul/cdiv)."""
+        xp = self._xp
+        cmask = 0
+        for c in controls:
+            cmask |= 1 << c
+        sel = (src & cmask) == cmask
+        self._k_out_of_place(src[sel], dst[sel] | cmask, cmask)
+
+    def _mod_out_len(self, mod_n: int) -> int:
+        return log2(mod_n) if is_pow2(mod_n) else (log2(mod_n) + 1)
+
+    def MULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
+        ol = self._mod_out_len(mod_n)
+        src, dst = alu.mulmodnout_pair(
+            self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
+        )
+        self._k_out_of_place(src, dst, None)
+
+    def IMULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
+        ol = self._mod_out_len(mod_n)
+        src, dst = alu.mulmodnout_pair(
+            self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
+        )
+        self._k_out_of_place(dst, src, None)
+
+    def CMULModNOut(self, to_mul, mod_n, in_start, out_start, length, controls) -> None:
+        controls = tuple(controls)
+        if not controls:
+            return self.MULModNOut(to_mul, mod_n, in_start, out_start, length)
+        ol = self._mod_out_len(mod_n)
+        src, dst = alu.mulmodnout_pair(
+            self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
+        )
+        self._ctrl_out_of_place(src, dst, controls)
+
+    def CIMULModNOut(self, to_mul, mod_n, in_start, out_start, length, controls) -> None:
+        controls = tuple(controls)
+        if not controls:
+            return self.IMULModNOut(to_mul, mod_n, in_start, out_start, length)
+        ol = self._mod_out_len(mod_n)
+        src, dst = alu.mulmodnout_pair(
+            self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
+        )
+        self._ctrl_out_of_place(dst, src, controls)
+
+    def POWModNOut(self, base: int, mod_n: int, in_start, out_start, length) -> None:
+        ol = self._mod_out_len(mod_n)
+        src, dst = alu.powmodnout_pair(
+            self._xp, self.qubit_count, base, mod_n, in_start, out_start, length, ol
+        )
+        self._k_out_of_place(src, dst, None)
+
+    def CPOWModNOut(self, base, mod_n, in_start, out_start, length, controls) -> None:
+        controls = tuple(controls)
+        if not controls:
+            return self.POWModNOut(base, mod_n, in_start, out_start, length)
+        ol = self._mod_out_len(mod_n)
+        src, dst = alu.powmodnout_pair(
+            self._xp, self.qubit_count, base, mod_n, in_start, out_start, length, ol
+        )
+        self._ctrl_out_of_place(src, dst, controls)
+
+    def IndexedLDA(self, index_start, index_length, value_start, value_length, values,
+                   reset_value: bool = True) -> int:
+        if reset_value:
+            # reference zeroes the value register before loading
+            # (src/qengine/arithmetic.cpp IndexedLDA: SetReg(..., 0))
+            self.SetReg(value_start, value_length, 0)
+        table = self._xp.asarray(np.asarray(values, dtype=np.int64))
+        self._k_gather(
+            lambda idx: alu.indexed_lda_src(
+                self._xp, idx, index_start, index_length, value_start, value_length, table
+            )
+        )
+        return int(round(self.ExpectationBitsAll(
+            list(range(value_start, value_start + value_length)))))
+
+    def IndexedADC(self, index_start, index_length, value_start, value_length, carry_index, values) -> int:
+        table = self._xp.asarray(np.asarray(values, dtype=np.int64))
+        self._k_gather(
+            lambda idx: alu.indexed_adc_src(
+                self._xp, idx, index_start, index_length, value_start, value_length,
+                carry_index, table, sign=1,
+            )
+        )
+        return int(round(self.ExpectationBitsAll(
+            list(range(value_start, value_start + value_length)))))
+
+    def IndexedSBC(self, index_start, index_length, value_start, value_length, carry_index, values) -> int:
+        table = self._xp.asarray(np.asarray(values, dtype=np.int64))
+        self._k_gather(
+            lambda idx: alu.indexed_adc_src(
+                self._xp, idx, index_start, index_length, value_start, value_length,
+                carry_index, table, sign=-1,
+            )
+        )
+        return int(round(self.ExpectationBitsAll(
+            list(range(value_start, value_start + value_length)))))
+
+    def Hash(self, start: int, length: int, values) -> None:
+        tbl = np.asarray(values, dtype=np.int64)
+        inv = np.empty_like(tbl)
+        inv[tbl] = np.arange(tbl.shape[0], dtype=np.int64)
+        inv_dev = self._xp.asarray(inv)
+        self._k_gather(lambda idx: alu.hash_src(self._xp, idx, start, length, inv_dev))
+
+    def PhaseFlipIfLess(self, greater_perm: int, start: int, length: int) -> None:
+        self._k_diag_fn(
+            lambda xp, idx, state: alu.phase_flip_if_less(
+                xp, idx, state, greater_perm, start, length
+            )
+        )
+
+    def CPhaseFlipIfLess(self, greater_perm: int, start: int, length: int, flag_index: int) -> None:
+        self._k_diag_fn(
+            lambda xp, idx, state: alu.phase_flip_if_less(
+                xp, idx, state, greater_perm, start, length, flag_index
+            )
+        )
+
+    def PhaseFlip(self) -> None:
+        self._k_diag_fn(lambda xp, idx, state: -state)
+
+    def UniformParityRZ(self, mask: int, angle: float) -> None:
+        ph = complex(math.cos(angle), math.sin(angle))
+
+        def fn(xp, idx, state):
+            par = self._parity_of(xp, idx, mask)
+            return state * xp.where(par == 1, ph, np.conj(ph))
+
+        self._k_diag_fn(fn)
+
+    def CUniformParityRZ(self, controls, mask: int, angle: float) -> None:
+        controls = tuple(controls)
+        if not controls:
+            return self.UniformParityRZ(mask, angle)
+        ph = complex(math.cos(angle), math.sin(angle))
+        cmask = 0
+        for c in controls:
+            cmask |= 1 << c
+
+        def fn(xp, idx, state):
+            par = self._parity_of(xp, idx, mask)
+            phase = xp.where(par == 1, ph, np.conj(ph))
+            active = (idx & cmask) == cmask
+            return state * xp.where(active, phase, xp.ones_like(phase))
+
+        self._k_diag_fn(fn)
+
+    # ------------------------------------------------------------------
+    # structure ops
+    # ------------------------------------------------------------------
+
+    def Compose(self, other, start: Optional[int] = None) -> int:
+        if start is None:
+            start = self.qubit_count
+        self._k_compose(other, start)
+        self.qubit_count += other.qubit_count
+        return start
+
+    def Decompose(self, start: int, dest) -> None:
+        length = dest.qubit_count
+        self._check_range(start, length)
+        dest_state = self._k_decompose(start, length)
+        self.qubit_count -= length
+        dest.SetQuantumState(dest_state)
+
+    def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
+        self._check_range(start, length)
+        self._k_dispose(start, length, disposed_perm)
+        self.qubit_count -= length
+
+    def Allocate(self, start: int, length: int = 1) -> int:
+        if length == 0:
+            return start
+        self._k_allocate(start, length)
+        self.qubit_count += length
+        return start
+
+    # ------------------------------------------------------------------
+    # norm bookkeeping (reference: include/qengine.hpp:100-152)
+    # ------------------------------------------------------------------
+
+    def GetRunningNorm(self) -> float:
+        return self.running_norm
+
+    def UpdateRunningNorm(self, norm_thresh: float = -1.0) -> None:
+        self.running_norm = float(self._k_probs().sum())
+
+    def NormalizeState(self, nrm: float = -1.0, norm_thresh: float = -1.0, phase_arg: float = 0.0) -> None:
+        if nrm < 0:
+            self.UpdateRunningNorm()
+            nrm = self.running_norm
+        if nrm > 0 and abs(nrm - 1.0) > FP_NORM_EPSILON:
+            self._k_normalize(nrm)
+            self.running_norm = 1.0
+
+    def SumSqrDiff(self, other) -> float:
+        return self._k_sum_sqr_diff(other)
+
+    # ------------------------------------------------------------------
+    # kernel contract (subclass responsibilities)
+    # ------------------------------------------------------------------
+
+    def _k_apply_2x2(self, m2, target, controls, perm) -> None:
+        raise NotImplementedError
+
+    def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
+        raise NotImplementedError
+
+    def _k_gather(self, src_fn) -> None:
+        raise NotImplementedError
+
+    def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:
+        raise NotImplementedError
+
+    def _k_diag_fn(self, fn) -> None:
+        raise NotImplementedError
+
+    def _k_probs(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def _k_prob_mask(self, mask, perm) -> float:
+        raise NotImplementedError
+
+    def _k_collapse(self, mask, val, nrm_sq) -> None:
+        raise NotImplementedError
+
+    def _k_compose(self, other, start) -> None:
+        raise NotImplementedError
+
+    def _k_decompose(self, start, length) -> np.ndarray:
+        raise NotImplementedError
+
+    def _k_dispose(self, start, length, perm) -> None:
+        raise NotImplementedError
+
+    def _k_allocate(self, start, length) -> None:
+        raise NotImplementedError
+
+    def _k_normalize(self, nrm_sq) -> None:
+        raise NotImplementedError
+
+    def _k_sum_sqr_diff(self, other) -> float:
+        raise NotImplementedError
+
+    def _k_swap_bits(self, q1, q2) -> None:
+        raise NotImplementedError
+
+    # -- cross-engine data plane (reference: include/qengine.hpp:128-145) --
+
+    def ZeroAmplitudes(self) -> None:
+        raise NotImplementedError
+
+    def IsZeroAmplitude(self) -> bool:
+        raise NotImplementedError
+
+    def CopyStateVec(self, other) -> None:
+        self.SetQuantumState(other.GetQuantumState())
+
+    def GetAmplitudePage(self, offset: int, length: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def SetAmplitudePage(self, page: np.ndarray, offset: int) -> None:
+        raise NotImplementedError
+
+    def ShuffleBuffers(self, other) -> None:
+        """Swap the top half of self's ket with the bottom half of other's
+        (reference: include/qengine.hpp:143; kernel shufflebuffers
+        src/common/qengine.cl:1059)."""
+        half = self.GetMaxQPower() >> 1
+        top = self.GetAmplitudePage(half, half)
+        bot = other.GetAmplitudePage(0, half)
+        self.SetAmplitudePage(bot, half)
+        other.SetAmplitudePage(top, 0)
+
+    def CloneEmpty(self) -> "QEngine":
+        raise NotImplementedError

@@ -1,0 +1,52 @@
+"""Assembles the full QInterface from its mixin layers.
+
+Reference parity: include/qinterface.hpp:141 (QInterface),
+include/qparity.hpp (QParity), include/qalu.hpp (QAlu) — here a single
+Python class built from cooperative mixins over one primitive contract.
+"""
+
+from .base import QInterfaceBase
+from .gates import GatesMixin
+from .rotations import RotationsMixin
+from .registers import RegistersMixin
+from .alu import AluMixin
+from .parity import ParityMixin
+
+
+class QInterface(GatesMixin, RotationsMixin, RegistersMixin, AluMixin, ParityMixin, QInterfaceBase):
+    """The universal gate-level simulator API (see module docstrings)."""
+
+    def TimeEvolve(self, hamiltonian, time_diff: float) -> None:
+        """First-order trotterized e^{-i H t}: apply e^{-i H_k t} per term
+        (reference: src/qinterface/gates.cpp:426). Unlike the reference's
+        uniform-op branch (which omits the i factor), uniform payloads here
+        are exponentiated as unitaries too."""
+        import numpy as np
+
+        from .. import matrices as mat
+
+        if abs(time_diff) <= 1e-12:
+            return
+        for op in hamiltonian:
+            if op.toggles:
+                for j, c in enumerate(op.controls):
+                    if op.toggles[j]:
+                        self.X(c)
+            if op.uniform:
+                payloads = [mat.exp_mtrx(-1j * time_diff * m) for m in op.matrix]
+                self.UCMtrx(tuple(op.controls), payloads, op.target)
+            else:
+                u = mat.exp_mtrx(-1j * time_diff * op.matrix)
+                if not op.controls:
+                    self.Mtrx(u, op.target)
+                elif op.anti:
+                    self.MACMtrx(tuple(op.controls), u, op.target)
+                else:
+                    self.MCMtrx(tuple(op.controls), u, op.target)
+            if op.toggles:
+                for j, c in enumerate(op.controls):
+                    if op.toggles[j]:
+                        self.X(c)
+
+
+__all__ = ["QInterface", "QInterfaceBase"]

@@ -1,0 +1,398 @@
+"""Named single/multi-qubit gates and the swap family.
+
+Default synthesis mirroring the reference (reference:
+include/qinterface.hpp:931-1422 named gates; :2399-2415 swap family;
+src/qinterface/gates.cpp:166-247 Swap/ISwap/SqrtSwap; src/qinterface/logic.cpp
+AND/OR/XOR). Everything reduces to the MCMtrxPerm primitive, so every
+layer/engine inherits the full set.
+"""
+
+from __future__ import annotations
+
+import cmath
+import math
+
+import numpy as np
+
+from .. import matrices as mat
+
+
+class GatesMixin:
+    # ---------------- single-qubit named gates ----------------
+
+    def H(self, q: int) -> None:
+        self.Mtrx(mat.H2, q)
+
+    def X(self, q: int) -> None:
+        self.Invert(1.0, 1.0, q)
+
+    def Y(self, q: int) -> None:
+        self.Invert(-1j, 1j, q)
+
+    def Z(self, q: int) -> None:
+        self.Phase(1.0, -1.0, q)
+
+    def S(self, q: int) -> None:
+        self.Phase(1.0, 1j, q)
+
+    def IS(self, q: int) -> None:
+        self.Phase(1.0, -1j, q)
+
+    def T(self, q: int) -> None:
+        self.Phase(1.0, cmath.exp(0.25j * math.pi), q)
+
+    def IT(self, q: int) -> None:
+        self.Phase(1.0, cmath.exp(-0.25j * math.pi), q)
+
+    def SqrtX(self, q: int) -> None:
+        self.Mtrx(mat.SQRTX2, q)
+
+    def ISqrtX(self, q: int) -> None:
+        self.Mtrx(mat.ISQRTX2, q)
+
+    def SqrtY(self, q: int) -> None:
+        self.Mtrx(mat.SQRTY2, q)
+
+    def ISqrtY(self, q: int) -> None:
+        self.Mtrx(mat.ISQRTY2, q)
+
+    def SqrtW(self, q: int) -> None:
+        """sqrt((X+Y)/sqrt(2)) — Sycamore gate set member
+        (reference: SqrtW usage in test_quantum_supremacy,
+        test/benchmarks.cpp:3635)."""
+        self.Mtrx(mat.SQRTW2, q)
+
+    def ISqrtW(self, q: int) -> None:
+        self.Mtrx(np.conj(mat.SQRTW2.T), q)
+
+    def SH(self, q: int) -> None:
+        """H then S (reference: include/qinterface.hpp:975)."""
+        self.H(q)
+        self.S(q)
+
+    def HIS(self, q: int) -> None:
+        """IS then H (inverse of SH)."""
+        self.IS(q)
+        self.H(q)
+
+    def PhaseRootN(self, n: int, q: int) -> None:
+        """Z^(1/2^(n-1)) — n=1 is Z, n=2 is S, n=3 is T
+        (reference: include/qinterface.hpp:1392)."""
+        if n == 0:
+            return
+        self.Phase(1.0, cmath.exp(1j * math.pi / (1 << (n - 1))), q)
+
+    def IPhaseRootN(self, n: int, q: int) -> None:
+        if n == 0:
+            return
+        self.Phase(1.0, cmath.exp(-1j * math.pi / (1 << (n - 1))), q)
+
+    def U(self, q: int, theta: float, phi: float, lambd: float) -> None:
+        """General 3-parameter unitary (reference: src/qinterface/rotational.cpp:18)."""
+        self.Mtrx(mat.u3_mtrx(theta, phi, lambd), q)
+
+    def U2(self, q: int, phi: float, lambd: float) -> None:
+        self.U(q, math.pi / 2, phi, lambd)
+
+    def IU2(self, q: int, phi: float, lambd: float) -> None:
+        """Inverse of U2 (reference: include/qinterface.hpp:856)."""
+        self.U(q, math.pi / 2, -lambd - math.pi, -phi + math.pi)
+
+    def AI(self, q: int, azimuth: float, inclination: float) -> None:
+        """Bloch azimuth/inclination gate (reference:
+        src/qinterface/rotational.cpp:55)."""
+        self.Mtrx(mat.ai_mtrx(azimuth, inclination), q)
+
+    def IAI(self, q: int, azimuth: float, inclination: float) -> None:
+        self.Mtrx(np.conj(mat.ai_mtrx(azimuth, inclination).T), q)
+
+    # ---------------- controlled named gates ----------------
+
+    def CNOT(self, control: int, target: int) -> None:
+        self.MCInvert((control,), 1.0, 1.0, target)
+
+    CX = CNOT
+
+    def AntiCNOT(self, control: int, target: int) -> None:
+        self.MACInvert((control,), 1.0, 1.0, target)
+
+    def CY(self, control: int, target: int) -> None:
+        self.MCInvert((control,), -1j, 1j, target)
+
+    def AntiCY(self, control: int, target: int) -> None:
+        self.MACInvert((control,), -1j, 1j, target)
+
+    def CZ(self, control: int, target: int) -> None:
+        self.MCPhase((control,), 1.0, -1.0, target)
+
+    def AntiCZ(self, control: int, target: int) -> None:
+        self.MACPhase((control,), 1.0, -1.0, target)
+
+    def CH(self, control: int, target: int) -> None:
+        self.MCMtrx((control,), mat.H2, target)
+
+    def AntiCH(self, control: int, target: int) -> None:
+        self.MACMtrx((control,), mat.H2, target)
+
+    def CS(self, control: int, target: int) -> None:
+        self.MCPhase((control,), 1.0, 1j, target)
+
+    def CIS(self, control: int, target: int) -> None:
+        self.MCPhase((control,), 1.0, -1j, target)
+
+    def CT(self, control: int, target: int) -> None:
+        self.MCPhase((control,), 1.0, cmath.exp(0.25j * math.pi), target)
+
+    def CIT(self, control: int, target: int) -> None:
+        self.MCPhase((control,), 1.0, cmath.exp(-0.25j * math.pi), target)
+
+    def CCNOT(self, c1: int, c2: int, target: int) -> None:
+        self.MCInvert((c1, c2), 1.0, 1.0, target)
+
+    Toffoli = CCNOT
+
+    def AntiCCNOT(self, c1: int, c2: int, target: int) -> None:
+        self.MACInvert((c1, c2), 1.0, 1.0, target)
+
+    def CCY(self, c1: int, c2: int, target: int) -> None:
+        self.MCInvert((c1, c2), -1j, 1j, target)
+
+    def AntiCCY(self, c1: int, c2: int, target: int) -> None:
+        self.MACInvert((c1, c2), -1j, 1j, target)
+
+    def CCZ(self, c1: int, c2: int, target: int) -> None:
+        self.MCPhase((c1, c2), 1.0, -1.0, target)
+
+    def AntiCCZ(self, c1: int, c2: int, target: int) -> None:
+        self.MACPhase((c1, c2), 1.0, -1.0, target)
+
+    def CU(self, controls, target: int, theta: float, phi: float, lambd: float) -> None:
+        self.MCMtrx(tuple(controls), mat.u3_mtrx(theta, phi, lambd), target)
+
+    def AntiCU(self, controls, target: int, theta: float, phi: float, lambd: float) -> None:
+        self.MACMtrx(tuple(controls), mat.u3_mtrx(theta, phi, lambd), target)
+
+    def CAI(self, control: int, target: int, azimuth: float, inclination: float) -> None:
+        self.MCMtrx((control,), mat.ai_mtrx(azimuth, inclination), target)
+
+    def CIAI(self, control: int, target: int, azimuth: float, inclination: float) -> None:
+        self.MCMtrx((control,), np.conj(mat.ai_mtrx(azimuth, inclination).T), target)
+
+    def AntiCAI(self, control: int, target: int, azimuth: float, inclination: float) -> None:
+        self.MACMtrx((control,), mat.ai_mtrx(azimuth, inclination), target)
+
+    def AntiCIAI(self, control: int, target: int, azimuth: float, inclination: float) -> None:
+        self.MACMtrx((control,), np.conj(mat.ai_mtrx(azimuth, inclination).T), target)
+
+    # ---------------- multi-target X/Z/phase masks ----------------
+
+    def XMask(self, mask: int) -> None:
+        """X on every set bit of mask (reference: include/qinterface.hpp:1196;
+        engines override with one fused kernel, xmask src/common/qengine.cl:266)."""
+        q = 0
+        while mask:
+            if mask & 1:
+                self.X(q)
+            mask >>= 1
+            q += 1
+
+    def YMask(self, mask: int) -> None:
+        q = 0
+        while mask:
+            if mask & 1:
+                self.Y(q)
+            mask >>= 1
+            q += 1
+
+    def ZMask(self, mask: int) -> None:
+        q = 0
+        while mask:
+            if mask & 1:
+                self.Z(q)
+            mask >>= 1
+            q += 1
+
+    def PhaseParity(self, radians: float, mask: int) -> None:
+        """exp(i*radians/2*parity(mask bits)) phase
+        (reference: src/qinterface/gates.cpp:399; kernel phaseparity
+        src/common/qengine.cl:306). Default synthesis: CNOT ladder + RZ."""
+        bits = [i for i in range(self.qubit_count) if (mask >> i) & 1]
+        if not bits:
+            return
+        for i in range(len(bits) - 1):
+            self.CNOT(bits[i], bits[i + 1])
+        self.RZ(radians, bits[-1])
+        for i in reversed(range(len(bits) - 1)):
+            self.CNOT(bits[i], bits[i + 1])
+
+    # ---------------- swap family ----------------
+    # (reference: src/qinterface/gates.cpp:166-247; include/qinterface.hpp:2399)
+
+    def Swap(self, q1: int, q2: int) -> None:
+        if q1 == q2:
+            return
+        self.CNOT(q1, q2)
+        self.CNOT(q2, q1)
+        self.CNOT(q1, q2)
+
+    def ISwap(self, q1: int, q2: int) -> None:
+        """Swap + i phase on |01>,|10> (reference: gates.cpp:189)."""
+        if q1 == q2:
+            return
+        self.Swap(q1, q2)
+        self.CZ(q1, q2)
+        self.S(q1)
+        self.S(q2)
+
+    def IISwap(self, q1: int, q2: int) -> None:
+        if q1 == q2:
+            return
+        self.IS(q2)
+        self.IS(q1)
+        self.CZ(q1, q2)
+        self.Swap(q1, q2)
+
+    def SqrtSwap(self, q1: int, q2: int) -> None:
+        """Half-way swap (reference: gates.cpp:205)."""
+        if q1 == q2:
+            return
+        self.Apply4x4(_SQRT_SWAP4, q1, q2)
+
+    def ISqrtSwap(self, q1: int, q2: int) -> None:
+        if q1 == q2:
+            return
+        self.Apply4x4(_ISQRT_SWAP4, q1, q2)
+
+    def CSwap(self, controls, q1: int, q2: int) -> None:
+        """Controlled swap (reference: CSwap include/qinterface.hpp:2408);
+        synthesized as CNOT + CCNOT + CNOT."""
+        controls = tuple(controls)
+        self.CNOT(q2, q1)
+        self.MCInvert(controls + (q1,), 1.0, 1.0, q2)
+        self.CNOT(q2, q1)
+
+    def AntiCSwap(self, controls, q1: int, q2: int) -> None:
+        controls = tuple(controls)
+        for c in controls:
+            self.X(c)
+        self.CSwap(controls, q1, q2)
+        for c in controls:
+            self.X(c)
+
+    def CSqrtSwap(self, controls, q1: int, q2: int) -> None:
+        self._controlled_two_qubit(controls, q1, q2, _SQRT_SWAP4, anti=False)
+
+    def AntiCSqrtSwap(self, controls, q1: int, q2: int) -> None:
+        self._controlled_two_qubit(controls, q1, q2, _SQRT_SWAP4, anti=True)
+
+    def CISqrtSwap(self, controls, q1: int, q2: int) -> None:
+        self._controlled_two_qubit(controls, q1, q2, _ISQRT_SWAP4, anti=False)
+
+    def AntiCISqrtSwap(self, controls, q1: int, q2: int) -> None:
+        self._controlled_two_qubit(controls, q1, q2, _ISQRT_SWAP4, anti=True)
+
+    def FSim(self, theta: float, phi: float, q1: int, q2: int) -> None:
+        """Fermionic simulation gate (reference: FSim
+        include/qinterface.hpp:2415; gates.cpp synthesis)."""
+        cos = math.cos(theta)
+        sin = math.sin(theta)
+        m = np.array(
+            [
+                [1, 0, 0, 0],
+                [0, cos, -1j * sin, 0],
+                [0, -1j * sin, cos, 0],
+                [0, 0, 0, cmath.exp(-1j * phi)],
+            ],
+            dtype=np.complex128,
+        )
+        self.Apply4x4(m, q1, q2)
+
+    # ---------------- two-qubit 4x4 fallback ----------------
+
+    def Apply4x4(self, m: np.ndarray, q1: int, q2: int) -> None:
+        """Apply an arbitrary 4x4 unitary on (q2:high, q1:low) via the
+        cosine-sine-free generic decomposition: two-level rotations through
+        the MCMtrxPerm primitive. Engines override with a native tensor op."""
+        # Decompose into controlled 2x2 operations using Gray-code two-level
+        # synthesis on the 4-dim space spanned by the two qubits.
+        from .synth import apply_small_unitary_via_primitive
+
+        apply_small_unitary_via_primitive(self, m, (q1, q2))
+
+    # ---------------- classical logic (reference: src/qinterface/logic.cpp) ----
+
+    def AND(self, a: int, b: int, out: int) -> None:
+        self.CCNOT(a, b, out)
+
+    def OR(self, a: int, b: int, out: int) -> None:
+        self.X(out)
+        self.AntiCCNOT(a, b, out)
+
+    def XOR(self, a: int, b: int, out: int) -> None:
+        if a == out:
+            self.CNOT(b, out)
+            return
+        if b == out:
+            self.CNOT(a, out)
+            return
+        self.CNOT(a, out)
+        self.CNOT(b, out)
+
+    def NAND(self, a: int, b: int, out: int) -> None:
+        self.AND(a, b, out)
+        self.X(out)
+
+    def NOR(self, a: int, b: int, out: int) -> None:
+        self.OR(a, b, out)
+        self.X(out)
+
+    def XNOR(self, a: int, b: int, out: int) -> None:
+        self.XOR(a, b, out)
+        self.X(out)
+
+    def CLAND(self, classical: bool, q: int, out: int) -> None:
+        if classical:
+            self.CNOT(q, out)
+
+    def CLOR(self, classical: bool, q: int, out: int) -> None:
+        if classical:
+            self.X(out)
+        else:
+            self.CNOT(q, out)
+
+    def CLXOR(self, classical: bool, q: int, out: int) -> None:
+        if q != out:
+            self.CNOT(q, out)
+        if classical:
+            self.X(out)
+
+    def CLNAND(self, classical: bool, q: int, out: int) -> None:
+        self.CLAND(classical, q, out)
+        self.X(out)
+
+    def CLNOR(self, classical: bool, q: int, out: int) -> None:
+        self.CLOR(classical, q, out)
+        self.X(out)
+
+    def CLXNOR(self, classical: bool, q: int, out: int) -> None:
+        self.CLXOR(classical, q, out)
+        self.X(out)
+
+    def _controlled_two_qubit(self, controls, q1, q2, m4, anti: bool) -> None:
+        from .synth import apply_small_unitary_via_primitive
+
+        controls = tuple(controls)
+        perm = 0 if anti else (1 << len(controls)) - 1
+        apply_small_unitary_via_primitive(self, m4, (q1, q2), controls=controls, perm=perm)
+
+
+_SQRT_SWAP4 = np.array(
+    [
+        [1, 0, 0, 0],
+        [0, 0.5 + 0.5j, 0.5 - 0.5j, 0],
+        [0, 0.5 - 0.5j, 0.5 + 0.5j, 0],
+        [0, 0, 0, 1],
+    ],
+    dtype=np.complex128,
+)
+_ISQRT_SWAP4 = np.conj(_SQRT_SWAP4.T)

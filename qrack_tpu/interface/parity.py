@@ -1,0 +1,68 @@
+"""Parity measurement/rotation mixin (the QParity surface).
+
+Reference: include/qparity.hpp:22-56 — ProbParity / ForceMParity /
+UniformParityRZ / CUniformParityRZ; engine kernels probparity /
+forcemparity / uniformparityrz (src/common/qengine.cl:452-948).
+Defaults here are universal syntheses; dense engines override with
+vectorized diagonal kernels.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class ParityMixin:
+    def _mask_bits(self, mask: int):
+        return [i for i in range(self.qubit_count) if (mask >> i) & 1]
+
+    def ProbParity(self, mask: int) -> float:
+        """P(odd parity) over the masked bits."""
+        probs = self.GetProbs()
+        idx = np.arange(probs.shape[0], dtype=np.uint64)
+        par = np.bitwise_count(idx & np.uint64(mask)) & 1
+        return float(probs[par == 1].sum())
+
+    def ForceMParity(self, mask: int, result: bool, do_force: bool = True) -> bool:
+        """Measure (or force) the joint parity of the masked bits."""
+        odd_prob = self.ProbParity(mask)
+        if not do_force:
+            result = self.Rand() <= odd_prob
+        nrm_sq = odd_prob if result else (1.0 - odd_prob)
+        if nrm_sq <= 0.0:
+            raise RuntimeError("ForceMParity: forced outcome has zero probability")
+        state = np.asarray(self.GetQuantumState(), dtype=np.complex128).copy()
+        idx = np.arange(state.shape[0], dtype=np.uint64)
+        par = (np.bitwise_count(idx & np.uint64(mask)) & 1).astype(bool)
+        keep = par if result else ~par
+        state[~keep] = 0.0
+        state /= math.sqrt(nrm_sq)
+        self.SetQuantumState(state)
+        return bool(result)
+
+    def UniformParityRZ(self, mask: int, angle: float) -> None:
+        """Parity phase: e^{+i*angle} on odd parity of the masked bits,
+        e^{-i*angle} on even (reference kernel uniformparityrz,
+        src/common/qengine.cl:452; phase factors src/qengine/opencl.cpp:1145)."""
+        bits = self._mask_bits(mask)
+        if not bits:
+            return
+        for i in range(len(bits) - 1):
+            self.CNOT(bits[i], bits[i + 1])
+        self.RZ(2.0 * angle, bits[-1])
+        for i in reversed(range(len(bits) - 1)):
+            self.CNOT(bits[i], bits[i + 1])
+
+    def CUniformParityRZ(self, controls, mask: int, angle: float) -> None:
+        bits = self._mask_bits(mask)
+        if not bits:
+            return
+        controls = tuple(controls)
+        for i in range(len(bits) - 1):
+            self.CNOT(bits[i], bits[i + 1])
+        c, s = math.cos(angle), math.sin(angle)
+        self.MCPhase(controls, complex(c, -s), complex(c, s), bits[-1])
+        for i in reversed(range(len(bits) - 1)):
+            self.CNOT(bits[i], bits[i + 1])

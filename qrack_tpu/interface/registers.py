@@ -1,0 +1,291 @@
+"""Register-spanning operations: QFT family, circular shifts, bitwise gates.
+
+Mirrors the reference's register API (reference: QFT/IQFT/QFTR
+src/qinterface/qinterface.cpp:114-180; ROL/ROR :297-330 swap-reversal
+algorithm; bitwise gate loops include/qinterface.hpp:1737-2141, gated
+there by ENABLE_REG_GATES — always available here).
+"""
+
+from __future__ import annotations
+
+import cmath
+import math
+from typing import Sequence
+
+
+class RegistersMixin:
+    # ---------------- QFT family ----------------
+
+    def PhaseRootNMask(self, n: int, mask: int) -> None:
+        q = 0
+        m = mask
+        while m:
+            if m & 1:
+                self.PhaseRootN(n, q)
+            m >>= 1
+            q += 1
+
+    def CPhaseRootN(self, n: int, control: int, target: int) -> None:
+        if n == 0:
+            return
+        self.MCPhase((control,), 1.0, cmath.exp(1j * math.pi / (1 << (n - 1))), target)
+
+    def CIPhaseRootN(self, n: int, control: int, target: int) -> None:
+        if n == 0:
+            return
+        self.MCPhase((control,), 1.0, cmath.exp(-1j * math.pi / (1 << (n - 1))), target)
+
+    def AntiCPhaseRootN(self, n: int, control: int, target: int) -> None:
+        if n == 0:
+            return
+        self.MACPhase((control,), 1.0, cmath.exp(1j * math.pi / (1 << (n - 1))), target)
+
+    def AntiCIPhaseRootN(self, n: int, control: int, target: int) -> None:
+        if n == 0:
+            return
+        self.MACPhase((control,), 1.0, cmath.exp(-1j * math.pi / (1 << (n - 1))), target)
+
+    def QFT(self, start: int, length: int, try_separate: bool = False) -> None:
+        """QFT optimized for |0>/|1> -> |+>/|-> (reference:
+        src/qinterface/qinterface.cpp:114)."""
+        if not length:
+            return
+        end = start + length - 1
+        for i in range(length):
+            h_bit = end - i
+            for j in range(i):
+                c = h_bit
+                t = h_bit + 1 + j
+                self.CPhaseRootN(j + 2, c, t)
+                if try_separate:
+                    self.TrySeparate((c, t))
+            self.H(h_bit)
+
+    def IQFT(self, start: int, length: int, try_separate: bool = False) -> None:
+        if not length:
+            return
+        for i in range(length):
+            for j in range(i):
+                c = (start + i) - (j + 1)
+                t = start + i
+                self.CIPhaseRootN(j + 2, c, t)
+                if try_separate:
+                    self.TrySeparate((c, t))
+            self.H(start + i)
+
+    def QFTR(self, qubits: Sequence[int], try_separate: bool = False) -> None:
+        """QFT over an arbitrary qubit list (reference:
+        src/qinterface/qinterface.cpp:157)."""
+        if not qubits:
+            return
+        end = len(qubits) - 1
+        for i in range(len(qubits)):
+            self.H(qubits[end - i])
+            for j in range(len(qubits) - 1 - i):
+                self.CPhaseRootN(j + 2, qubits[end - i - (j + 1)], qubits[end - i])
+            if try_separate:
+                self.TrySeparate(qubits[end - i])
+
+    def IQFTR(self, qubits: Sequence[int], try_separate: bool = False) -> None:
+        if not qubits:
+            return
+        for i in range(len(qubits)):
+            for j in range(i):
+                self.CIPhaseRootN(i - j + 1, qubits[j], qubits[i])
+            self.H(qubits[i])
+            if try_separate:
+                self.TrySeparate(qubits[i])
+
+    # ---------------- circular shifts (reference: qinterface.cpp:297) ------
+
+    def Reverse(self, first: int, last: int) -> None:
+        """Reverse qubit order in [first, last) via swaps."""
+        last -= 1
+        while first < last:
+            self.Swap(first, last)
+            first += 1
+            last -= 1
+
+    def ROL(self, shift: int, start: int, length: int) -> None:
+        if length < 2:
+            return
+        shift %= length
+        if not shift:
+            return
+        end = start + length
+        self.Reverse(start, end)
+        self.Reverse(start, start + shift)
+        self.Reverse(start + shift, end)
+
+    def ROR(self, shift: int, start: int, length: int) -> None:
+        if length < 2:
+            return
+        shift %= length
+        if not shift:
+            return
+        end = start + length
+        self.Reverse(start + shift, end)
+        self.Reverse(start, start + shift)
+        self.Reverse(start, end)
+
+    # ---------------- classical register set ----------------
+
+    def SetReg(self, start: int, length: int, value: int) -> None:
+        """Set a register to a classical value (reference: SetReg —
+        measure then flip differing bits)."""
+        measured = self.MReg(start, length)
+        diff = measured ^ value
+        for i in range(length):
+            if (diff >> i) & 1:
+                self.X(start + i)
+
+    def SetBit(self, q: int, value: bool) -> None:
+        if self.M(q) != value:
+            self.X(q)
+
+    # ---------------- bitwise register gates ----------------
+    # (reference: include/qinterface.hpp:1737-2141)
+
+    def HReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.H(start + i)
+
+    def XReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.X(start + i)
+
+    def YReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.Y(start + i)
+
+    def ZReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.Z(start + i)
+
+    def SReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.S(start + i)
+
+    def ISReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.IS(start + i)
+
+    def TReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.T(start + i)
+
+    def ITReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.IT(start + i)
+
+    def SqrtXReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.SqrtX(start + i)
+
+    def ISqrtXReg(self, start: int, length: int) -> None:
+        for i in range(length):
+            self.ISqrtX(start + i)
+
+    def PhaseRootNReg(self, n: int, start: int, length: int) -> None:
+        for i in range(length):
+            self.PhaseRootN(n, start + i)
+
+    def IPhaseRootNReg(self, n: int, start: int, length: int) -> None:
+        for i in range(length):
+            self.IPhaseRootN(n, start + i)
+
+    def CNOTReg(self, control_start: int, target_start: int, length: int) -> None:
+        for i in range(length):
+            self.CNOT(control_start + i, target_start + i)
+
+    def AntiCNOTReg(self, control_start: int, target_start: int, length: int) -> None:
+        for i in range(length):
+            self.AntiCNOT(control_start + i, target_start + i)
+
+    def CCNOTReg(self, c1_start: int, c2_start: int, target_start: int, length: int) -> None:
+        for i in range(length):
+            self.CCNOT(c1_start + i, c2_start + i, target_start + i)
+
+    def CYReg(self, control_start: int, target_start: int, length: int) -> None:
+        for i in range(length):
+            self.CY(control_start + i, target_start + i)
+
+    def CZReg(self, control_start: int, target_start: int, length: int) -> None:
+        for i in range(length):
+            self.CZ(control_start + i, target_start + i)
+
+    def SwapReg(self, start1: int, start2: int, length: int) -> None:
+        for i in range(length):
+            self.Swap(start1 + i, start2 + i)
+
+    def ISwapReg(self, start1: int, start2: int, length: int) -> None:
+        for i in range(length):
+            self.ISwap(start1 + i, start2 + i)
+
+    def SqrtSwapReg(self, start1: int, start2: int, length: int) -> None:
+        for i in range(length):
+            self.SqrtSwap(start1 + i, start2 + i)
+
+    def CSwapReg(self, control_start: int, start1: int, start2: int, length: int) -> None:
+        for i in range(length):
+            self.CSwap((control_start + i,), start1 + i, start2 + i)
+
+    def ANDReg(self, a_start: int, b_start: int, out_start: int, length: int) -> None:
+        for i in range(length):
+            self.AND(a_start + i, b_start + i, out_start + i)
+
+    def ORReg(self, a_start: int, b_start: int, out_start: int, length: int) -> None:
+        for i in range(length):
+            self.OR(a_start + i, b_start + i, out_start + i)
+
+    def XORReg(self, a_start: int, b_start: int, out_start: int, length: int) -> None:
+        for i in range(length):
+            self.XOR(a_start + i, b_start + i, out_start + i)
+
+    def CLANDReg(self, classical: int, q_start: int, out_start: int, length: int) -> None:
+        for i in range(length):
+            self.CLAND(bool((classical >> i) & 1), q_start + i, out_start + i)
+
+    def CLORReg(self, classical: int, q_start: int, out_start: int, length: int) -> None:
+        for i in range(length):
+            self.CLOR(bool((classical >> i) & 1), q_start + i, out_start + i)
+
+    def CLXORReg(self, classical: int, q_start: int, out_start: int, length: int) -> None:
+        for i in range(length):
+            self.CLXOR(bool((classical >> i) & 1), q_start + i, out_start + i)
+
+    def RTReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.RT(radians, start + i)
+
+    def RXReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.RX(radians, start + i)
+
+    def RYReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.RY(radians, start + i)
+
+    def RZReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.RZ(radians, start + i)
+
+    def CRZReg(self, radians: float, control_start: int, target_start: int, length: int) -> None:
+        for i in range(length):
+            self.CRZ(radians, control_start + i, target_start + i)
+
+    def ExpReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.Exp(radians, start + i)
+
+    def ExpXReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.ExpX(radians, start + i)
+
+    def ExpYReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.ExpY(radians, start + i)
+
+    def ExpZReg(self, radians: float, start: int, length: int) -> None:
+        for i in range(length):
+            self.ExpZ(radians, start + i)

@@ -1,0 +1,125 @@
+"""Rotation gates: radian + dyadic-fraction + Pauli-exponentiation forms.
+
+Conventions match the reference exactly (reference:
+src/qinterface/rotational.cpp:170-290; dyadAngle
+src/qinterface/qinterface.cpp:1310 = -2*pi*numerator / 2^denomPower;
+note the reference's CRX/CRT sign quirks are reproduced deliberately).
+"""
+
+from __future__ import annotations
+
+import cmath
+import math
+
+import numpy as np
+
+from .. import matrices as mat
+
+
+def _dyad_angle(numerator: int, denom_power: int) -> float:
+    return (-math.pi * numerator * 2) / (1 << denom_power)
+
+
+class RotationsMixin:
+    # ---------------- radian rotations ----------------
+
+    def RT(self, radians: float, q: int) -> None:
+        """Phase shift: e^{i*radians/2} on |1> (reference: rotational.cpp:173)."""
+        self.Phase(1.0, cmath.exp(0.5j * radians), q)
+
+    def RX(self, radians: float, q: int) -> None:
+        c, s = math.cos(radians / 2), math.sin(radians / 2)
+        self.Mtrx(np.array([[c, -1j * s], [-1j * s, c]], dtype=np.complex128), q)
+
+    def RY(self, radians: float, q: int) -> None:
+        c, s = math.cos(radians / 2), math.sin(radians / 2)
+        self.Mtrx(np.array([[c, -s], [s, c]], dtype=np.complex128), q)
+
+    def RZ(self, radians: float, q: int) -> None:
+        c, s = math.cos(radians / 2), math.sin(radians / 2)
+        self.Phase(complex(c, -s), complex(c, s), q)
+
+    def CRT(self, radians: float, control: int, target: int) -> None:
+        self.MCPhase((control,), 1.0, cmath.exp(0.5j * radians), target)
+
+    def CRX(self, radians: float, control: int, target: int) -> None:
+        # Sign matches the reference's controlled-X rotation (+i*sin),
+        # reference: rotational.cpp:281-287.
+        c, s = math.cos(radians / 2), math.sin(radians / 2)
+        self.MCMtrx((control,), np.array([[c, 1j * s], [1j * s, c]], dtype=np.complex128), target)
+
+    def CRY(self, radians: float, control: int, target: int) -> None:
+        c, s = math.cos(radians / 2), math.sin(radians / 2)
+        self.MCMtrx((control,), np.array([[c, -s], [s, c]], dtype=np.complex128), target)
+
+    def CRZ(self, radians: float, control: int, target: int) -> None:
+        c, s = math.cos(radians / 2), math.sin(radians / 2)
+        self.MCPhase((control,), complex(c, -s), complex(c, s), target)
+
+    # ---------------- dyadic-fraction rotations ----------------
+    # (reference: src/qinterface/qinterface.cpp:1310-1380; angle sign is
+    #  reversed and not divided by two, per include/qinterface.hpp:1505)
+
+    def RTDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.RT(_dyad_angle(numerator, denom_power), q)
+
+    def RXDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.RX(_dyad_angle(numerator, denom_power), q)
+
+    def RYDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.RY(_dyad_angle(numerator, denom_power), q)
+
+    def RZDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.RZ(_dyad_angle(numerator, denom_power), q)
+
+    def CRTDyad(self, numerator: int, denom_power: int, control: int, target: int) -> None:
+        self.CRT(_dyad_angle(numerator, denom_power), control, target)
+
+    def CRXDyad(self, numerator: int, denom_power: int, control: int, target: int) -> None:
+        self.CRX(_dyad_angle(numerator, denom_power), control, target)
+
+    def CRYDyad(self, numerator: int, denom_power: int, control: int, target: int) -> None:
+        self.CRY(_dyad_angle(numerator, denom_power), control, target)
+
+    def CRZDyad(self, numerator: int, denom_power: int, control: int, target: int) -> None:
+        self.CRZ(_dyad_angle(numerator, denom_power), control, target)
+
+    # ---------------- Pauli exponentiation ----------------
+    # (reference: rotational.cpp:227-270 — note e^{i*radians*P}, no /2)
+
+    def Exp(self, radians: float, q: int) -> None:
+        ph = cmath.exp(1j * radians)
+        self.Phase(ph, ph, q)
+
+    def ExpX(self, radians: float, q: int) -> None:
+        ph = cmath.exp(1j * radians)
+        self.Invert(ph, ph, q)
+
+    def ExpY(self, radians: float, q: int) -> None:
+        ph = cmath.exp(1j * radians)
+        self.Invert(ph * -1j, ph * 1j, q)
+
+    def ExpZ(self, radians: float, q: int) -> None:
+        ph = cmath.exp(1j * radians)
+        self.Phase(ph, -ph, q)
+
+    def ExpMtrx(self, controls, q: int, mtrx: np.ndarray, anti_ctrled: bool = False) -> None:
+        """exp(i * mtrx) under controls (reference: Exp(controls,...)
+        rotational.cpp:234)."""
+        m = mat.exp_mtrx(1j * np.asarray(mtrx, dtype=np.complex128))
+        if anti_ctrled:
+            self.MACMtrx(tuple(controls), m, q)
+        else:
+            self.MCMtrx(tuple(controls), m, q)
+
+    def ExpDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.Exp(_dyad_angle(numerator, denom_power), q)
+
+    def ExpXDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.ExpX(_dyad_angle(numerator, denom_power), q)
+
+    def ExpYDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.ExpY(_dyad_angle(numerator, denom_power), q)
+
+    def ExpZDyad(self, numerator: int, denom_power: int, q: int) -> None:
+        self.ExpZ(_dyad_angle(numerator, denom_power), q)

@@ -1,0 +1,125 @@
+"""Two-level (Givens + Gray-code) synthesis of small unitaries.
+
+Lets any layer apply an arbitrary 2^k x 2^k unitary through the single
+MCMtrxPerm primitive, the same role the reference's compositional
+fallbacks play (reference: src/qinterface/gates.cpp — Swap/FSim built
+from CNOT ladders). Dense engines override Apply4x4 with a native
+tensor contraction; this path exists so *every* layer supports the full
+two-qubit gate family.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+_X2 = np.array([[0, 1], [1, 0]], dtype=np.complex128)
+
+
+def two_level_decompose(u: np.ndarray) -> List[Tuple[int, int, np.ndarray]]:
+    """Factor unitary `u` into two-level unitaries.
+
+    Returns ops [(i, j, m2), ...] such that applying each m2 on the
+    (|i>, |j>) subspace *in list order* implements `u`.
+    """
+    d = u.shape[0]
+    w = u.astype(np.complex128).copy()
+    t_list: List[Tuple[int, int, np.ndarray]] = []  # T_k ... T_1 w = I
+    for c in range(d - 1):
+        for r in range(c + 1, d):
+            a = w[c, c]
+            b = w[r, c]
+            if abs(b) < 1e-14:
+                continue
+            n = np.sqrt(abs(a) ** 2 + abs(b) ** 2)
+            g = np.array(
+                [[np.conj(a) / n, np.conj(b) / n], [b / n, -a / n]], dtype=np.complex128
+            )
+            # rows c, r of w <- g @ [row c; row r]
+            rows = np.stack([w[c, :], w[r, :]])
+            rows = g @ rows
+            w[c, :] = rows[0]
+            w[r, :] = rows[1]
+            t_list.append((c, r, g))
+        # normalize the diagonal phase of column c
+        ph = w[c, c]
+        if abs(ph - 1.0) > 1e-14:
+            g = np.array([[np.conj(ph), 0], [0, 1]], dtype=np.complex128)
+            w[c, :] = np.conj(ph) * w[c, :]
+            # the (c, c) "two-level" phase needs a partner index; use d-1
+            t_list.append((c, d - 1, np.array([[np.conj(ph), 0], [0, 1]], dtype=np.complex128)))
+            # undo the unintended identity action on row d-1 (none: bottom-right is 1)
+    ph = w[d - 1, d - 1]
+    if abs(ph - 1.0) > 1e-14:
+        t_list.append((d - 2, d - 1, np.array([[1, 0], [0, np.conj(ph)]], dtype=np.complex128)))
+        w[d - 1, :] = np.conj(ph) * w[d - 1, :]
+    # w is now I; u = T_1^† ... T_k^†, applied right-to-left ⇒ op order T_k^†, ..., T_1^†
+    ops = [(i, j, np.conj(g.T)) for (i, j, g) in reversed(t_list)]
+    return ops
+
+
+def apply_small_unitary_via_primitive(
+    qi,
+    u: np.ndarray,
+    qubits: Sequence[int],
+    controls: Sequence[int] = (),
+    perm: int = 0,
+) -> None:
+    """Apply `u` over `qubits` (qubits[0] = least-significant subspace bit)
+    via MCMtrxPerm, optionally under external `controls` at permutation
+    `perm`."""
+    k = len(qubits)
+    assert u.shape == (1 << k, 1 << k)
+    for (i, j, m2) in two_level_decompose(u):
+        _apply_two_level(qi, qubits, i, j, m2, controls, perm)
+
+
+def _apply_two_level(qi, qubits, i, j, m2, ext_controls, ext_perm) -> None:
+    diff = i ^ j
+    bits = [t for t in range(len(qubits)) if (diff >> t) & 1]
+    # Gray-code walk i -> j; last flip is the gate target
+    path = [i]
+    cur = i
+    for b in bits:
+        cur ^= 1 << b
+        path.append(cur)
+    # permutation steps mapping amplitude of i to path[-2]
+    for t in range(1, len(path) - 1):
+        _pair_x(qi, qubits, path[t - 1], path[t], ext_controls, ext_perm)
+    a, b = path[-2], path[-1]
+    tbit = (a ^ b).bit_length() - 1
+    # basis order: m2 is expressed on (|i>, |j>) ~ (|a>, |b>) after the walk
+    if (a >> tbit) & 1:
+        g = _X2 @ m2 @ _X2  # a has target=1: reorder to (|target=0>, |target=1>)
+    else:
+        g = m2
+    _controlled_on_pair(qi, qubits, a, tbit, g, ext_controls, ext_perm)
+    for t in reversed(range(1, len(path) - 1)):
+        _pair_x(qi, qubits, path[t - 1], path[t], ext_controls, ext_perm)
+
+
+def _pair_x(qi, qubits, a, b, ext_controls, ext_perm) -> None:
+    tbit = (a ^ b).bit_length() - 1
+    _controlled_on_pair(qi, qubits, a, tbit, _X2, ext_controls, ext_perm)
+
+
+def _controlled_on_pair(qi, qubits, rep, tbit, g, ext_controls, ext_perm) -> None:
+    """Apply 2x2 `g` to qubits[tbit], controlled on every other subspace
+    qubit matching index `rep`, plus the external controls."""
+    ctrls = []
+    perm = 0
+    pos = 0
+    for t, q in enumerate(qubits):
+        if t == tbit:
+            continue
+        ctrls.append(q)
+        if (rep >> t) & 1:
+            perm |= 1 << pos
+        pos += 1
+    for jx, c in enumerate(ext_controls):
+        ctrls.append(c)
+        if (ext_perm >> jx) & 1:
+            perm |= 1 << pos
+        pos += 1
+    qi.MCMtrxPerm(tuple(ctrls), g, qubits[tbit], perm)

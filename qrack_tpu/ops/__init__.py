@@ -1,0 +1,1 @@
+from . import alu_kernels  # noqa: F401

@@ -1,0 +1,10 @@
+"""Pauli operator ids (reference: include/pauli.hpp — Q#-compatible values)."""
+
+from enum import IntEnum
+
+
+class Pauli(IntEnum):
+    PauliI = 0
+    PauliX = 1
+    PauliZ = 2
+    PauliY = 3

@@ -1,0 +1,1 @@
+from . import turboquant  # noqa: F401

@@ -1,0 +1,2 @@
+from . import bits  # noqa: F401
+from .rng import QrackRandom  # noqa: F401

@@ -1,0 +1,104 @@
+"""Bit-twiddling helpers over arbitrary-precision Python ints.
+
+TPU-native replacement for the reference's index utilities
+(reference: include/common/qrack_functions.hpp:1-271 — log2Ocl / pow2 /
+bitRegMask / intPow; include/common/big_integer.hpp — obsoleted here by
+Python ints).
+
+Also provides the vectorized "masked index" generators that replace the
+reference's skip-bit iterators (reference: par_for_mask,
+include/common/parallel_for.hpp:60-96): instead of striding a loop while
+skipping target/control bits, we *materialize* the index set as a numpy
+vector (host oracle) or compute it inside a jitted program with the same
+bit-deposit recurrence (device path, see qrack_tpu/ops/gatekernels.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+
+def pow2(p: int) -> int:
+    return 1 << p
+
+
+def log2(n: int) -> int:
+    """Floor log2 for n >= 1 (reference log2Ocl)."""
+    return n.bit_length() - 1
+
+
+def bit_reg_mask(start: int, length: int) -> int:
+    """Mask with `length` ones starting at bit `start` (reference bitRegMask)."""
+    return ((1 << length) - 1) << start
+
+
+def is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def int_pow(base: int, power: int) -> int:
+    return base ** power
+
+
+def popcount(n: int) -> int:
+    return bin(n).count("1")
+
+
+def bit_slice(value: int, start: int, length: int) -> int:
+    """Extract `length` bits of `value` starting at `start`."""
+    return (value >> start) & ((1 << length) - 1)
+
+
+def set_bit_slice(value: int, start: int, length: int, field: int) -> int:
+    mask = ((1 << length) - 1) << start
+    return (value & ~mask) | ((field << start) & mask)
+
+
+def reverse_bits(value: int, length: int) -> int:
+    out = 0
+    for _ in range(length):
+        out = (out << 1) | (value & 1)
+        value >>= 1
+    return out
+
+
+def deposit_indices(n_qubits: int, skip_bits: Sequence[int]) -> np.ndarray:
+    """All 2^(n-k) indices of an n-qubit register with the k `skip_bits` zero.
+
+    Vectorized equivalent of the reference's par_for_mask index walk
+    (reference: src/common/parallel_for.cpp, par_for_mask): each skipped
+    bit position splits the counter and shifts the high part up one.
+    Returned dtype is int64 (valid for any page that fits in memory).
+    """
+    k = len(skip_bits)
+    count = 1 << (n_qubits - k)
+    idx = np.arange(count, dtype=np.int64)
+    for p in sorted(skip_bits):
+        low_mask = (1 << p) - 1
+        idx = ((idx & ~low_mask) << 1) | (idx & low_mask)
+    return idx
+
+
+def control_offset(controls: Iterable[int], perm: int) -> int:
+    """Bit-or of 2^c for each control whose bit in `perm` is 1.
+
+    `perm` indexes control values positionally: bit j of perm is the
+    required state of controls[j] (reference: UCMtrx control permutation,
+    include/qinterface.hpp:560-650).
+    """
+    off = 0
+    for j, c in enumerate(controls):
+        if (perm >> j) & 1:
+            off |= 1 << c
+    return off
+
+
+def perm_from_mask(controls: Sequence[int], required_mask: int) -> int:
+    """Convert a bit-position mask of required-on controls to a positional perm."""
+    perm = 0
+    for j, c in enumerate(controls):
+        if (required_mask >> c) & 1:
+            perm |= 1 << j
+    return perm

@@ -1,0 +1,48 @@
+"""Deterministic RNG wrapper.
+
+Replaces the reference's mt19937_64 + hardware RDRAND stack
+(reference: include/common/qrack_types.hpp:157 qrack_rand_gen;
+include/common/rdrandwrapper.hpp). Hardware entropy is drawn from
+os.urandom when no seed is given; with SetRandomSeed the stream is
+exactly reproducible, which the conformance suite relies on for
+CPU-vs-TPU parity (SURVEY.md §4 "TPU-build implication").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class QrackRandom:
+    def __init__(self, seed: Optional[int] = None):
+        self.seed(seed)
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        if seed is None:
+            seed = int.from_bytes(os.urandom(8), "little")
+        self._seed = seed
+        self._gen = np.random.Generator(np.random.PCG64(seed))
+
+    def rand(self) -> float:
+        """Uniform in [0, 1)."""
+        return float(self._gen.random())
+
+    def uniform(self, size=None):
+        return self._gen.random(size)
+
+    def randint(self, low: int, high: int) -> int:
+        return int(self._gen.integers(low, high))
+
+    def choice_from_probs(self, probs: np.ndarray, shots: int) -> np.ndarray:
+        """Multinomial sampling used by MultiShotMeasureMask."""
+        cdf = np.cumsum(probs)
+        cdf = cdf / cdf[-1]
+        u = self._gen.random(shots)
+        return np.searchsorted(cdf, u, side="right")
+
+    def spawn(self) -> "QrackRandom":
+        """Independent child stream (for per-subsystem engines)."""
+        return QrackRandom(self.randint(0, 2 ** 62))

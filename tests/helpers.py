@@ -1,0 +1,49 @@
+"""Independent brute-force oracle utilities for conformance tests.
+
+Deliberately implemented with explicit index loops (not the engine's
+vectorized index algebra) so engine bugs can't hide in shared code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def full_unitary(n: int, m: np.ndarray, qubits) -> np.ndarray:
+    """Expand unitary `m` over `qubits` (qubits[0] = LSB of m's index) to
+    the full 2^n space. O(4^n) — test-size only."""
+    k = len(qubits)
+    dim = 1 << n
+    u = np.zeros((dim, dim), dtype=np.complex128)
+    for i in range(dim):
+        sub = 0
+        for j, q in enumerate(qubits):
+            sub |= ((i >> q) & 1) << j
+        base = i
+        for q in qubits:
+            base &= ~(1 << q)
+        for sub2 in range(1 << k):
+            i2 = base
+            for j, q in enumerate(qubits):
+                i2 |= ((sub2 >> j) & 1) << q
+            u[i2, i] += m[sub2, sub]
+    return u
+
+
+def controlled(m: np.ndarray, n_controls: int, perm: int = None) -> np.ndarray:
+    """Controlled expansion: m on target (LSB), controls above it."""
+    if perm is None:
+        perm = (1 << n_controls) - 1
+    dim = 2 << n_controls
+    u = np.eye(dim, dtype=np.complex128)
+    # target = bit 0, controls = bits 1..n_controls
+    for t in (0, 1):
+        for t2 in (0, 1):
+            u[(perm << 1) | t2, (perm << 1) | t] = m[t2, t]
+    return u
+
+
+def rand_state(n: int, seed: int) -> np.ndarray:
+    g = np.random.Generator(np.random.PCG64(seed))
+    v = g.normal(size=1 << n) + 1j * g.normal(size=1 << n)
+    return (v / np.linalg.norm(v)).astype(np.complex128)

@@ -1,0 +1,267 @@
+"""ALU conformance: engine index-map kernels vs classical arithmetic, and
+the universal gate-ladder syntheses vs the engine kernels.
+
+Reference model: qheader_alu.cl kernels + src/qinterface/arithmetic.cpp
+fallbacks, tested like test/tests.cpp's arithmetic cases."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.interface.alu import AluMixin, _range_to_cubes
+from qrack_tpu.utils.rng import QrackRandom
+
+from helpers import rand_state
+
+
+class SynthCPU(QEngineCPU):
+    """CPU engine with the universal gate-ladder ALU syntheses pinned back
+    in place of the engine's index-map kernels — tests that the
+    AluMixin defaults are themselves correct."""
+
+
+for _name in ["INC", "CINC", "INCDECC", "CINCDECC", "INCS", "INCDECSC",
+              "MULModNOut", "IMULModNOut", "CMULModNOut", "CIMULModNOut",
+              "PhaseFlipIfLess", "CPhaseFlipIfLess"]:
+    setattr(SynthCPU, _name, getattr(AluMixin, _name))
+
+
+def make(n, perm=0, cls=QEngineCPU):
+    q = cls(n, rand_global_phase=False, rng=QrackRandom(7))
+    q.SetPermutation(perm)
+    return q
+
+
+def basis_value(q, start, length):
+    """Read a classical register value from a basis-state engine."""
+    s = q.GetQuantumState()
+    i = int(np.argmax(np.abs(s)))
+    assert abs(s[i]) == pytest.approx(1.0, abs=1e-6)
+    return (i >> start) & ((1 << length) - 1), i
+
+
+@pytest.mark.parametrize("x,add", [(0, 1), (5, 3), (7, 1), (6, 7), (3, 0)])
+def test_inc_kernel_and_synthesis(x, add):
+    for cls in (QEngineCPU, SynthCPU):
+        q = make(4, x, cls)
+        q.INC(add, 0, 3)
+        v, _ = basis_value(q, 0, 3)
+        assert v == (x + add) % 8, cls.__name__
+
+
+def test_inc_superposition():
+    q = make(4)
+    psi = rand_state(4, 3)
+    q.SetQuantumState(psi)
+    q.INC(3, 0, 4)
+    expect = np.empty_like(psi)
+    for i in range(16):
+        expect[(i + 3) % 16] = psi[i]
+    np.testing.assert_allclose(q.GetQuantumState(), expect, atol=1e-10)
+
+
+def test_dec():
+    q = make(4, 2)
+    q.DEC(5, 0, 4)
+    v, _ = basis_value(q, 0, 4)
+    assert v == (2 - 5) % 16
+
+
+@pytest.mark.parametrize("ctrl_set", [False, True])
+def test_cinc(ctrl_set):
+    for cls in (QEngineCPU, SynthCPU):
+        q = make(5, (0b10000 if ctrl_set else 0) | 3, cls)
+        q.CINC(2, 0, 3, (4,))
+        v, _ = basis_value(q, 0, 3)
+        assert v == ((3 + 2) % 8 if ctrl_set else 3), cls.__name__
+
+
+@pytest.mark.parametrize("x,add,carry_in", [(6, 3, 0), (7, 1, 0), (2, 1, 1), (7, 7, 1)])
+def test_incdecc(x, add, carry_in):
+    for cls in (QEngineCPU, SynthCPU):
+        q = make(4, x | (carry_in << 3), cls)
+        q.INCDECC(add, 0, 3, 3)
+        ext = (x | (carry_in << 3)) & 0xF
+        expect = (ext + add) % 16
+        v, i = basis_value(q, 0, 3)
+        carry_out = (i >> 3) & 1
+        assert v == expect & 7 and carry_out == expect >> 3, cls.__name__
+
+
+def test_incc_semantics():
+    # carry-in consumed, carry-out produced (reference: src/qalu.cpp INCC)
+    q = make(4, 0b1111)  # reg=7, carry=1
+    q.INCC(0, 0, 3, 3)  # add 0 + carry 1 -> 0, carry cleared? 7+1=8 -> overflow sets carry
+    v, i = basis_value(q, 0, 3)
+    assert v == 0 and ((i >> 3) & 1) == 1
+
+
+@pytest.mark.parametrize("x,add", [(3, 1), (3, 2), (5, 6), (7, 7), (4, 4)])
+def test_incs_overflow(x, add):
+    # 3-bit signed: overflow iff signed sum leaves [-4, 3]
+    for cls in (QEngineCPU, SynthCPU):
+        q = make(4, x, cls)
+        q.INCS(add, 0, 3, 3)
+        v, i = basis_value(q, 0, 3)
+        sx = x - 8 if x >= 4 else x
+        sa = add - 8 if add >= 4 else add
+        overflow = not (-4 <= sx + sa <= 3)
+        assert v == (x + add) % 8, cls.__name__
+        assert ((i >> 3) & 1) == int(overflow), cls.__name__
+
+
+def test_rol_ror():
+    q = make(5, 0b01011)
+    q.ROL(2, 0, 5)
+    v, _ = basis_value(q, 0, 5)
+    assert v == 0b01101  # rotate left by 2 within 5 bits
+    q.ROR(2, 0, 5)
+    v, _ = basis_value(q, 0, 5)
+    assert v == 0b01011
+
+
+@pytest.mark.parametrize("x,mul", [(1, 3), (2, 3), (3, 5), (0, 7), (3, 2)])
+def test_mul_div(x, mul):
+    q = make(6, x)  # inOut [0,3), carry [3,6)
+    q.MUL(mul, 0, 3, 3)
+    v, i = basis_value(q, 0, 6)
+    assert v == (x * mul) & 63
+    q.DIV(mul, 0, 3, 3)
+    v, _ = basis_value(q, 0, 6)
+    assert v == x
+
+
+def test_cmul():
+    q = make(7, 0b1000000 | 3)  # control q6 set, x=3
+    q.CMUL(5, 0, 3, 3, (6,))
+    v, _ = basis_value(q, 0, 6)
+    assert v == 15
+    q2 = make(7, 3)  # control clear
+    q2.CMUL(5, 0, 3, 3, (6,))
+    v, _ = basis_value(q2, 0, 6)
+    assert v == 3
+
+
+@pytest.mark.parametrize("x,mul,mod", [(3, 5, 7), (6, 4, 7), (2, 3, 8), (5, 3, 6)])
+def test_mulmodnout(x, mul, mod):
+    n_out = 3
+    for cls in (QEngineCPU, SynthCPU):
+        q = make(7, x, cls)
+        q.MULModNOut(mul, mod, 0, 3, 3)
+        v, i = basis_value(q, 3, n_out)
+        assert v == (x * mul) % mod, cls.__name__
+        assert (i & 7) == x, cls.__name__  # input register preserved
+
+
+def test_imulmodnout_roundtrip():
+    for cls in (QEngineCPU, SynthCPU):
+        q = make(7, 5, cls)
+        q.MULModNOut(3, 7, 0, 3, 3)
+        q.IMULModNOut(3, 7, 0, 3, 3)
+        v, i = basis_value(q, 0, 7)
+        assert v == 5, cls.__name__
+
+
+def test_powmodnout():
+    q = make(7, 4)
+    q.POWModNOut(3, 7, 0, 3, 3)  # 3^4 mod 7 = 4
+    v, _ = basis_value(q, 3, 3)
+    assert v == 4
+
+
+def test_indexed_lda_adc_sbc():
+    # 2-bit index at [0,2), 3-bit value at [2,5), carry at 5
+    table = [1, 3, 5, 2]
+    q = make(6, 2)  # index=2
+    q.IndexedLDA(0, 2, 2, 3, table)
+    v, _ = basis_value(q, 2, 3)
+    assert v == 5
+    # ADC: add table[index] again with carry
+    q.IndexedADC(0, 2, 2, 3, 5, table)
+    v, i = basis_value(q, 2, 3)
+    assert v == (5 + 5) & 7 and ((i >> 5) & 1) == 1
+    # SBC back
+    q.IndexedSBC(0, 2, 2, 3, 5, table)
+    v, i = basis_value(q, 2, 3)
+    assert v == 5 and ((i >> 5) & 1) == 0
+
+
+def test_hash():
+    table = [2, 0, 3, 1]
+    q = make(3, 2)
+    q.Hash(0, 2, table)
+    v, _ = basis_value(q, 0, 2)
+    assert v == 3
+
+
+def test_phase_flip_if_less():
+    psi = rand_state(3, 9)
+    q = make(3)
+    q.SetQuantumState(psi)
+    q.PhaseFlipIfLess(3, 0, 3)
+    expect = psi.copy()
+    for i in range(8):
+        if i < 3:
+            expect[i] = -expect[i]
+    np.testing.assert_allclose(q.GetQuantumState(), expect, atol=1e-12)
+    # synthesis path must agree
+    q2 = make(3, cls=SynthCPU)
+    q2.SetQuantumState(psi)
+    q2.PhaseFlipIfLess(3, 0, 3)
+    np.testing.assert_allclose(q2.GetQuantumState(), expect, atol=1e-10)
+
+
+def test_cphase_flip_if_less():
+    psi = rand_state(4, 10)
+    q = make(4)
+    q.SetQuantumState(psi)
+    q.CPhaseFlipIfLess(2, 0, 3, 3)
+    expect = psi.copy()
+    for i in range(16):
+        if (i & 7) < 2 and (i >> 3) & 1:
+            expect[i] = -expect[i]
+    np.testing.assert_allclose(q.GetQuantumState(), expect, atol=1e-12)
+
+
+def test_full_adder_chain():
+    # ADC: input1 [0,2), input2 [2,4), output [4,6), carry 6
+    for a in (0, 1, 2, 3):
+        for b in (0, 2, 3):
+            q = make(7, a | (b << 2))
+            q.ADC(0, 2, 4, 2, 6)
+            s = q.GetQuantumState()
+            i = int(np.argmax(np.abs(s)))
+            total = ((i >> 4) & 3) | (((i >> 6) & 1) << 2)
+            assert total == a + b, (a, b, total)
+
+
+def test_range_to_cubes():
+    for lo, hi, ln in [(0, 5, 3), (3, 8, 3), (1, 7, 3), (0, 8, 3), (5, 6, 3)]:
+        cubes = _range_to_cubes(lo, hi, ln)
+        covered = sorted(v for (k, m) in cubes for v in range(m << k, (m + 1) << k))
+        assert covered == list(range(lo, hi))
+
+
+def test_incc_unmasked_carry_contribution():
+    # regression: 2 + 7 + carry_in(1) = 10 -> reg 2, carry_out 1
+    q = make(4, 2 | (1 << 3))
+    q.INCC(7, 0, 3, 3)
+    v, i = basis_value(q, 0, 3)
+    assert v == 2 and ((i >> 3) & 1) == 1
+
+
+def test_decc_zero_subtrahend_keeps_carry():
+    # regression: 5 - 0 with carry-in set -> reg 5, carry still set
+    q = make(4, 5 | (1 << 3))
+    q.DECC(0, 0, 3, 3)
+    v, i = basis_value(q, 0, 3)
+    assert v == 5 and ((i >> 3) & 1) == 1
+
+
+def test_indexed_lda_resets_value_register():
+    # regression: value register pre-loaded with junk must be cleared
+    table = [1, 3, 5, 2]
+    q = make(6, 2 | (3 << 2))  # index=2, value=3 (junk)
+    q.IndexedLDA(0, 2, 2, 3, table)
+    v, _ = basis_value(q, 2, 3)
+    assert v == 5
