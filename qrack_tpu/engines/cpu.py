@@ -25,11 +25,7 @@ class QEngineCPU(QEngine):
 
     def __init__(self, qubit_count: int, init_state: int = 0, dtype=np.complex128, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
-        if qubit_count > self.config.max_cpu_qubits:
-            raise MemoryError(
-                f"QEngineCPU width {qubit_count} exceeds QRACK_MAX_CPU_QB="
-                f"{self.config.max_cpu_qubits}"
-            )
+        self._check_capacity(qubit_count)
         self.dtype = np.dtype(dtype)
         self._state = np.zeros(1 << qubit_count, dtype=self.dtype)
         self.SetPermutation(init_state)
@@ -38,6 +34,13 @@ class QEngineCPU(QEngine):
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------
+
+    def _check_capacity(self, qubit_count: int) -> None:
+        if qubit_count > self.config.max_cpu_qubits:
+            raise MemoryError(
+                f"QEngineCPU width {qubit_count} exceeds QRACK_MAX_CPU_QB="
+                f"{self.config.max_cpu_qubits}"
+            )
 
     @property
     def _idx(self) -> np.ndarray:
@@ -103,8 +106,14 @@ class QEngineCPU(QEngine):
         new[dst_idx] = self._state[src_idx]
         self._state = new
 
-    def _k_diag_fn(self, fn) -> None:
-        self._state = fn(np, self._idx, self._state).astype(self.dtype, copy=False)
+    def _k_phase_fn(self, fn) -> None:
+        fre, fim = fn(np, self._idx)
+        if np.isscalar(fim) and fim == 0.0:
+            # pure-real factor (Z/phase flips): skip the complex promote
+            self._state = (self._state * fre).astype(self.dtype, copy=False)
+        else:
+            self._state = (self._state * (np.asarray(fre) + 1j * np.asarray(fim))).astype(
+                self.dtype, copy=False)
 
     def _k_probs(self) -> np.ndarray:
         return (self._state.real.astype(np.float64) ** 2

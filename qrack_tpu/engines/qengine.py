@@ -15,7 +15,8 @@ include/common/oclapi.hpp:19-99):
   _k_apply_diag(d0, d1, target, controls, perm) phase fast path (phase/z)
   _k_gather(src_idx)                            basis permutation (ALU, xmask, rol)
   _k_out_of_place(src, dst, passthrough)        mul/div/*modnout scatter
-  _k_diag_fn(fn, *args)                         diagonal multiply (phaseflips, parity rz)
+  _k_phase_fn(fn)                               diagonal complex factor:
+                                                fn(xp, idx) -> (re, im)
   _k_probs()                                    |amp|^2 vector (host numpy)
   _k_prob_mask(mask, perm)                      masked-probability reduce
   _k_collapse(mask, val, nrm_sq)                projective collapse (applym/applymreg)
@@ -76,30 +77,32 @@ class QEngine(QInterface):
         if not mask:
             return
 
-        def fn(xp, idx, state):
+        def fn(xp, idx):
             par = self._parity_of(xp, idx, mask)
-            return xp.where(par == 1, -state, state)
+            return xp.where(par == 1, -1.0, 1.0), 0.0
 
-        self._k_diag_fn(fn)
+        self._k_phase_fn(fn)
 
     @staticmethod
     def _parity_of(xp, idx, mask):
         v = idx & mask
-        # O(log n) parity fold (works for numpy and jax int64)
+        # O(log n) parity fold; skip shifts >= the index dtype width
+        width = v.dtype.itemsize * 8 if hasattr(v, "dtype") else 64
         for s in (32, 16, 8, 4, 2, 1):
-            v = v ^ (v >> s)
+            if s < width:
+                v = v ^ (v >> s)
         return v & 1
 
     def PhaseParity(self, radians: float, mask: int) -> None:
         if not mask:
             return
-        half = complex(math.cos(radians / 2), math.sin(radians / 2))
+        c, s_ = math.cos(radians / 2), math.sin(radians / 2)
 
-        def fn(xp, idx, state):
+        def fn(xp, idx):
             par = self._parity_of(xp, idx, mask)
-            return state * xp.where(par == 1, half, np.conj(half))
+            return c, xp.where(par == 1, s_, -s_)
 
-        self._k_diag_fn(fn)
+        self._k_phase_fn(fn)
 
     def Swap(self, q1: int, q2: int) -> None:
         if q1 == q2:
@@ -163,12 +166,13 @@ class QEngine(QInterface):
         if nrm_sq <= 0.0:
             raise RuntimeError("ForceMParity: forced result has zero probability")
         want = 1 if result else 0
+        scale = 1.0 / math.sqrt(nrm_sq)
 
-        def fn(xp, idx, state):
+        def fn(xp, idx):
             par = self._parity_of(xp, idx, mask)
-            return xp.where(par == want, state / math.sqrt(nrm_sq), xp.zeros_like(state))
+            return xp.where(par == want, scale, 0.0), 0.0
 
-        self._k_diag_fn(fn)
+        self._k_phase_fn(fn)
         return bool(result)
 
     def MAll(self) -> int:
@@ -395,47 +399,46 @@ class QEngine(QInterface):
         self._k_gather(lambda idx: alu.hash_src(self._xp, idx, start, length, inv_dev))
 
     def PhaseFlipIfLess(self, greater_perm: int, start: int, length: int) -> None:
-        self._k_diag_fn(
-            lambda xp, idx, state: alu.phase_flip_if_less(
-                xp, idx, state, greater_perm, start, length
-            )
+        self._k_phase_fn(
+            lambda xp, idx: (alu.phase_flip_less_factor(
+                xp, idx, greater_perm, start, length), 0.0)
         )
 
     def CPhaseFlipIfLess(self, greater_perm: int, start: int, length: int, flag_index: int) -> None:
-        self._k_diag_fn(
-            lambda xp, idx, state: alu.phase_flip_if_less(
-                xp, idx, state, greater_perm, start, length, flag_index
-            )
+        self._k_phase_fn(
+            lambda xp, idx: (alu.phase_flip_less_factor(
+                xp, idx, greater_perm, start, length, flag_index), 0.0)
         )
 
     def PhaseFlip(self) -> None:
-        self._k_diag_fn(lambda xp, idx, state: -state)
+        self._k_phase_fn(lambda xp, idx: (-1.0, 0.0))
 
     def UniformParityRZ(self, mask: int, angle: float) -> None:
-        ph = complex(math.cos(angle), math.sin(angle))
+        c, s_ = math.cos(angle), math.sin(angle)
 
-        def fn(xp, idx, state):
+        def fn(xp, idx):
             par = self._parity_of(xp, idx, mask)
-            return state * xp.where(par == 1, ph, np.conj(ph))
+            return c, xp.where(par == 1, s_, -s_)
 
-        self._k_diag_fn(fn)
+        self._k_phase_fn(fn)
 
     def CUniformParityRZ(self, controls, mask: int, angle: float) -> None:
         controls = tuple(controls)
         if not controls:
             return self.UniformParityRZ(mask, angle)
-        ph = complex(math.cos(angle), math.sin(angle))
+        c, s_ = math.cos(angle), math.sin(angle)
         cmask = 0
-        for c in controls:
-            cmask |= 1 << c
+        for ctl in controls:
+            cmask |= 1 << ctl
 
-        def fn(xp, idx, state):
+        def fn(xp, idx):
             par = self._parity_of(xp, idx, mask)
-            phase = xp.where(par == 1, ph, np.conj(ph))
             active = (idx & cmask) == cmask
-            return state * xp.where(active, phase, xp.ones_like(phase))
+            fre = xp.where(active, c, 1.0)
+            fim = xp.where(active, xp.where(par == 1, s_, -s_), 0.0)
+            return fre, fim
 
-        self._k_diag_fn(fn)
+        self._k_phase_fn(fn)
 
     # ------------------------------------------------------------------
     # structure ops
@@ -444,6 +447,7 @@ class QEngine(QInterface):
     def Compose(self, other, start: Optional[int] = None) -> int:
         if start is None:
             start = self.qubit_count
+        self._check_capacity(self.qubit_count + other.qubit_count)
         self._k_compose(other, start)
         self.qubit_count += other.qubit_count
         return start
@@ -463,9 +467,14 @@ class QEngine(QInterface):
     def Allocate(self, start: int, length: int = 1) -> int:
         if length == 0:
             return start
+        self._check_capacity(self.qubit_count + length)
         self._k_allocate(start, length)
         self.qubit_count += length
         return start
+
+    def _check_capacity(self, qubit_count: int) -> None:
+        """Growth guard (reference: allocation guards, oclengine.cpp:388);
+        engines override with their width ceilings."""
 
     # ------------------------------------------------------------------
     # norm bookkeeping (reference: include/qengine.hpp:100-152)
@@ -504,7 +513,8 @@ class QEngine(QInterface):
     def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:
         raise NotImplementedError
 
-    def _k_diag_fn(self, fn) -> None:
+    def _k_phase_fn(self, fn) -> None:
+        """Apply a per-index complex factor: fn(xp, idx) -> (re, im)."""
         raise NotImplementedError
 
     def _k_probs(self) -> np.ndarray:

@@ -1,0 +1,331 @@
+"""QEngineTPU: dense state vector in TPU HBM as split real/imag planes.
+
+The TPU-native successor of the reference's GPU engines (reference:
+include/qengine_opencl.hpp:168 QEngineOCL / qengine_cuda.hpp). Design
+mapping (SURVEY.md §7 step 4):
+
+  * The reference's QueueItem chain + event callbacks (opencl.cpp:412)
+    become JAX async dispatch: every void gate op returns immediately,
+    device work is ordered by data dependence, and only non-void ops
+    (Prob/M/amplitude reads) synchronize — the reference's
+    clFinish-on-read discipline (opencl.cpp:329).
+  * The 8 apply2x2 kernel variants (opencl.cpp:810-1016) collapse into
+    three jitted XLA program families (generic/diagonal/invert) whose
+    compile-cache keys are (width, target axis) only — control
+    placement, control count, and matrix values are dynamic operands.
+  * Amplitudes are (2, 2^n) float32 planes (TPUs have no complex ALU;
+    see ops/gatekernels.py). bf16 storage is a dtype switch.
+  * Buffers are donated back to XLA on every gate, so the ket updates
+    in place in HBM like the reference's persistent stateBuffer.
+  * The OpenCL binary-kernel cache (oclengine.cpp:150-202) is XLA's
+    own compilation cache.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import gatekernels as gk
+from .qengine import QEngine
+from .. import matrices as mat
+
+
+# ---------------------------------------------------------------------------
+# module-level jitted programs, shared by every engine instance
+# ---------------------------------------------------------------------------
+
+_j_apply_2x2 = jax.jit(gk.apply_2x2, static_argnums=(2, 3), donate_argnums=(0,))
+_j_apply_diag = jax.jit(gk.apply_diag, static_argnums=(5,), donate_argnums=(0,))
+_j_apply_invert = jax.jit(gk.apply_invert, static_argnums=(5, 6), donate_argnums=(0,))
+_j_apply_4x4 = jax.jit(gk.apply_4x4, static_argnums=(2, 3, 4), donate_argnums=(0,))
+_j_swap_bits = jax.jit(gk.swap_bits, static_argnums=(1, 2, 3), donate_argnums=(0,))
+_j_gather = jax.jit(gk.gather, donate_argnums=(0,))
+_j_phase_apply = jax.jit(gk.phase_factor_apply, donate_argnums=(0,))
+_j_prob_mask = jax.jit(gk.prob_mask_sum)
+_j_collapse = jax.jit(gk.collapse, donate_argnums=(0,))
+_j_normalize = jax.jit(gk.normalize, donate_argnums=(0,))
+_j_probs = jax.jit(gk.probs)
+_j_sum_sqr_diff = jax.jit(gk.sum_sqr_diff)
+_j_sample = jax.jit(gk.sample)
+_j_uc_2x2 = jax.jit(gk.uc_2x2, static_argnums=(2, 3, 4), donate_argnums=(0,))
+
+
+class QEngineTPU(QEngine):
+    """Dense ket on one accelerator device (TPU; CPU backend in tests)."""
+
+    _xp = jnp
+
+    def __init__(self, qubit_count: int, init_state: int = 0, dtype=jnp.float32,
+                 device_id: int = -1, **kwargs):
+        super().__init__(qubit_count, init_state=init_state, **kwargs)
+        self._check_capacity(qubit_count)
+        self.dtype = jnp.dtype(dtype)  # plane dtype (float32 / bfloat16)
+        self._device = jax.devices()[device_id] if device_id >= 0 else None
+        self._device_id = device_id
+        self._state = None  # (2, 2^n) planes
+        self.SetPermutation(init_state)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _check_capacity(self, qubit_count: int) -> None:
+        # int32 index math and one-chip HBM both cap a dense shard at 30
+        # qubits; Compose/Allocate growth funnels through this too.
+        if qubit_count > 30:
+            raise MemoryError(
+                f"QEngineTPU width {qubit_count} exceeds a single dense shard; "
+                "use the QPager/QUnit layers above this engine"
+            )
+
+    def _put(self, arr):
+        return jax.device_put(arr, self._device) if self._device is not None else jnp.asarray(arr)
+
+    def _rand_phase(self) -> complex:
+        if self.rand_global_phase:
+            ang = 2.0 * math.pi * self.Rand()
+            return complex(math.cos(ang), math.sin(ang))
+        return 1.0 + 0.0j
+
+    @staticmethod
+    def _cmask_cval(controls, perm):
+        from ..utils.bits import control_offset
+
+        cmask = 0
+        for c in controls:
+            cmask |= 1 << c
+        return cmask, control_offset(controls, perm)
+
+    # ------------------------------------------------------------------
+    # kernel contract
+    # ------------------------------------------------------------------
+
+    def _k_apply_2x2(self, m2, target, controls, perm) -> None:
+        cmask, cval = self._cmask_cval(controls, perm)
+        if mat.is_invert(m2):
+            tr, bl = m2[0, 1], m2[1, 0]
+            self._state = _j_apply_invert(
+                self._state, float(tr.real), float(tr.imag),
+                float(bl.real), float(bl.imag),
+                self.qubit_count, target, cmask, cval,
+            )
+        else:
+            mp = gk.mtrx_planes(m2, self.dtype)
+            self._state = _j_apply_2x2(self._state, mp, self.qubit_count, target, cmask, cval)
+
+    def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
+        cmask, cval = self._cmask_cval(controls, perm)
+        d0, d1 = complex(d0), complex(d1)
+        self._state = _j_apply_diag(
+            self._state, d0.real, d0.imag, d1.real, d1.imag,
+            self.qubit_count, 1 << target, cmask, cval,
+        )
+
+    def _k_apply_4x4(self, m4, q1, q2) -> None:
+        mp = gk.mtrx_planes(m4, self.dtype)
+        self._state = _j_apply_4x4(self._state, mp, self.qubit_count, q1, q2)
+
+    def UCMtrx(self, controls, mtrxs, target, mtrx_skip_powers=(), mtrx_skip_value_mask=0) -> None:
+        """Uniformly-controlled gate in one fused kernel (reference kernel
+        uniformlycontrolled, qengine.cl:409)."""
+        if mtrx_skip_powers:
+            return super().UCMtrx(controls, mtrxs, target, mtrx_skip_powers, mtrx_skip_value_mask)
+        stack = np.stack([np.asarray(m, dtype=np.complex128).reshape(2, 2) for m in mtrxs])
+        mps = jnp.stack([
+            jnp.asarray(stack.real, dtype=self.dtype),
+            jnp.asarray(stack.imag, dtype=self.dtype),
+        ])
+        self._state = _j_uc_2x2(self._state, mps, self.qubit_count, target, tuple(controls))
+
+    def _k_gather(self, src_fn) -> None:
+        src = src_fn(gk.iota_for(self._state))
+        self._state = _j_gather(self._state, src)
+
+    def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:
+        src_idx = jnp.asarray(src_idx, dtype=gk.IDX_DTYPE)
+        dst_idx = jnp.asarray(dst_idx, dtype=gk.IDX_DTYPE)
+        new = jnp.zeros_like(self._state)
+        if passthrough_cmask is not None:
+            idx = gk.iota_for(self._state)
+            keep = (idx & passthrough_cmask) != passthrough_cmask
+            new = jnp.where(keep, self._state, new)
+        new = new.at[:, dst_idx].set(self._state[:, src_idx])
+        self._state = new
+
+    def _k_phase_fn(self, fn) -> None:
+        fre, fim = fn(jnp, gk.iota_for(self._state))
+        self._state = _j_phase_apply(self._state, fre, fim)
+
+    def _k_probs(self) -> np.ndarray:
+        return np.asarray(_j_probs(self._state), dtype=np.float64)
+
+    def _k_prob_mask(self, mask, perm) -> float:
+        p = float(_j_prob_mask(self._state, mask, perm))
+        return min(max(p, 0.0), 1.0)
+
+    def _k_collapse(self, mask, val, nrm_sq) -> None:
+        self._state = _j_collapse(self._state, mask, val, nrm_sq)
+
+    def MAll(self) -> int:
+        """Device-side categorical sample; no 2^n host transfer
+        (reference MAll ships probabilities to host)."""
+        result = int(_j_sample(self._state, float(self.Rand())))
+        self.SetPermutation(result)
+        return result
+
+    def MultiShotMeasureMask(self, q_powers, shots: int) -> dict:
+        from ..utils.bits import log2
+
+        u = jnp.asarray(self.rng.uniform(shots), dtype=self.dtype)
+        p = gk.probs(self._state)
+        cdf = jnp.cumsum(p)
+        draws = np.asarray(jnp.searchsorted(cdf, u * cdf[-1], side="right"))
+        bits = [log2(int(pw)) for pw in q_powers]
+        out: dict = {}
+        for d in draws:
+            d = int(min(d, self._state.shape[-1] - 1))
+            key = 0
+            for j, b in enumerate(bits):
+                if (d >> b) & 1:
+                    key |= 1 << j
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    def _k_compose(self, other, start) -> None:
+        other_planes = gk.to_planes(other.GetQuantumState(), self.dtype)
+        self._state = gk.compose(
+            self._state, other_planes, self.qubit_count, other.qubit_count, start
+        )
+
+    def _k_decompose(self, start, length) -> np.ndarray:
+        m = gk.split_matrix(self._state, self.qubit_count, start, length)
+        row_norms = jnp.sum(m[0] ** 2 + m[1] ** 2, axis=1)
+        r0 = int(jnp.argmax(row_norms))
+        nrm = jnp.sqrt(row_norms[r0])
+        dest = m[:, r0, :] / nrm  # (2, 2^L)
+        # rem = M @ conj(dest): plane algebra
+        rem_re = m[0] @ dest[0] + m[1] @ dest[1]
+        rem_im = m[1] @ dest[0] - m[0] @ dest[1]
+        rem = jnp.stack([rem_re, rem_im])
+        rn = jnp.sqrt(jnp.sum(rem[0] ** 2 + rem[1] ** 2))
+        self._state = jnp.where(rn > 0, rem / rn, rem)
+        return gk.from_planes(dest)
+
+    def _k_dispose(self, start, length, perm) -> None:
+        m = gk.split_matrix(self._state, self.qubit_count, start, length)
+        if perm is not None:
+            rem = m[:, :, perm]
+        else:
+            row_norms = jnp.sum(m[0] ** 2 + m[1] ** 2, axis=1)
+            r0 = int(jnp.argmax(row_norms))
+            dest = m[:, r0, :] / jnp.sqrt(row_norms[r0])
+            rem_re = m[0] @ dest[0] + m[1] @ dest[1]
+            rem_im = m[1] @ dest[0] - m[0] @ dest[1]
+            rem = jnp.stack([rem_re, rem_im])
+        rn = jnp.sqrt(jnp.sum(rem[0] ** 2 + rem[1] ** 2))
+        self._state = jnp.where(rn > 0, rem / rn, rem)
+
+    def _k_allocate(self, start, length) -> None:
+        self._state = gk.allocate(self._state, self.qubit_count, start, length)
+
+    def _k_normalize(self, nrm_sq) -> None:
+        self._state = _j_normalize(self._state, nrm_sq)
+
+    def _k_sum_sqr_diff(self, other) -> float:
+        if isinstance(other, QEngineTPU):
+            b = other._state.astype(self.dtype)
+        else:
+            b = gk.to_planes(other.GetQuantumState(), self.dtype)
+        return float(_j_sum_sqr_diff(self._state, b))
+
+    def _k_swap_bits(self, q1, q2) -> None:
+        self._state = _j_swap_bits(self._state, self.qubit_count, q1, q2)
+
+    def ExpectationBitsAll(self, bits, offset: int = 0) -> float:
+        """One device reduction; the distribution never reaches the host."""
+        return float(gk.expectation_bits(self._state, tuple(bits), offset))
+
+    # ------------------------------------------------------------------
+    # state access (host boundary: complex <-> planes)
+    # ------------------------------------------------------------------
+
+    def GetQuantumState(self) -> np.ndarray:
+        return gk.from_planes(self._state)
+
+    def SetQuantumState(self, state) -> None:
+        st = np.asarray(state).reshape(-1)
+        if st.shape[0] != (1 << self.qubit_count):
+            raise ValueError("state length mismatch")
+        self._state = self._put(gk.to_planes(st, self.dtype))
+
+    def GetAmplitude(self, perm: int) -> complex:
+        amp = np.asarray(self._state[:, perm], dtype=np.float64)
+        return complex(amp[0], amp[1])
+
+    def SetAmplitude(self, perm: int, amp: complex) -> None:
+        amp = complex(amp)
+        self._state = self._state.at[:, perm].set(
+            jnp.asarray([amp.real, amp.imag], dtype=self.dtype)
+        )
+
+    def SetPermutation(self, perm: int, phase=None) -> None:
+        ph = self._rand_phase() if phase is None else complex(phase)
+        st = jnp.zeros((2, 1 << self.qubit_count), dtype=self.dtype)
+        st = st.at[:, perm].set(jnp.asarray([ph.real, ph.imag], dtype=self.dtype))
+        self._state = self._put(st)
+        self.running_norm = 1.0
+
+    def Clone(self) -> "QEngineTPU":
+        c = QEngineTPU(
+            self.qubit_count, dtype=self.dtype, device_id=self._device_id,
+            rng=self.rng.spawn(), do_normalize=self.do_normalize,
+            rand_global_phase=self.rand_global_phase,
+        )
+        c._state = jnp.array(self._state, copy=True)
+        return c
+
+    def CloneEmpty(self) -> "QEngineTPU":
+        return QEngineTPU(
+            self.qubit_count, dtype=self.dtype, device_id=self._device_id,
+            rng=self.rng.spawn(), do_normalize=self.do_normalize,
+            rand_global_phase=self.rand_global_phase,
+        )
+
+    # -- async discipline (reference: DispatchQueue / clFinish) --
+
+    def Finish(self) -> None:
+        if self._state is not None:
+            self._state.block_until_ready()
+
+    # -- device placement (reference: SetDevice, opencl.cpp:535) --
+
+    def SetDevice(self, device_id: int) -> None:
+        if device_id == self._device_id:
+            return
+        self._device = jax.devices()[device_id] if device_id >= 0 else None
+        self._device_id = device_id
+        self._state = self._put(self._state)
+
+    def GetDevice(self) -> int:
+        return self._device_id
+
+    # -- cross-engine data plane --
+
+    def ZeroAmplitudes(self) -> None:
+        self._state = jnp.zeros_like(self._state)
+
+    def IsZeroAmplitude(self) -> bool:
+        return not bool(jnp.any(self._state != 0))
+
+    def GetAmplitudePage(self, offset: int, length: int) -> np.ndarray:
+        return gk.from_planes(self._state[:, offset:offset + length])
+
+    def SetAmplitudePage(self, page, offset: int) -> None:
+        self._state = self._state.at[:, offset:offset + len(page)].set(
+            gk.to_planes(page, self.dtype)
+        )

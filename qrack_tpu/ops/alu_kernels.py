@@ -189,12 +189,12 @@ def indexed_adc_src(xp, idx, index_start, index_length, value_start, value_lengt
     return (src & ~(1 << carry_index)) | (src_c << carry_index)
 
 
-def phase_flip_if_less(xp, idx, state, greater_perm, start, length, flag_index=None):
-    """(C)PhaseFlipIfLess: -1 phase where reg < greater_perm (and flag set)
-    (reference kernels cphaseflipifless/phaseflipifless,
-    qheader_alu.cl:780-810)."""
+def phase_flip_less_factor(xp, idx, greater_perm, start, length, flag_index=None):
+    """(C)PhaseFlipIfLess real factor: -1 where reg < greater_perm (and
+    flag set), else +1 (reference kernels cphaseflipifless/
+    phaseflipifless, qheader_alu.cl:780-810)."""
     v = _reg_get(xp, idx, start, length)
     cond = v < greater_perm
     if flag_index is not None:
         cond = cond & (((idx >> flag_index) & 1) == 1)
-    return xp.where(cond, -state, state)
+    return xp.where(cond, -1.0, 1.0)

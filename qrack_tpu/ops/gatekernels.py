@@ -1,0 +1,284 @@
+"""Pure-function XLA gate kernels over a dense state vector.
+
+TPU-native replacement for the reference GPU kernel set (reference:
+src/common/qengine.cl:144-1085 apply2x2*/x/z/phase/invert/compose/
+decompose/prob*/nrmlze/applym; enumerated include/common/oclapi.hpp).
+
+Representation: **split real/imag planes** — the ket is a real array of
+shape (2, 2^n), plane 0 = Re, plane 1 = Im. TPUs have no complex ALU
+(and this environment's TPU platform rejects complex dtypes outright),
+so complex arithmetic is written out as plane algebra. This also makes
+bf16 amplitude storage a dtype switch rather than a redesign.
+
+Design rules (see SURVEY.md §7):
+  * A gate is reshape → einsum → reshape: the target "bit" becomes a
+    tensor axis, and the complex 2x2 becomes a real 4x4 plane-mixing
+    contraction XLA maps onto the VPU/MXU. No gathers in the hot path.
+  * Controls are dynamic (cmask, cval) scalar operands folded in with a
+    `where` select, so the jit cache is keyed only on (n, target axis) —
+    the reference's 8 apply2x2 kernel variants (opencl.cpp:810-1016)
+    collapse into three XLA program families.
+  * Every function is pure and trace-safe: usable eagerly, under
+    per-gate jit, inside a whole-circuit jit, and inside shard_map.
+
+Index convention: qubit q is bit q of the flat index; axis split for
+target t is (high = 2^(n-1-t), 2, low = 2^t).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Flat indices are int32: a single dense shard beyond 2^31 amplitudes
+# (31 qubits, 16 GiB at float32 planes) exceeds one chip's HBM; wider
+# registers live above the pager/QUnit layers, where index math is
+# host-side Python int (arbitrary precision).
+IDX_DTYPE = jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# plane representation helpers
+# ---------------------------------------------------------------------------
+
+def to_planes(state_complex, dtype=jnp.float32):
+    """Host complex vector -> (2, N) real planes."""
+    arr = np.asarray(state_complex)
+    return jnp.stack([jnp.asarray(arr.real, dtype=dtype), jnp.asarray(arr.imag, dtype=dtype)])
+
+def from_planes(planes) -> np.ndarray:
+    """(2, N) real planes -> host complex128 vector."""
+    host = np.asarray(planes, dtype=np.float64)
+    return host[0] + 1j * host[1]
+
+def mtrx_planes(m, dtype=jnp.float32):
+    """Host complex (d, d) matrix -> (2, d, d) real planes."""
+    m = np.asarray(m)
+    return jnp.stack([jnp.asarray(m.real, dtype=dtype), jnp.asarray(m.imag, dtype=dtype)])
+
+def _mix(mp):
+    """(2, d, d) matrix planes -> (2, d, 2, d) real mixing tensor M with
+    out[P, A] = sum_{p, a} M[P, A, p, a] * v[p, a], implementing complex
+    multiply: Re' = Re·re - Im·im ; Im' = Re·im + Im·re."""
+    re, im = mp[0], mp[1]
+    row0 = jnp.stack([re, -im], axis=1)  # [d, 2, d]
+    row1 = jnp.stack([im, re], axis=1)
+    return jnp.stack([row0, row1])  # [2, d, 2, d]
+
+def iota_for(planes):
+    return jax.lax.iota(IDX_DTYPE, planes.shape[-1])
+
+def cmul(fre, fim, v):
+    """Multiply planes v=(2,N) by a complex factor given as (re, im)
+    arrays/scalars broadcastable over N."""
+    return jnp.stack([v[0] * fre - v[1] * fim, v[0] * fim + v[1] * fre])
+
+
+# ---------------------------------------------------------------------------
+# gate kernels
+# ---------------------------------------------------------------------------
+
+def _ctrl_select(new, old, cmask, cval):
+    idx = iota_for(new)
+    keep = (idx & cmask) == cval
+    return jnp.where(keep, new, old)
+
+
+def apply_2x2(planes, mp, n: int, target: int, cmask=0, cval=0):
+    """Generic (optionally controlled) single-qubit gate
+    (reference kernels apply2x2/apply2x2single/..., qengine.cl:144-244)."""
+    high = 1 << (n - 1 - target)
+    low = 1 << target
+    v = planes.reshape(2, high, 2, low)
+    out = jnp.einsum("PApa,phal->PhAl", _mix(mp), v).reshape(2, -1)
+    if isinstance(cmask, int) and cmask == 0:
+        return out
+    return _ctrl_select(out, planes, cmask, cval)
+
+
+def apply_diag(planes, d0re, d0im, d1re, d1im, n: int, tmask, cmask=0, cval=0):
+    """Diagonal (phase) gate with dynamic target/control masks — one XLA
+    program per width n (reference kernels phasesingle/zsingle/...,
+    qengine.cl:247-340)."""
+    idx = iota_for(planes)
+    bit = (idx & tmask) != 0
+    fre = jnp.where(bit, d1re, d0re)
+    fim = jnp.where(bit, d1im, d0im)
+    active = (idx & cmask) == cval
+    one = jnp.ones((), planes.dtype)
+    zero = jnp.zeros((), planes.dtype)
+    fre = jnp.where(active, fre, one)
+    fim = jnp.where(active, fim, zero)
+    return cmul(fre, fim, planes)
+
+
+def apply_invert(planes, tr_re, tr_im, bl_re, bl_im, n: int, target: int, cmask=0, cval=0):
+    """Anti-diagonal gate: bit-flip + per-half phases (reference kernels
+    xsingle/invertsingle, qengine.cl:247-290)."""
+    high = 1 << (n - 1 - target)
+    low = 1 << target
+    v = planes.reshape(2, high, 2, low)
+    flipped = jnp.flip(v, axis=2).reshape(2, -1)
+    idx = iota_for(planes)
+    bit = ((idx >> target) & 1) == 1
+    fre = jnp.where(bit, bl_re, tr_re)
+    fim = jnp.where(bit, bl_im, tr_im)
+    out = cmul(fre, fim, flipped)
+    if isinstance(cmask, int) and cmask == 0:
+        return out
+    return _ctrl_select(out, planes, cmask, cval)
+
+
+def apply_4x4(planes, mp4, n: int, q1: int, q2: int):
+    """Arbitrary two-qubit gate as one plane-mixing contraction (the
+    reference decomposes instead; natively batched here)."""
+    lo, hi = (q1, q2) if q1 < q2 else (q2, q1)
+    h = 1 << (n - 1 - hi)
+    m = 1 << (hi - lo - 1)
+    l = 1 << lo
+    v = planes.reshape(2, h, 2, m, 2, l)
+    mix = _mix(mp4)  # [2, 4, 2, 4]
+    mix = mix.reshape(2, 2, 2, 2, 2, 2)  # [P, B2, B1, p, b2, b1]
+    if q1 < q2:
+        out = jnp.einsum("PABpab,phambl->PhAmBl", mix, v)
+    else:
+        out = jnp.einsum("PBApba,phambl->PhAmBl", mix, v)
+    return out.reshape(2, -1)
+
+
+def uc_2x2(planes, mps, n: int, target: int, controls):
+    """Uniformly-controlled gate: per-control-permutation payloads
+    (reference kernel uniformlycontrolled, qengine.cl:409).
+    mps: (2, 2^k, 2, 2) matrix planes."""
+    idx = iota_for(planes)
+    key = jnp.zeros_like(idx)
+    for j, c in enumerate(controls):
+        key = key | (((idx >> c) & 1) << j)
+    bit = (idx >> target) & 1
+    partner = idx ^ (1 << target)
+    ps = planes[:, partner]
+    re, im = mps[0], mps[1]  # [2^k, 2, 2]
+    d_re = jnp.where(bit == 0, re[key, 0, 0], re[key, 1, 1])
+    d_im = jnp.where(bit == 0, im[key, 0, 0], im[key, 1, 1])
+    o_re = jnp.where(bit == 0, re[key, 0, 1], re[key, 1, 0])
+    o_im = jnp.where(bit == 0, im[key, 0, 1], im[key, 1, 0])
+    return cmul(d_re, d_im, planes) + cmul(o_re, o_im, ps)
+
+
+def phase_factor_apply(planes, fre, fim):
+    """Multiply by an arbitrary per-index complex factor (diagonal ops:
+    parity rz, phase flips — reference kernels uniformparityrz/
+    phaseparity/phaseflipifless)."""
+    return cmul(fre, fim, planes)
+
+
+def swap_bits(planes, n: int, q1: int, q2: int):
+    """Swap two qubits as a pure axis transpose — zero-FLOP relabel
+    (the reference pays 3 CNOT kernels)."""
+    lo, hi = (q1, q2) if q1 < q2 else (q2, q1)
+    h = 1 << (n - 1 - hi)
+    m = 1 << (hi - lo - 1)
+    l = 1 << lo
+    v = planes.reshape(2, h, 2, m, 2, l)
+    return jnp.swapaxes(v, 2, 4).reshape(2, -1)
+
+
+def gather(planes, src_idx):
+    """Basis permutation (ALU family, reference qheader_alu.cl)."""
+    return planes[:, src_idx]
+
+
+def prob_mask_sum(planes, mask, val):
+    """Masked probability reduction (reference kernels probmask/probreg,
+    qengine.cl:704-948)."""
+    idx = iota_for(planes)
+    p = planes[0] ** 2 + planes[1] ** 2
+    return jnp.sum(jnp.where((idx & mask) == val, p, 0.0))
+
+
+def collapse(planes, mask, val, nrm_sq):
+    """Projective collapse + renorm (reference kernels applym/applymreg,
+    qengine.cl:1013-1045)."""
+    idx = iota_for(planes)
+    keep = (idx & mask) == val
+    scale = (1.0 / jnp.sqrt(nrm_sq)).astype(planes.dtype)
+    return jnp.where(keep, planes * scale, jnp.zeros((), planes.dtype))
+
+
+def normalize(planes, nrm_sq):
+    return planes * (1.0 / jnp.sqrt(nrm_sq)).astype(planes.dtype)
+
+
+def probs(planes):
+    return planes[0] ** 2 + planes[1] ** 2
+
+
+def sum_sqr_diff(a, b):
+    """1 - |<a|b>|^2 from planes (reference: approxcompare kernel)."""
+    re = jnp.sum(a[0] * b[0] + a[1] * b[1])
+    im = jnp.sum(a[0] * b[1] - a[1] * b[0])
+    return jnp.maximum(0.0, 1.0 - (re * re + im * im))
+
+
+def expectation_bits(planes, bits, offset: int = 0):
+    """<integer value of bits> via per-bit marginal reductions (reference:
+    expperm kernel, qengine.cl:930). Summing 2^j * P(bit_j) keeps each
+    accumulation O(1)-magnitude, which matters because plane dtype may be
+    float32 (a direct sum of p*value over 2^n terms loses integer
+    precision for wide registers)."""
+    idx = iota_for(planes)
+    p = planes[0] ** 2 + planes[1] ** 2
+    total = jnp.asarray(float(offset), dtype=p.dtype)
+    for j, b in enumerate(bits):
+        bit_set = ((idx >> b) & 1) == 1
+        total = total + float(1 << j) * jnp.sum(jnp.where(bit_set, p, 0.0))
+    return total
+
+
+def sample(planes, u):
+    """Device-side categorical draw for MAll (no 2^n host transfer)."""
+    p = planes[0] ** 2 + planes[1] ** 2
+    cdf = jnp.cumsum(p)
+    idx = jnp.searchsorted(cdf, u * cdf[-1], side="right")
+    return jnp.minimum(idx, p.shape[0] - 1)
+
+
+def allocate(planes, n: int, start: int, length: int):
+    """Insert |0> qubits at `start` as zero-pad + reshape."""
+    high = 1 << (n - start)
+    low = 1 << start
+    v = planes.reshape(2, high, 1, low)
+    z = jnp.zeros((2, high, (1 << length) - 1, low), dtype=planes.dtype)
+    return jnp.concatenate([v, z], axis=2).reshape(2, -1)
+
+
+def compose(planes_self, planes_other, n: int, m: int, start: int):
+    """Tensor product with other's qubits inserted at `start`
+    (reference kernel compose, qengine.cl:521)."""
+    # complex outer product in planes
+    re = jnp.outer(planes_other[0], planes_self[0]) - jnp.outer(planes_other[1], planes_self[1])
+    im = jnp.outer(planes_other[0], planes_self[1]) + jnp.outer(planes_other[1], planes_self[0])
+    t = jnp.stack([re, im]).reshape((2,) + (2,) * (m + n))
+    axes = [0]
+    total = n + m
+    for k in range(total - 1, -1, -1):
+        if k < start:
+            axes.append(1 + m + (n - 1 - k))
+        elif k < start + m:
+            axes.append(1 + m - 1 - (k - start))
+        else:
+            axes.append(1 + m + (n - 1 - (k - m)))
+    return jnp.transpose(t, axes).reshape(2, -1)
+
+
+def split_matrix(planes, n: int, start: int, length: int):
+    """Reshape ket planes to (2, remainder, dest) for dest = [start,
+    start+length) (reference kernels decomposeprob/decomposeamp,
+    qengine.cl:569-702)."""
+    t = planes.reshape((2,) + (2,) * n)
+    dest_axes = [1 + n - 1 - q for q in range(start + length - 1, start - 1, -1)]
+    rem_axes = [a for a in range(1, n + 1) if a not in dest_axes]
+    tt = jnp.transpose(t, [0] + rem_axes + dest_axes)
+    return tt.reshape(2, 1 << (n - length), 1 << length)

@@ -1,10 +1,18 @@
-"""Test harness config: force JAX onto a virtual 8-device CPU mesh so
+"""Test harness config: force JAX onto a virtual 8-device CPU platform so
 multi-chip sharding paths run without TPU hardware (the driver separately
-dry-runs the sharded path via __graft_entry__.dryrun_multichip)."""
+dry-runs the sharded path via __graft_entry__.dryrun_multichip).
+
+This environment's axon TPU plugin force-sets jax_platforms="axon,cpu"
+from sitecustomize at interpreter start, so JAX_PLATFORMS env alone is
+ineffective — the config must be updated back before any backend init
+(otherwise a wedged TPU tunnel hangs the whole suite)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

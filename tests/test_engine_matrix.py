@@ -1,0 +1,206 @@
+"""One conformance battery × engine matrix (SURVEY.md §4 design).
+
+Every engine/stack listed in ENGINE_FACTORIES runs the same randomized
+circuit batteries; the complex128 CPU oracle is the ground truth
+(reference: test/tests.cpp engine-matrix globals, test/test_main.cpp:24)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.engines.tpu import QEngineTPU
+from qrack_tpu import matrices as mat
+from qrack_tpu.utils.rng import QrackRandom
+
+
+ENGINE_FACTORIES = {
+    "tpu": lambda n, **kw: QEngineTPU(n, **kw),
+}
+
+
+def oracle(n, **kw):
+    return QEngineCPU(n, **kw)
+
+
+def both(n, seed=11):
+    o = oracle(n, rng=QrackRandom(seed), rand_global_phase=False)
+    return o, {
+        name: f(n, rng=QrackRandom(seed), rand_global_phase=False)
+        for name, f in ENGINE_FACTORIES.items()
+    }
+
+
+def assert_match(o, others, atol=2e-5):
+    expect = o.GetQuantumState()
+    for name, q in others.items():
+        got = q.GetQuantumState()
+        np.testing.assert_allclose(got, expect, atol=atol, err_msg=name)
+
+
+def random_circuit(q, rng, depth, n, allow_measure=False):
+    """Apply an identical random gate sequence to engine q."""
+    for _ in range(depth):
+        kind = rng.randint(0, 12)
+        t = rng.randint(0, n)
+        if kind == 0:
+            q.H(t)
+        elif kind == 1:
+            q.X(t)
+        elif kind == 2:
+            q.RY(rng.rand() * 2 * math.pi, t)
+        elif kind == 3:
+            q.RZ(rng.rand() * 2 * math.pi, t)
+        elif kind == 4:
+            q.T(t)
+        elif kind == 5:
+            c = rng.randint(0, n)
+            if c != t:
+                q.CNOT(c, t)
+        elif kind == 6:
+            c = rng.randint(0, n)
+            if c != t:
+                q.CZ(c, t)
+        elif kind == 7:
+            c = rng.randint(0, n)
+            if c != t:
+                q.Swap(c, t)
+        elif kind == 8:
+            q.U(t, rng.rand(), rng.rand(), rng.rand())
+        elif kind == 9:
+            c = rng.randint(0, n)
+            if c != t:
+                q.AntiCNOT(c, t)
+        elif kind == 10:
+            c1, c2 = rng.randint(0, n), rng.randint(0, n)
+            if len({c1, c2, t}) == 3:
+                q.CCNOT(c1, c2, t)
+        elif kind == 11:
+            c = rng.randint(0, n)
+            if c != t:
+                q.ISwap(c, t)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_random_circuits_match_oracle(seed):
+    n = 5
+    o, others = both(n, seed)
+    random_circuit(o, QrackRandom(100 + seed), 40, n)
+    for q in others.values():
+        random_circuit(q, QrackRandom(100 + seed), 40, n)
+    assert_match(o, others)
+
+
+@pytest.mark.parametrize("name", list(ENGINE_FACTORIES))
+def test_qft_matches_oracle(name):
+    n = 6
+    o, others = both(n, 5)
+    q = others[name]
+    for eng in (o, q):
+        eng.SetPermutation(0b101101)
+        eng.QFT(0, n)
+    np.testing.assert_allclose(q.GetQuantumState(), o.GetQuantumState(), atol=2e-5)
+    for eng in (o, q):
+        eng.IQFT(0, n)
+    np.testing.assert_allclose(q.GetQuantumState(), o.GetQuantumState(), atol=2e-5)
+    assert abs(q.GetAmplitude(0b101101)) == pytest.approx(1.0, abs=1e-4)
+
+
+@pytest.mark.parametrize("name", list(ENGINE_FACTORIES))
+def test_alu_matches_oracle(name):
+    n = 8
+    o, others = both(n, 7)
+    q = others[name]
+    for eng in (o, q):
+        eng.HReg(0, 4)
+        eng.INC(5, 0, 4)
+        eng.CINC(3, 0, 3, (6,))
+        eng.INCDECC(2, 0, 3, 5)
+        eng.ROL(1, 0, 4)
+        eng.PhaseFlipIfLess(7, 0, 4)
+        eng.Hash(0, 2, [2, 0, 3, 1])
+    assert_match(o, {name: q})
+
+
+@pytest.mark.parametrize("name", list(ENGINE_FACTORIES))
+def test_mul_and_modular_match_oracle(name):
+    n = 8
+    o, others = both(n, 9)
+    q = others[name]
+    for eng in (o, q):
+        eng.HReg(0, 3)
+        eng.MUL(3, 0, 3, 3)
+        eng.DIV(3, 0, 3, 3)
+        eng.MULModNOut(5, 7, 0, 3, 3)
+    assert_match(o, {name: q})
+
+
+@pytest.mark.parametrize("name", list(ENGINE_FACTORIES))
+def test_measurement_statistics_match(name):
+    n = 4
+    o, others = both(n, 13)
+    q = others[name]
+    for eng in (o, q):
+        eng.H(0)
+        eng.CNOT(0, 1)
+        eng.H(2)
+    # same rng seed -> same measurement outcomes
+    for eng in (o, q):
+        eng.rng.seed(42)
+    ro = [o.M(i) for i in range(n)]
+    rq = [q.M(i) for i in range(n)]
+    assert ro == rq
+    assert_match(o, {name: q}, atol=5e-5)
+
+
+@pytest.mark.parametrize("name", list(ENGINE_FACTORIES))
+def test_parity_and_uc_match(name):
+    n = 4
+    o, others = both(n, 17)
+    q = others[name]
+    mtrxs = [mat.u3_mtrx(0.3 * k, 0.1 * k, -0.2 * k) for k in range(4)]
+    for eng in (o, q):
+        eng.HReg(0, n)
+        eng.UniformParityRZ(0b0110, 0.7)
+        eng.PhaseParity(0.9, 0b1011)
+        eng.UCMtrx((1, 2), mtrxs, 0)
+    assert_match(o, {name: q})
+    assert q.ProbParity(0b0110) == pytest.approx(o.ProbParity(0b0110), abs=1e-5)
+
+
+@pytest.mark.parametrize("name", list(ENGINE_FACTORIES))
+def test_compose_decompose_match(name):
+    o, others = both(3, 19)
+    q = others[name]
+    for eng, mk in ((o, oracle), (q, ENGINE_FACTORIES[name])):
+        eng.H(0)
+        eng.CNOT(0, 1)
+        other = mk(2, rng=QrackRandom(7), rand_global_phase=False)
+        other.X(0)
+        other.H(1)
+        eng.Compose(other)
+        assert eng.GetQubitCount() == 5
+    assert_match(o, {name: q})
+    for eng, mk in ((o, oracle), (q, ENGINE_FACTORIES[name])):
+        dest = mk(2, rng=QrackRandom(8), rand_global_phase=False)
+        eng.Decompose(3, dest)
+        assert eng.GetQubitCount() == 3
+    assert_match(o, {name: q})
+
+
+@pytest.mark.parametrize("name", list(ENGINE_FACTORIES))
+def test_expectation_and_multishot(name):
+    n = 5
+    o, others = both(n, 23)
+    q = others[name]
+    for eng in (o, q):
+        random_circuit(eng, QrackRandom(55), 30, n)
+    assert q.ExpectationBitsAll(list(range(n))) == pytest.approx(
+        o.ExpectationBitsAll(list(range(n))), abs=1e-3)
+    assert q.VarianceBitsAll([0, 2, 4]) == pytest.approx(
+        o.VarianceBitsAll([0, 2, 4]), abs=1e-3)
+    so = o.MultiShotMeasureMask([1, 4], 2000)
+    sq = q.MultiShotMeasureMask([1, 4], 2000)
+    for k in range(4):
+        assert abs(so.get(k, 0) - sq.get(k, 0)) < 220
