@@ -1,0 +1,82 @@
+"""Round benchmark: fused whole-circuit QFT wall-clock on one TPU chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Protocol follows the reference's benchmark discipline (reference:
+test/benchmarks.cpp:98-300 benchmarkLoopVariable — warm-up excluded,
+average over samples). vs_baseline = CPU-oracle wall-clock / ours at
+the same width (cached in bench_baseline.json after first measurement;
+the oracle is this framework's numpy engine, the BASELINE.md parity
+reference)."""
+
+import json
+import os
+import sys
+import time
+
+WIDTH = int(os.environ.get("QRACK_BENCH_QB", "26"))
+SAMPLES = int(os.environ.get("QRACK_BENCH_SAMPLES", "5"))
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+
+
+def _tpu_seconds() -> float:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+    from qrack_tpu.models import qft as qftm
+
+    fn = jax.jit(qftm.make_qft_fn(WIDTH), donate_argnums=(0,))
+    planes = qftm.basis_planes(WIDTH, 12345)
+    # warm-up: compile + first run (excluded, reference benchmark style)
+    planes = fn(planes)
+    planes.block_until_ready()
+    times = []
+    for _ in range(SAMPLES):
+        t0 = time.perf_counter()
+        planes = fn(planes)
+        planes.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return sum(times) / len(times)
+
+
+def _cpu_baseline_seconds() -> float:
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            data = json.load(f)
+        if data.get("width") == WIDTH:
+            return float(data["cpu_qft_s"])
+    import numpy as np
+
+    from qrack_tpu import QEngineCPU, set_config
+    from qrack_tpu.utils.rng import QrackRandom
+
+    set_config(max_cpu_qubits=max(WIDTH, 28))
+    q = QEngineCPU(WIDTH, dtype=np.complex64, rng=QrackRandom(1))
+    t0 = time.perf_counter()
+    q.QFT(0, WIDTH)
+    cpu_s = time.perf_counter() - t0
+    with open(BASELINE_FILE, "w") as f:
+        json.dump({"width": WIDTH, "cpu_qft_s": cpu_s}, f)
+    return cpu_s
+
+
+def main() -> None:
+    tpu_s = _tpu_seconds()
+    try:
+        cpu_s = _cpu_baseline_seconds()
+        vs = cpu_s / tpu_s if tpu_s > 0 else 0.0
+    except Exception:
+        vs = 0.0
+    print(json.dumps({
+        "metric": f"qft{WIDTH}_fused_wall",
+        "value": round(tpu_s, 6),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

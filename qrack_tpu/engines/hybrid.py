@@ -1,0 +1,160 @@
+"""QHybrid: transparent CPU <-> TPU <-> pager switching by width.
+
+Re-design of the reference QHybrid (reference: include/qhybrid.hpp:35,
+SwitchGpuMode :105, SwitchPagerMode :127): below `tpu_threshold_qubits`
+the numpy engine wins (TPU dispatch latency dwarfs the math on tiny
+kets — SURVEY.md §7 "Tiny-state dispatch overhead"); above it the JAX
+engine; above `max_page_qubits` the sharded QPager. The wrapper forwards
+the entire QInterface surface to the active engine and re-materializes
+the ket across representations on width changes (the reference's
+CopyStateVec hand-off)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import get_config
+from ..utils.rng import QrackRandom
+from .cpu import QEngineCPU
+from .tpu import QEngineTPU
+
+
+class QHybrid:
+    def __init__(self, qubit_count: int, init_state: int = 0,
+                 rng: Optional[QrackRandom] = None,
+                 tpu_threshold_qubits: Optional[int] = None,
+                 pager_threshold_qubits: Optional[int] = None,
+                 devices=None, **kwargs):
+        cfg = get_config()
+        self._tpu_threshold = (
+            tpu_threshold_qubits if tpu_threshold_qubits is not None
+            else cfg.hybrid_tpu_threshold_qubits
+        )
+        self._pager_threshold = (
+            pager_threshold_qubits if pager_threshold_qubits is not None
+            else cfg.max_page_qubits
+        )
+        self._devices = devices
+        self._kwargs = dict(kwargs)
+        self._kwargs["rng"] = rng if rng is not None else QrackRandom()
+        self._engine = self._make_engine(qubit_count, init_state)
+
+    # ------------------------------------------------------------------
+
+    def _mode_for(self, qubit_count: int) -> str:
+        if qubit_count < self._tpu_threshold:
+            return "cpu"
+        if qubit_count <= self._pager_threshold:
+            return "tpu"
+        return "pager"
+
+    def _make_engine(self, qubit_count: int, init_state: int = 0, mode: Optional[str] = None):
+        if mode is None:
+            mode = self._mode_for(qubit_count)
+        if mode == "cpu":
+            return QEngineCPU(qubit_count, init_state=init_state, **self._kwargs)
+        if mode == "tpu":
+            return QEngineTPU(qubit_count, init_state=init_state, **self._kwargs)
+        from ..parallel.pager import QPager
+
+        return QPager(qubit_count, init_state=init_state, devices=self._devices,
+                      **self._kwargs)
+
+    def _maybe_switch(self) -> None:
+        """Re-materialize the ket when the width crosses a threshold
+        (reference: SwitchGpuMode / SwitchPagerMode)."""
+        n = self._engine.qubit_count
+        want = self._mode_for(n)
+        have = (
+            "cpu" if isinstance(self._engine, QEngineCPU)
+            else "tpu" if isinstance(self._engine, QEngineTPU)
+            else "pager"
+        )
+        if want == have:
+            return
+        state = self._engine.GetQuantumState()
+        rng = self._engine.rng
+        new = self._make_engine(n)
+        new.rng = rng
+        new.SetQuantumState(state)
+        self._engine = new
+
+    # ------------------------------------------------------------------
+    # full-surface forwarding with structural hooks
+    # ------------------------------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def Compose(self, other, start=None) -> int:
+        inner = other._engine if isinstance(other, QHybrid) else other
+        n_cur = self._engine.qubit_count
+        n_new = n_cur + inner.qubit_count
+        want = self._mode_for(n_new)
+        if want == self._mode_for(n_cur):
+            return self._engine.Compose(inner, start)
+        # crossing a threshold: build the target-mode engine directly at
+        # the grown width (it may not exist at the current width, e.g. a
+        # pager with more pages than 2^n_cur) and host-stage the product
+        from ..utils.states import compose_states
+
+        if start is None:
+            start = n_cur
+        full = compose_states(self._engine.GetQuantumState(),
+                              inner.GetQuantumState(),
+                              n_cur, inner.qubit_count, start)
+        rng = self._engine.rng
+        grown = self._make_engine(n_new, mode=want)
+        grown.rng = rng
+        grown.SetQuantumState(full)
+        self._engine = grown
+        return start
+
+    def Decompose(self, start, dest) -> None:
+        inner = dest._engine if isinstance(dest, QHybrid) else dest
+        self._engine.Decompose(start, inner)
+        self._maybe_switch()
+        if isinstance(dest, QHybrid):
+            dest._maybe_switch()
+
+    def Dispose(self, start, length, disposed_perm=None) -> None:
+        self._engine.Dispose(start, length, disposed_perm)
+        self._maybe_switch()
+
+    def Allocate(self, start, length=1) -> int:
+        n_cur = self._engine.qubit_count
+        want = self._mode_for(n_cur + length)
+        if want != self._mode_for(n_cur):
+            # pre-switch so growth never trips the smaller engine's guard
+            import numpy as np
+
+            from ..utils.states import compose_states
+
+            zeros = np.zeros(1 << length, dtype=np.complex128)
+            zeros[0] = 1.0
+            full = compose_states(self._engine.GetQuantumState(), zeros,
+                                  n_cur, length, start)
+            rng = self._engine.rng
+            grown = self._make_engine(n_cur + length, mode=want)
+            grown.rng = rng
+            grown.SetQuantumState(full)
+            self._engine = grown
+            return start
+        res = self._engine.Allocate(start, length)
+        self._maybe_switch()
+        return res
+
+    def Clone(self) -> "QHybrid":
+        c = QHybrid.__new__(QHybrid)
+        c._tpu_threshold = self._tpu_threshold
+        c._pager_threshold = self._pager_threshold
+        c._devices = self._devices
+        c._kwargs = dict(self._kwargs)
+        # fresh stream: the clone must not consume the original's RNG
+        c._kwargs["rng"] = self._kwargs["rng"].spawn()
+        c._engine = self._engine.Clone()
+        return c
+
+    @property
+    def qubit_count(self) -> int:
+        return self._engine.qubit_count

@@ -1,0 +1,1 @@
+from . import qft  # noqa: F401

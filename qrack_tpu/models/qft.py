@@ -1,0 +1,150 @@
+"""Whole-circuit QFT programs: the flagship fused workload.
+
+The reference dispatches one GPU kernel per gate (reference:
+test/benchmarks.cpp test_qft_* drive QInterface::QFT gate by gate).
+TPU-native, the entire circuit is traced into ONE XLA program — the
+n H-gates and n(n-1)/2 controlled phases unroll at trace time into a
+single fused executable (the reference's QueueItem chain becomes jit
+tracing, SURVEY.md §7 step 4), and the sharded variant runs the same
+program per page with ppermute pair exchanges over ICI for paged-qubit
+targets (reference: src/qpager.cpp:400-447 host-staged ShuffleBuffers).
+
+Gate order matches QInterface::QFT (reference:
+src/qinterface/qinterface.cpp:114) so results are bit-for-bit
+comparable with the gate-at-a-time path.
+"""
+
+from __future__ import annotations
+
+import cmath
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import gatekernels as gk
+
+
+def _h_mp(dtype):
+    s = 1.0 / math.sqrt(2.0)
+    re = jnp.asarray([[s, s], [s, -s]], dtype=dtype)
+    return jnp.stack([re, jnp.zeros_like(re)])
+
+
+def qft_planes(planes, n: int):
+    """Single-shard QFT over all n qubits (pure, trace-safe)."""
+    hm = _h_mp(planes.dtype)
+    end = n - 1
+    for i in range(n):
+        h_bit = end - i
+        for j in range(i):
+            c, t = h_bit, h_bit + 1 + j
+            ph = cmath.exp(1j * math.pi / (1 << (j + 1)))
+            cmask = 1 << c
+            planes = gk.apply_diag(planes, 1.0, 0.0, ph.real, ph.imag,
+                                   n, 1 << t, cmask, cmask)
+        planes = gk.apply_2x2(planes, hm, n, h_bit)
+    return planes
+
+
+def iqft_planes(planes, n: int):
+    hm = _h_mp(planes.dtype)
+    for i in range(n):
+        for j in range(i):
+            c, t = (i) - (j + 1), i
+            ph = cmath.exp(-1j * math.pi / (1 << (j + 1)))
+            cmask = 1 << c
+            planes = gk.apply_diag(planes, 1.0, 0.0, ph.real, ph.imag,
+                                   n, 1 << t, cmask, cmask)
+        planes = gk.apply_2x2(planes, hm, n, i)
+    return planes
+
+
+def make_qft_fn(n: int, inverse: bool = False):
+    """Jittable single-chip whole-QFT program over (2, 2^n) planes."""
+    body = iqft_planes if inverse else qft_planes
+
+    def fn(planes):
+        return body(planes, n)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# sharded whole-circuit program (pages mesh axis)
+# ---------------------------------------------------------------------------
+
+def _sharded_h(local, hm, L, npg, target):
+    """H inside the shard_map body: local target applies per page; paged
+    target rides one ppermute pair exchange."""
+    if target < L:
+        return gk.apply_2x2(local, hm, L, target)
+    gpos = target - L
+    perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
+    pid = jax.lax.axis_index("pages")
+    b = (pid >> gpos) & 1
+    other = jax.lax.ppermute(local, "pages", perm)
+    s = 1.0 / math.sqrt(2.0)
+    # H is real: diag entry s or -s by b; off-diag always s
+    dd = jnp.where(b == 0, s, -s)
+    return local * dd + other * s
+
+
+def _sharded_cphase(local, L, c, t, ph_re, ph_im):
+    """Controlled phase with split local/page masks — always collective-free."""
+    pid = jax.lax.axis_index("pages")
+    idx = gk.iota_for(local)
+    cmask, tmask = 1 << c, 1 << t
+    clo, chi = cmask & ((1 << L) - 1), cmask >> L
+    tlo, thi = tmask & ((1 << L) - 1), tmask >> L
+    on = (((idx & clo) == clo) if clo else (pid & chi) == chi) & \
+         (((idx & tlo) != 0) if tlo else ((pid & thi) != 0))
+    fre = jnp.where(on, jnp.asarray(ph_re, local.dtype), jnp.ones((), local.dtype))
+    fim = jnp.where(on, jnp.asarray(ph_im, local.dtype), jnp.zeros((), local.dtype))
+    return gk.cmul(fre, fim, local)
+
+
+def make_sharded_qft_fn(mesh: Mesh, n: int, inverse: bool = False):
+    """One jitted program: full QFT over a ket sharded across the 'pages'
+    mesh axis — in-page math per device, ppermute over ICI for paged
+    targets. Returns (fn, sharding)."""
+    npg = mesh.devices.size
+    g = npg.bit_length() - 1
+    L = n - g
+    assert (1 << g) == npg, "page count must be a power of two"
+    sharding = NamedSharding(mesh, P(None, "pages"))
+
+    def body(local):
+        hm = _h_mp(local.dtype)
+        end = n - 1
+        if not inverse:
+            for i in range(n):
+                h_bit = end - i
+                for j in range(i):
+                    ph = cmath.exp(1j * math.pi / (1 << (j + 1)))
+                    local = _sharded_cphase(local, L, h_bit, h_bit + 1 + j, ph.real, ph.imag)
+                local = _sharded_h(local, hm, L, npg, h_bit)
+        else:
+            for i in range(n):
+                for j in range(i):
+                    ph = cmath.exp(-1j * math.pi / (1 << (j + 1)))
+                    local = _sharded_cphase(local, L, i - (j + 1), i, ph.real, ph.imag)
+                local = _sharded_h(local, hm, L, npg, i)
+        return local
+
+    fn = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P(None, "pages"), out_specs=P(None, "pages")),
+        donate_argnums=(0,),
+    )
+    return fn, sharding
+
+
+def basis_planes(n: int, perm: int, sharding=None, dtype=jnp.float32):
+    """|perm> as (2, 2^n) planes, optionally sharded."""
+    st = jnp.zeros((2, 1 << n), dtype=dtype).at[0, perm].set(1.0)
+    if sharding is not None:
+        st = jax.device_put(st, sharding)
+    return st

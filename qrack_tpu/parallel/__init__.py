@@ -1,0 +1,1 @@
+from .pager import QPager  # noqa: F401

@@ -1,0 +1,629 @@
+"""QPager: one coherent ket sharded into pages across a TPU device mesh.
+
+TPU-native re-design of the reference's QPager (reference:
+include/qpager.hpp:31; src/qpager.cpp). Mapping (SURVEY.md §2.3):
+
+  reference                                   here
+  ------------------------------------------  ---------------------------
+  page i = amplitudes [i*pageMaxQPower, ...)   shard i of one jax.Array
+    (src/qpager_turboquant.cpp:12-21)          NamedSharding(mesh,'pages')
+  in-page gate broadcast to every page         shard_map, no collective
+    (src/qpager.cpp:369-397)
+  paged-qubit gate: pair pages, host-staged    lax.ppermute pair exchange
+    ShuffleBuffers (src/qpager.cpp:400-447)    over ICI — the headline win
+  MetaControlled page-subset selection         dynamic page-index masks
+    (src/qpager.cpp:453,563)                   inside the same programs
+  MetaSwap page-pointer permutation            ppermute with bit-swapped
+    (src/qpager.cpp:1314-1350)                 permutation
+  CombineEngines for indivisible ops           host-staged fallback
+    (src/qpager.cpp:316-367, :595)             (guarded by width)
+
+Masks are always split into (local, page) parts, so no kernel ever
+builds a >int32 global index — widths beyond 31 qubits stay exact.
+Multi-host DCN scale-out composes by constructing the Mesh over
+jax.distributed processes; the kernels are unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engines.qengine import QEngine
+from ..ops import gatekernels as gk
+from ..utils.bits import log2, is_pow2
+from .. import matrices as mat
+
+
+# ---------------------------------------------------------------------------
+# cached sharded programs, keyed on (n_pages, local_width, static params)
+# ---------------------------------------------------------------------------
+
+_PROGRAMS: dict = {}
+
+
+def _program(key, builder):
+    fn = _PROGRAMS.get(key)
+    if fn is None:
+        fn = builder()
+        _PROGRAMS[key] = fn
+    return fn
+
+
+def _state_specs(n_scalars: int):
+    """in_specs: sharded state first, replicated scalars after."""
+    return (P(None, "pages"),) + (P(),) * n_scalars
+
+
+def _split_masks(mask, val, local_bits):
+    lmask = mask & ((1 << local_bits) - 1)
+    lval = val & ((1 << local_bits) - 1)
+    gmask = mask >> local_bits
+    gval = val >> local_bits
+    return lmask, lval, gmask, gval
+
+
+class QPager(QEngine):
+    """Paged dense engine over a 1-D 'pages' mesh axis."""
+
+    _xp = jnp
+
+    def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
+                 n_pages: Optional[int] = None, dtype=jnp.float32, **kwargs):
+        super().__init__(qubit_count, init_state=init_state, **kwargs)
+        if devices is None:
+            devices = jax.devices()
+        # power-of-two device prefix (reference: page-count policy,
+        # src/qpager.cpp:89-292)
+        if n_pages is None:
+            n_pages = 1 << log2(len(devices))
+        if not is_pow2(n_pages):
+            raise ValueError("n_pages must be a power of two")
+        if n_pages > len(devices):
+            raise ValueError(
+                f"n_pages={n_pages} exceeds available devices ({len(devices)}); "
+                "a JAX mesh needs distinct devices — use fewer pages (larger "
+                "local shards are equivalent)"
+            )
+        dev_list = list(devices)[:n_pages]
+        self.n_pages = n_pages
+        self.g_bits = log2(n_pages)
+        self._check_capacity(qubit_count)
+        self.dtype = jnp.dtype(dtype)
+        self.mesh = Mesh(np.array(dev_list), ("pages",))
+        self.sharding = NamedSharding(self.mesh, P(None, "pages"))
+        self._state = None
+        self.SetPermutation(init_state)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def local_bits(self) -> int:
+        return self.qubit_count - self.g_bits
+
+    def _check_capacity(self, qubit_count: int) -> None:
+        local = qubit_count - self.g_bits
+        if local < 0:
+            raise ValueError(
+                f"QPager width {qubit_count} smaller than page count 2^{self.g_bits}"
+            )
+        if local > 30:
+            raise MemoryError(
+                f"QPager page width {local} exceeds a single shard; "
+                "add devices/pages or stack QUnit above"
+            )
+        if qubit_count > self.config.max_paging_qubits:
+            raise MemoryError(
+                f"QPager width {qubit_count} exceeds QRACK_MAX_PAGING_QB="
+                f"{self.config.max_paging_qubits}"
+            )
+
+    def _rand_phase(self) -> complex:
+        if self.rand_global_phase:
+            ang = 2.0 * math.pi * self.Rand()
+            return complex(math.cos(ang), math.sin(ang))
+        return 1.0 + 0.0j
+
+    def _split(self, mask, val=None):
+        if val is None:
+            val = mask
+        return _split_masks(mask, val, self.local_bits)
+
+    @staticmethod
+    def _cmask_cval(controls, perm):
+        from ..utils.bits import control_offset
+
+        cmask = 0
+        for c in controls:
+            cmask |= 1 << c
+        return cmask, control_offset(controls, perm)
+
+    # ------------------------------------------------------------------
+    # sharded kernel programs
+    # ------------------------------------------------------------------
+
+    def _key(self, *parts):
+        return (self.n_pages, self.local_bits, id(self.mesh)) + parts
+
+    def _p_local_2x2(self, target):
+        L, mesh, npg = self.local_bits, self.mesh, self.n_pages
+
+        def build():
+            def f(local, mp, lmask, lval, gmask, gval):
+                out = gk.apply_2x2(local, mp, L, target, lmask, lval)
+                pid = jax.lax.axis_index("pages")
+                ok = (pid & gmask) == gval
+                return jnp.where(ok, out, local)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
+            ), donate_argnums=(0,))
+
+        return _program(self._key("l2x2", target), build)
+
+    def _p_global_2x2(self, gpos):
+        L, mesh, npg = self.local_bits, self.mesh, self.n_pages
+        perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
+
+        def build():
+            def f(local, mp, lmask, lval, gmask, gval):
+                pid = jax.lax.axis_index("pages")
+                b = (pid >> gpos) & 1
+                other = jax.lax.ppermute(local, "pages", perm)
+                re, im = mp[0], mp[1]
+                dd_re = jnp.where(b == 0, re[0, 0], re[1, 1])
+                dd_im = jnp.where(b == 0, im[0, 0], im[1, 1])
+                od_re = jnp.where(b == 0, re[0, 1], re[1, 0])
+                od_im = jnp.where(b == 0, im[0, 1], im[1, 0])
+                out = gk.cmul(dd_re, dd_im, local) + gk.cmul(od_re, od_im, other)
+                idx = gk.iota_for(local)
+                ok = ((idx & lmask) == lval) & ((pid & gmask) == gval)
+                return jnp.where(ok, out, local)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
+            ), donate_argnums=(0,))
+
+        return _program(self._key("g2x2", gpos), build)
+
+    def _p_diag(self):
+        L, mesh = self.local_bits, self.mesh
+
+        def build():
+            def f(local, d0re, d0im, d1re, d1im, tlo, thi, clo, cvlo, chi, cvhi):
+                pid = jax.lax.axis_index("pages")
+                idx = gk.iota_for(local)
+                bit = ((idx & tlo) != 0) | ((pid & thi) != 0)
+                fre = jnp.where(bit, d1re, d0re)
+                fim = jnp.where(bit, d1im, d0im)
+                ok = ((idx & clo) == cvlo) & ((pid & chi) == cvhi)
+                fre = jnp.where(ok, fre, jnp.ones((), local.dtype))
+                fim = jnp.where(ok, fim, jnp.zeros((), local.dtype))
+                return gk.cmul(fre, fim, local)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=_state_specs(10), out_specs=P(None, "pages")
+            ), donate_argnums=(0,))
+
+        return _program(self._key("diag"), build)
+
+    def _p_prob_mask(self):
+        mesh = self.mesh
+
+        def build():
+            def f(local, lmask, lval, gmask, gval):
+                pid = jax.lax.axis_index("pages")
+                idx = gk.iota_for(local)
+                p = local[0] ** 2 + local[1] ** 2
+                ok = ((idx & lmask) == lval) & ((pid & gmask) == gval)
+                return jax.lax.psum(jnp.sum(jnp.where(ok, p, 0.0)), "pages")
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=_state_specs(4), out_specs=P()
+            ))
+
+        return _program(self._key("probmask"), build)
+
+    def _p_collapse(self):
+        mesh = self.mesh
+
+        def build():
+            def f(local, lmask, lval, gmask, gval, nrm_sq):
+                pid = jax.lax.axis_index("pages")
+                idx = gk.iota_for(local)
+                ok = ((idx & lmask) == lval) & ((pid & gmask) == gval)
+                scale = (1.0 / jnp.sqrt(nrm_sq)).astype(local.dtype)
+                return jnp.where(ok, local * scale, jnp.zeros((), local.dtype))
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
+            ), donate_argnums=(0,))
+
+        return _program(self._key("collapse"), build)
+
+    def _p_page_probs(self):
+        mesh = self.mesh
+
+        def build():
+            def f(local):
+                return jnp.sum(local[0] ** 2 + local[1] ** 2).reshape(1)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=_state_specs(0), out_specs=P("pages")
+            ))
+
+        return _program(self._key("pageprobs"), build)
+
+    def _p_meta_swap(self, g1, g2):
+        """Swap two paged qubits: pure page permutation over ICI
+        (reference MetaSwap, src/qpager.cpp:1314)."""
+        mesh, npg = self.mesh, self.n_pages
+
+        def build():
+            def permute(j):
+                b1 = (j >> g1) & 1
+                b2 = (j >> g2) & 1
+                if b1 == b2:
+                    return j
+                return j ^ ((1 << g1) | (1 << g2))
+
+            perm = [(j, permute(j)) for j in range(npg)]
+
+            def f(local):
+                return jax.lax.ppermute(local, "pages", perm)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=P(None, "pages"), out_specs=P(None, "pages")
+            ), donate_argnums=(0,))
+
+        return _program(self._key("metaswap", g1, g2), build)
+
+    def _p_local_swap(self, q1, q2):
+        L, mesh = self.local_bits, self.mesh
+
+        def build():
+            def f(local):
+                return gk.swap_bits(local, L, q1, q2)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=P(None, "pages"), out_specs=P(None, "pages")
+            ), donate_argnums=(0,))
+
+        return _program(self._key("lswap", q1, q2), build)
+
+    def _p_sum_sqr_diff(self):
+        mesh = self.mesh
+
+        def build():
+            def f(a, b):
+                re = jax.lax.psum(jnp.sum(a[0] * b[0] + a[1] * b[1]), "pages")
+                im = jax.lax.psum(jnp.sum(a[0] * b[1] - a[1] * b[0]), "pages")
+                return jnp.maximum(0.0, 1.0 - (re * re + im * im))
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=(P(None, "pages"), P(None, "pages")), out_specs=P()
+            ))
+
+        return _program(self._key("ssd"), build)
+
+    # ------------------------------------------------------------------
+    # kernel contract
+    # ------------------------------------------------------------------
+
+    def _k_apply_2x2(self, m2, target, controls, perm) -> None:
+        cmask, cval = self._cmask_cval(controls, perm)
+        lmask, lval, gmask, gval = _split_masks(cmask, cval, self.local_bits)
+        mp = gk.mtrx_planes(m2, self.dtype)
+        if target < self.local_bits:
+            self._state = self._p_local_2x2(target)(self._state, mp, lmask, lval, gmask, gval)
+        else:
+            gpos = target - self.local_bits
+            self._state = self._p_global_2x2(gpos)(self._state, mp, lmask, lval, gmask, gval)
+
+    def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
+        cmask, cval = self._cmask_cval(controls, perm)
+        lmask, lval, gmask, gval = _split_masks(cmask, cval, self.local_bits)
+        tmask = 1 << target
+        tlo = tmask & ((1 << self.local_bits) - 1)
+        thi = tmask >> self.local_bits
+        d0, d1 = complex(d0), complex(d1)
+        self._state = self._p_diag()(
+            self._state, d0.real, d0.imag, d1.real, d1.imag,
+            tlo, thi, lmask, lval, gmask, gval,
+        )
+
+    def _k_apply_4x4(self, m4, q1, q2) -> None:
+        # decompose into primitive ops through the pager paths
+        from ..interface.synth import apply_small_unitary_via_primitive
+
+        apply_small_unitary_via_primitive(self, np.asarray(m4, dtype=np.complex128), (q1, q2))
+
+    def _k_swap_bits(self, q1, q2) -> None:
+        L = self.local_bits
+        if q1 > q2:
+            q1, q2 = q2, q1
+        if q2 < L:
+            self._state = self._p_local_swap(q1, q2)(self._state)
+        elif q1 >= L:
+            self._state = self._p_meta_swap(q1 - L, q2 - L)(self._state)
+        else:
+            # mixed local/global: 3 controlled inverts through the
+            # pair-exchange path (reference falls back to gate synthesis)
+            x2 = mat.X2
+            self._k_apply_2x2(x2, q2, (q1,), 1)
+            self._k_apply_2x2(x2, q1, (q2,), 1)
+            self._k_apply_2x2(x2, q2, (q1,), 1)
+
+    def _global_iota(self):
+        """Sharded full-width index vector (int32-safe only to 31 qubits)."""
+        def build():
+            return jax.jit(
+                lambda: jax.lax.iota(gk.IDX_DTYPE, 1 << self.qubit_count),
+                out_shardings=NamedSharding(self.mesh, P("pages")),
+            )
+
+        return _program(self._key("iota", self.qubit_count), build)()
+
+    def _p_phase_apply(self):
+        def build():
+            return jax.jit(gk.phase_factor_apply, out_shardings=self.sharding,
+                           donate_argnums=(0,))
+
+        return _program(self._key("phaseapply"), build)
+
+    def _k_phase_fn(self, fn) -> None:
+        if self.qubit_count > 31:
+            raise NotImplementedError(
+                "generic diagonal ops above 31 qubits need split-mask "
+                "overrides (ZMask/PhaseParity/UniformParityRZ already have them)"
+            )
+        # factors computed eagerly (captured values stay out of any trace),
+        # then applied by one cached program
+        fre, fim = fn(jnp, self._global_iota())
+        self._state = self._p_phase_apply()(self._state, fre, fim)
+
+    def _p_gather(self):
+        def build():
+            return jax.jit(lambda s, i: s[:, i], out_shardings=self.sharding,
+                           donate_argnums=(0,))
+
+        return _program(self._key("gather"), build)
+
+    def _k_gather(self, src_fn) -> None:
+        if self.qubit_count > 31:
+            raise NotImplementedError(
+                "cross-page basis permutations above 31 qubits are a "
+                "combine-and-op fallback (reference: CombineAndOp) — "
+                "pending carry-aware sharded ALU kernels"
+            )
+        src = src_fn(self._global_iota())
+        self._state = self._p_gather()(self._state, src)
+
+    def _p_out_of_place(self, with_passthrough: bool):
+        def build():
+            if with_passthrough:
+                def f(state, s_idx, d_idx, cmask):
+                    idx = jax.lax.iota(gk.IDX_DTYPE, state.shape[-1])
+                    keep = (idx & cmask) != cmask
+                    new = jnp.where(keep, state, jnp.zeros((), state.dtype))
+                    return new.at[:, d_idx].set(state[:, s_idx])
+            else:
+                def f(state, s_idx, d_idx):
+                    new = jnp.zeros_like(state)
+                    return new.at[:, d_idx].set(state[:, s_idx])
+
+            return jax.jit(f, out_shardings=self.sharding)
+
+        return _program(self._key("oop", with_passthrough), build)
+
+    def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:
+        if self.qubit_count > 31:
+            raise NotImplementedError("see _k_gather")
+        src_idx = jnp.asarray(src_idx, dtype=gk.IDX_DTYPE)
+        dst_idx = jnp.asarray(dst_idx, dtype=gk.IDX_DTYPE)
+        if passthrough_cmask is not None:
+            self._state = self._p_out_of_place(True)(
+                self._state, src_idx, dst_idx, passthrough_cmask)
+        else:
+            self._state = self._p_out_of_place(False)(self._state, src_idx, dst_idx)
+
+    def _k_probs(self) -> np.ndarray:
+        return np.asarray(jax.jit(gk.probs)(self._state), dtype=np.float64)
+
+    def _k_prob_mask(self, mask, perm) -> float:
+        lmask, lval, gmask, gval = _split_masks(mask, perm, self.local_bits)
+        p = float(self._p_prob_mask()(self._state, lmask, lval, gmask, gval))
+        return min(max(p, 0.0), 1.0)
+
+    def _k_collapse(self, mask, val, nrm_sq) -> None:
+        lmask, lval, gmask, gval = _split_masks(mask, val, self.local_bits)
+        self._state = self._p_collapse()(self._state, lmask, lval, gmask, gval, nrm_sq)
+
+    def MAll(self) -> int:
+        """Two-stage sample: page marginals (psum over mesh), then an
+        in-page draw — only one page ever reaches the host."""
+        page_probs = np.asarray(self._p_page_probs()(self._state), dtype=np.float64)
+        page = int(self.rng.choice_from_probs(page_probs, 1)[0])
+        L = self.local_bits
+        local = np.asarray(
+            jax.device_get(self._state[:, page << L:(page + 1) << L]), dtype=np.float64
+        )
+        p_local = local[0] ** 2 + local[1] ** 2
+        sub = int(self.rng.choice_from_probs(p_local, 1)[0])
+        result = (page << L) | sub
+        self.SetPermutation(result)
+        return result
+
+    def _k_normalize(self, nrm_sq) -> None:
+        self._state = jax.jit(gk.normalize, donate_argnums=(0,))(self._state, nrm_sq)
+
+    def _k_sum_sqr_diff(self, other) -> float:
+        if isinstance(other, QPager) and other.n_pages == self.n_pages:
+            b = other._state
+        else:
+            b = jax.device_put(gk.to_planes(other.GetQuantumState(), self.dtype), self.sharding)
+        return float(self._p_sum_sqr_diff()(self._state, b))
+
+    # -- structural ops: host-staged (reference: CombineEngines fallback) --
+
+    def _k_compose(self, other, start) -> None:
+        a = self.GetQuantumState()
+        b = np.asarray(other.GetQuantumState())
+        full = gk.compose(gk.to_planes(a, self.dtype), gk.to_planes(b, self.dtype),
+                          self.qubit_count, other.qubit_count, start)
+        self._state = jax.device_put(full, self._sharding_for(self.qubit_count + other.qubit_count))
+
+    def _k_decompose(self, start, length) -> np.ndarray:
+        planes = gk.to_planes(self.GetQuantumState(), self.dtype)
+        m = gk.split_matrix(planes, self.qubit_count, start, length)
+        m = np.asarray(m, dtype=np.float64)
+        row_norms = (m[0] ** 2 + m[1] ** 2).sum(axis=1)
+        r0 = int(np.argmax(row_norms))
+        dest = (m[0, r0] + 1j * m[1, r0]) / math.sqrt(row_norms[r0])
+        rem = (m[0] + 1j * m[1]) @ np.conj(dest)
+        nrm = np.linalg.norm(rem)
+        if nrm > 0:
+            rem /= nrm
+        self._state = jax.device_put(
+            gk.to_planes(rem, self.dtype), self._sharding_for(self.qubit_count - length)
+        )
+        return dest
+
+    def _k_dispose(self, start, length, perm) -> None:
+        planes = gk.to_planes(self.GetQuantumState(), self.dtype)
+        m = gk.split_matrix(planes, self.qubit_count, start, length)
+        m = np.asarray(m, dtype=np.float64)
+        full = m[0] + 1j * m[1]
+        if perm is not None:
+            rem = full[:, perm]
+        else:
+            row_norms = (np.abs(full) ** 2).sum(axis=1)
+            r0 = int(np.argmax(row_norms))
+            dest = full[r0] / math.sqrt(row_norms[r0])
+            rem = full @ np.conj(dest)
+        nrm = np.linalg.norm(rem)
+        if nrm > 0:
+            rem /= nrm
+        self._state = jax.device_put(
+            gk.to_planes(rem, self.dtype), self._sharding_for(self.qubit_count - length)
+        )
+
+    def _k_allocate(self, start, length) -> None:
+        st = self.GetQuantumState()
+        new = np.zeros(1 << (self.qubit_count + length), dtype=np.complex128)
+        from ..utils.bits import deposit_indices
+
+        pos = deposit_indices(self.qubit_count + length, list(range(start, start + length)))
+        new[pos] = st
+        self._state = jax.device_put(
+            gk.to_planes(new, self.dtype), self._sharding_for(self.qubit_count + length)
+        )
+
+    def _sharding_for(self, qubit_count):
+        """Sharding for a (possibly shrunken) width; drops pages when the
+        ket gets smaller than the page count (reference: SeparateEngines/
+        CombineEngines page-count rebalance, src/qpager.cpp:316-367)."""
+        new_g = min(self.g_bits, max(qubit_count, 0))
+        if new_g != self.g_bits:
+            devs = list(self.mesh.devices.flat)[: 1 << new_g]
+            self.n_pages = 1 << new_g
+            self.g_bits = new_g
+            self.mesh = Mesh(np.array(devs), ("pages",))
+            self.sharding = NamedSharding(self.mesh, P(None, "pages"))
+        return self.sharding
+
+    # ------------------------------------------------------------------
+    # state access
+    # ------------------------------------------------------------------
+
+    def GetQuantumState(self) -> np.ndarray:
+        return gk.from_planes(jax.device_get(self._state))
+
+    def SetQuantumState(self, state) -> None:
+        st = np.asarray(state).reshape(-1)
+        if st.shape[0] != (1 << self.qubit_count):
+            raise ValueError("state length mismatch")
+        self._state = jax.device_put(gk.to_planes(st, self.dtype), self.sharding)
+
+    def GetAmplitude(self, perm: int) -> complex:
+        amp = np.asarray(jax.device_get(self._state[:, perm]), dtype=np.float64)
+        return complex(amp[0], amp[1])
+
+    def SetAmplitude(self, perm: int, amp: complex) -> None:
+        amp = complex(amp)
+
+        def build():
+            return jax.jit(lambda s, p, v: s.at[:, p].set(v),
+                           out_shardings=self.sharding)
+
+        prog = _program(self._key("setamp"), build)
+        self._state = prog(self._state, perm,
+                           jnp.asarray([amp.real, amp.imag], dtype=self.dtype))
+
+    def SetPermutation(self, perm: int, phase=None) -> None:
+        ph = self._rand_phase() if phase is None else complex(phase)
+        key = self._key("setperm")
+
+        def build():
+            def f(p, v):
+                return jnp.zeros((2, 1 << self.qubit_count), dtype=self.dtype).at[:, p].set(v)
+
+            return jax.jit(f, out_shardings=self.sharding)
+
+        prog = _program(key + (self.qubit_count,), build)
+        self._state = prog(perm, jnp.asarray([ph.real, ph.imag], dtype=self.dtype))
+        self.running_norm = 1.0
+
+    def Clone(self) -> "QPager":
+        c = QPager(
+            self.qubit_count, n_pages=self.n_pages,
+            devices=list(self.mesh.devices.flat), dtype=self.dtype,
+            rng=self.rng.spawn(), do_normalize=self.do_normalize,
+            rand_global_phase=self.rand_global_phase,
+        )
+        c._state = jnp.array(self._state, copy=True)
+        return c
+
+    def CloneEmpty(self) -> "QPager":
+        return QPager(
+            self.qubit_count, n_pages=self.n_pages,
+            devices=list(self.mesh.devices.flat), dtype=self.dtype,
+            rng=self.rng.spawn(), do_normalize=self.do_normalize,
+            rand_global_phase=self.rand_global_phase,
+        )
+
+    def Finish(self) -> None:
+        if self._state is not None:
+            self._state.block_until_ready()
+
+    def GetDeviceList(self):
+        return [d.id for d in self.mesh.devices.flat]
+
+    # -- cross-engine data plane --
+
+    def ZeroAmplitudes(self) -> None:
+        self._state = jax.device_put(
+            jnp.zeros_like(self._state), self.sharding
+        )
+
+    def IsZeroAmplitude(self) -> bool:
+        return not bool(jnp.any(self._state != 0))
+
+    def GetAmplitudePage(self, offset: int, length: int) -> np.ndarray:
+        return gk.from_planes(jax.device_get(self._state[:, offset:offset + length]))
+
+    def SetAmplitudePage(self, page, offset: int) -> None:
+        def build():
+            return jax.jit(
+                lambda s, v, o: jax.lax.dynamic_update_slice(s, v, (0, o)),
+                out_shardings=self.sharding,
+            )
+
+        prog = _program(self._key("setpage", len(page)), build)
+        self._state = prog(self._state, gk.to_planes(page, self.dtype), offset)

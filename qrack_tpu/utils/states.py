@@ -1,0 +1,26 @@
+"""Host-side (numpy) state compose/split helpers shared by layers that
+stage structural ops through the host (reference: CombineEngines
+fallback, src/qpager.cpp:316-367)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compose_states(a: np.ndarray, b: np.ndarray, n: int, m: int, start: int) -> np.ndarray:
+    """Tensor `b` (m qubits) into `a` (n qubits) at qubit index `start`."""
+    a = np.asarray(a).reshape(-1)
+    b = np.asarray(b).reshape(-1)
+    if start == n:
+        return np.kron(b, a)
+    t = np.outer(b, a).reshape((2,) * (m + n))
+    axes = []
+    total = n + m
+    for k in range(total - 1, -1, -1):
+        if k < start:
+            axes.append(m + (n - 1 - k))
+        elif k < start + m:
+            axes.append(m - 1 - (k - start))
+        else:
+            axes.append(m + (n - 1 - (k - m)))
+    return np.transpose(t, axes).reshape(-1).copy()

@@ -15,8 +15,22 @@ from qrack_tpu import matrices as mat
 from qrack_tpu.utils.rng import QrackRandom
 
 
+def _pager(n, **kw):
+    from qrack_tpu.parallel.pager import QPager
+
+    return QPager(n, n_pages=4, **kw)
+
+
+def _hybrid(n, **kw):
+    from qrack_tpu.engines.hybrid import QHybrid
+
+    return QHybrid(n, tpu_threshold_qubits=4, pager_threshold_qubits=7, **kw)
+
+
 ENGINE_FACTORIES = {
     "tpu": lambda n, **kw: QEngineTPU(n, **kw),
+    "pager": _pager,
+    "hybrid": _hybrid,
 }
 
 

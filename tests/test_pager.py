@@ -1,0 +1,207 @@
+"""QPager conformance on the 8-device virtual CPU mesh.
+
+Exercises the reference QPager semantics re-designed as collectives
+(SURVEY.md §2.3): in-page broadcast, paged-qubit ppermute exchange,
+MetaSwap page permutation, meta-controlled page selection."""
+
+import math
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.parallel.pager import QPager
+from qrack_tpu import matrices as mat
+from qrack_tpu.utils.rng import QrackRandom
+
+from helpers import rand_state
+from test_engine_matrix import random_circuit
+
+
+def make_pair(n, seed=3, n_pages=8):
+    o = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+    p = QPager(n, rng=QrackRandom(seed), rand_global_phase=False, n_pages=n_pages)
+    return o, p
+
+
+def assert_match(o, p, atol=3e-5):
+    np.testing.assert_allclose(p.GetQuantumState(), o.GetQuantumState(), atol=atol)
+
+
+def test_local_and_global_gates():
+    n = 6  # 3 local bits, 3 global bits on 8 pages
+    o, p = make_pair(n)
+    for eng in (o, p):
+        eng.H(0)        # local
+        eng.H(4)        # global (paged)
+        eng.CNOT(0, 5)  # local control, global target
+        eng.CNOT(5, 1)  # global control, local target
+        eng.CZ(3, 4)    # global-global diag
+        eng.T(5)        # global diag
+    assert_match(o, p)
+
+
+def test_random_circuits_match():
+    n = 7
+    for seed in (1, 2):
+        o, p = make_pair(n, seed)
+        random_circuit(o, QrackRandom(200 + seed), 50, n)
+        random_circuit(p, QrackRandom(200 + seed), 50, n)
+        assert_match(o, p)
+
+
+def test_qft_across_pages():
+    n = 8
+    o, p = make_pair(n)
+    for eng in (o, p):
+        eng.SetPermutation(0b10110101)
+        eng.QFT(0, n)
+    assert_match(o, p)
+    for eng in (o, p):
+        eng.IQFT(0, n)
+    assert_match(o, p)
+    assert abs(p.GetAmplitude(0b10110101)) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_meta_swap_and_mixed_swap():
+    n = 7
+    o, p = make_pair(n, seed=9)
+    psi = rand_state(n, 77)
+    o.SetQuantumState(psi)
+    p.SetQuantumState(psi)
+    for eng in (o, p):
+        eng.Swap(4, 6)  # global-global: pure page permutation
+        eng.Swap(0, 2)  # local-local
+        eng.Swap(1, 5)  # mixed
+    assert_match(o, p)
+
+
+def test_measurement_and_prob():
+    n = 6
+    o, p = make_pair(n, seed=11)
+    for eng in (o, p):
+        eng.H(0)
+        eng.CNOT(0, 5)  # entangle across the page boundary
+    assert p.Prob(5) == pytest.approx(o.Prob(5), abs=1e-6)
+    assert p.ProbMask(0b100001, 0b100001) == pytest.approx(
+        o.ProbMask(0b100001, 0b100001), abs=1e-6)
+    for eng in (o, p):
+        eng.rng.seed(5)
+    assert p.M(5) == o.M(5)
+    assert_match(o, p)
+    # MAll two-stage sampling
+    o2, p2 = make_pair(n, seed=13)
+    for eng in (o2, p2):
+        eng.H(0)
+        eng.CNOT(0, 5)
+        eng.rng.seed(21)
+    assert p2.MAll() in (0, 0b100001)
+
+
+def test_alu_and_diag_through_pager():
+    n = 7
+    o, p = make_pair(n, seed=15)
+    for eng in (o, p):
+        eng.HReg(0, 4)
+        eng.INC(11, 0, 6)          # register crosses the page boundary
+        eng.PhaseFlipIfLess(9, 0, 4)
+        eng.UniformParityRZ(0b1010001, 0.4)
+        eng.ROL(2, 0, 6)
+    assert_match(o, p)
+
+
+def test_expectation_and_clone():
+    n = 6
+    o, p = make_pair(n, seed=17)
+    random_circuit(o, QrackRandom(31), 30, n)
+    random_circuit(p, QrackRandom(31), 30, n)
+    assert p.ExpectationBitsAll(list(range(n))) == pytest.approx(
+        o.ExpectationBitsAll(list(range(n))), abs=1e-3)
+    c = p.Clone()
+    assert p.ApproxCompare(c, 1e-6)
+    assert p.SumSqrDiff(o) < 1e-6
+
+
+def test_fewer_pages_than_qubits_devices():
+    # 4 pages on the 8-device pool (degenerate placement allowed)
+    o = QEngineCPU(5, rng=QrackRandom(1), rand_global_phase=False)
+    p = QPager(5, rng=QrackRandom(1), rand_global_phase=False, n_pages=4)
+    for eng in (o, p):
+        eng.H(0)
+        eng.CNOT(0, 4)
+        eng.T(4)
+    assert_match(o, p)
+
+
+def test_compose_decompose_through_pager():
+    o, p = make_pair(4, seed=19, n_pages=4)
+    for eng, mk in ((o, None), (p, None)):
+        eng.H(0)
+        eng.CNOT(0, 1)
+    other_o = QEngineCPU(2, rng=QrackRandom(7), rand_global_phase=False)
+    other_o.X(0)
+    other_p = QEngineCPU(2, rng=QrackRandom(7), rand_global_phase=False)
+    other_p.X(0)
+    o.Compose(other_o)
+    p.Compose(other_p)
+    assert p.GetQubitCount() == 6
+    assert_match(o, p)
+
+
+def test_hybrid_switching():
+    from qrack_tpu.engines.hybrid import QHybrid
+
+    q = QHybrid(3, rng=QrackRandom(5), rand_global_phase=False,
+                tpu_threshold_qubits=5, pager_threshold_qubits=8)
+    from qrack_tpu.engines.cpu import QEngineCPU as CPU
+    assert isinstance(q._engine, CPU)
+    q.H(0)
+    q.CNOT(0, 1)
+    state_before = q.GetQuantumState()
+    # grow past the TPU threshold
+    q.Allocate(3, 3)
+    from qrack_tpu.engines.tpu import QEngineTPU as TPU
+    assert isinstance(q._engine, TPU)
+    assert q.qubit_count == 6
+    np.testing.assert_allclose(q.GetQuantumState()[:8], state_before, atol=1e-6)
+    # gates keep working after the switch
+    q.CNOT(0, 5)
+    assert q.Prob(5) == pytest.approx(0.5, abs=1e-5)
+    # shrink back below the threshold
+    q.ForceM(5, False) if q.Prob(5) < 2 else None
+    q.Dispose(3, 3, None)
+    assert isinstance(q._engine, CPU)
+    assert q.qubit_count == 3
+
+
+def test_hybrid_compose_into_pager_mode():
+    # regression: composing a small hybrid past the pager threshold must
+    # not construct a pager at the (too small) current width
+    from qrack_tpu.engines.hybrid import QHybrid
+    from qrack_tpu.parallel.pager import QPager as _QP
+
+    q = QHybrid(2, rng=QrackRandom(1), rand_global_phase=False,
+                tpu_threshold_qubits=4, pager_threshold_qubits=7)
+    q.H(0)
+    other = QEngineCPU(7, rng=QrackRandom(2), rand_global_phase=False)
+    other.X(0)
+    start = q.Compose(other)
+    assert start == 2 and q.qubit_count == 9
+    assert isinstance(q._engine, _QP)
+    assert q.Prob(0) == pytest.approx(0.5, abs=1e-5)
+    assert q.Prob(2) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_pager_dispose_below_page_count():
+    # regression: shrinking below the page count rebuilds the mesh
+    p = QPager(8, rng=QrackRandom(3), rand_global_phase=False, n_pages=8)
+    p.H(0)
+    p.Dispose(2, 6)
+    assert p.GetQubitCount() == 2
+    assert p.n_pages <= 4
+    assert p.Prob(0) == pytest.approx(0.5, abs=1e-5)
+
+
+def test_pager_rejects_more_pages_than_devices():
+    with pytest.raises(ValueError):
+        QPager(10, n_pages=16)
