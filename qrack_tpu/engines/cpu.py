@@ -136,23 +136,9 @@ class QEngineCPU(QEngine):
         if start == n:
             self._state = np.kron(other_state, self._state)
             return
-        # general insertion: outer product, then axis permutation
-        t = np.outer(other_state, self._state).reshape((2,) * (m + n))
-        # axes: [other qubits m-1..0] + [self qubits n-1..0]
-        # new qubit k (0-based, little-endian):
-        #   k < start         -> old self qubit k
-        #   start <= k < start+m -> other qubit k-start
-        #   k >= start+m      -> old self qubit k-m
-        axes = []
-        total = n + m
-        for k in range(total - 1, -1, -1):  # new MSB..LSB = numpy axis order
-            if k < start:
-                axes.append(m + (n - 1 - k))
-            elif k < start + m:
-                axes.append(m - 1 - (k - start))
-            else:
-                axes.append(m + (n - 1 - (k - m)))
-        self._state = np.transpose(t, axes).reshape(-1).copy()
+        from ..utils.states import compose_states
+
+        self._state = compose_states(self._state, other_state, n, m, start).astype(self.dtype)
 
     def _split_matrix(self, start, length) -> np.ndarray:
         """Reshape ket to M[remainder, dest] for dest = [start, start+length)."""

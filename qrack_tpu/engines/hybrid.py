@@ -86,6 +86,16 @@ class QHybrid:
     def __getattr__(self, name):
         return getattr(self._engine, name)
 
+    def _grow_to(self, n_new: int, mode: str, full_state) -> None:
+        """Host-stage into a target-mode engine at the grown width (it
+        may not exist at the current width, e.g. a pager with more pages
+        than 2^n_cur)."""
+        rng = self._engine.rng
+        grown = self._make_engine(n_new, mode=mode)
+        grown.rng = rng
+        grown.SetQuantumState(full_state)
+        self._engine = grown
+
     def Compose(self, other, start=None) -> int:
         inner = other._engine if isinstance(other, QHybrid) else other
         n_cur = self._engine.qubit_count
@@ -93,21 +103,13 @@ class QHybrid:
         want = self._mode_for(n_new)
         if want == self._mode_for(n_cur):
             return self._engine.Compose(inner, start)
-        # crossing a threshold: build the target-mode engine directly at
-        # the grown width (it may not exist at the current width, e.g. a
-        # pager with more pages than 2^n_cur) and host-stage the product
         from ..utils.states import compose_states
 
         if start is None:
             start = n_cur
-        full = compose_states(self._engine.GetQuantumState(),
-                              inner.GetQuantumState(),
-                              n_cur, inner.qubit_count, start)
-        rng = self._engine.rng
-        grown = self._make_engine(n_new, mode=want)
-        grown.rng = rng
-        grown.SetQuantumState(full)
-        self._engine = grown
+        self._grow_to(n_new, want, compose_states(
+            self._engine.GetQuantumState(), inner.GetQuantumState(),
+            n_cur, inner.qubit_count, start))
         return start
 
     def Decompose(self, start, dest) -> None:
@@ -125,20 +127,14 @@ class QHybrid:
         n_cur = self._engine.qubit_count
         want = self._mode_for(n_cur + length)
         if want != self._mode_for(n_cur):
-            # pre-switch so growth never trips the smaller engine's guard
             import numpy as np
 
             from ..utils.states import compose_states
 
             zeros = np.zeros(1 << length, dtype=np.complex128)
             zeros[0] = 1.0
-            full = compose_states(self._engine.GetQuantumState(), zeros,
-                                  n_cur, length, start)
-            rng = self._engine.rng
-            grown = self._make_engine(n_cur + length, mode=want)
-            grown.rng = rng
-            grown.SetQuantumState(full)
-            self._engine = grown
+            self._grow_to(n_cur + length, want, compose_states(
+                self._engine.GetQuantumState(), zeros, n_cur, length, start))
             return start
         res = self._engine.Allocate(start, length)
         self._maybe_switch()

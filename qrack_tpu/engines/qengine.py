@@ -140,13 +140,12 @@ class QEngine(QInterface):
         prob_one = self.Prob(q)
         if do_force:
             res = bool(result)
+        elif prob_one >= 1.0 - FP_NORM_EPSILON:
+            res = True   # deterministic: no RNG draw (keeps streams
+        elif prob_one <= FP_NORM_EPSILON:
+            res = False  # aligned with the tableau engines)
         else:
             res = self.Rand() <= prob_one
-            # guard against numerically-impossible branches
-            if prob_one >= 1.0 - FP_NORM_EPSILON:
-                res = True
-            elif prob_one <= FP_NORM_EPSILON:
-                res = False
         nrm_sq = prob_one if res else (1.0 - prob_one)
         if nrm_sq <= 0.0:
             raise RuntimeError("ForceM: forced result has zero probability")
@@ -157,11 +156,12 @@ class QEngine(QInterface):
     def ForceMParity(self, mask: int, result: bool, do_force: bool = True) -> bool:
         odd_prob = self.ProbParity(mask)
         if not do_force:
-            result = self.Rand() <= odd_prob
             if odd_prob >= 1.0 - FP_NORM_EPSILON:
-                result = True
+                result = True   # deterministic: no draw (stream-aligned
             elif odd_prob <= FP_NORM_EPSILON:
-                result = False
+                result = False  # with ForceM and the tableau path)
+            else:
+                result = self.Rand() <= odd_prob
         nrm_sq = odd_prob if result else (1.0 - odd_prob)
         if nrm_sq <= 0.0:
             raise RuntimeError("ForceMParity: forced result has zero probability")

@@ -1,0 +1,2 @@
+from .stabilizer import QStabilizer, CliffordError  # noqa: F401
+from .stabilizerhybrid import QStabilizerHybrid  # noqa: F401

@@ -260,17 +260,10 @@ def compose(planes_self, planes_other, n: int, m: int, start: int):
     # complex outer product in planes
     re = jnp.outer(planes_other[0], planes_self[0]) - jnp.outer(planes_other[1], planes_self[1])
     im = jnp.outer(planes_other[0], planes_self[1]) + jnp.outer(planes_other[1], planes_self[0])
+    from ..utils.states import insertion_axes
+
     t = jnp.stack([re, im]).reshape((2,) + (2,) * (m + n))
-    axes = [0]
-    total = n + m
-    for k in range(total - 1, -1, -1):
-        if k < start:
-            axes.append(1 + m + (n - 1 - k))
-        elif k < start + m:
-            axes.append(1 + m - 1 - (k - start))
-        else:
-            axes.append(1 + m + (n - 1 - (k - m)))
-    return jnp.transpose(t, axes).reshape(2, -1)
+    return jnp.transpose(t, insertion_axes(n, m, start, lead=1)).reshape(2, -1)
 
 
 def split_matrix(planes, n: int, start: int, length: int):

@@ -362,17 +362,22 @@ class QPager(QEngine):
 
     def _global_iota(self):
         """Sharded full-width index vector (int32-safe only to 31 qubits)."""
-        def build():
-            return jax.jit(
-                lambda: jax.lax.iota(gk.IDX_DTYPE, 1 << self.qubit_count),
-                out_shardings=NamedSharding(self.mesh, P("pages")),
-            )
+        n = self.qubit_count
+        sh = NamedSharding(self.mesh, P("pages"))
 
-        return _program(self._key("iota", self.qubit_count), build)()
+        def build():
+            # closure binds only locals: cached programs must not pin
+            # engine instances (and their kets) via `self`
+            return jax.jit(lambda: jax.lax.iota(gk.IDX_DTYPE, 1 << n),
+                           out_shardings=sh)
+
+        return _program(self._key("iota", n), build)()
 
     def _p_phase_apply(self):
+        sh = self.sharding
+
         def build():
-            return jax.jit(gk.phase_factor_apply, out_shardings=self.sharding,
+            return jax.jit(gk.phase_factor_apply, out_shardings=sh,
                            donate_argnums=(0,))
 
         return _program(self._key("phaseapply"), build)
@@ -389,8 +394,10 @@ class QPager(QEngine):
         self._state = self._p_phase_apply()(self._state, fre, fim)
 
     def _p_gather(self):
+        sh = self.sharding
+
         def build():
-            return jax.jit(lambda s, i: s[:, i], out_shardings=self.sharding,
+            return jax.jit(lambda s, i: s[:, i], out_shardings=sh,
                            donate_argnums=(0,))
 
         return _program(self._key("gather"), build)
@@ -406,6 +413,8 @@ class QPager(QEngine):
         self._state = self._p_gather()(self._state, src)
 
     def _p_out_of_place(self, with_passthrough: bool):
+        sh = self.sharding
+
         def build():
             if with_passthrough:
                 def f(state, s_idx, d_idx, cmask):
@@ -418,7 +427,7 @@ class QPager(QEngine):
                     new = jnp.zeros_like(state)
                     return new.at[:, d_idx].set(state[:, s_idx])
 
-            return jax.jit(f, out_shardings=self.sharding)
+            return jax.jit(f, out_shardings=sh)
 
         return _program(self._key("oop", with_passthrough), build)
 
@@ -558,9 +567,10 @@ class QPager(QEngine):
     def SetAmplitude(self, perm: int, amp: complex) -> None:
         amp = complex(amp)
 
+        sh = self.sharding
+
         def build():
-            return jax.jit(lambda s, p, v: s.at[:, p].set(v),
-                           out_shardings=self.sharding)
+            return jax.jit(lambda s, p, v: s.at[:, p].set(v), out_shardings=sh)
 
         prog = _program(self._key("setamp"), build)
         self._state = prog(self._state, perm,
@@ -568,15 +578,15 @@ class QPager(QEngine):
 
     def SetPermutation(self, perm: int, phase=None) -> None:
         ph = self._rand_phase() if phase is None else complex(phase)
-        key = self._key("setperm")
+        n, dtype, sh = self.qubit_count, self.dtype, self.sharding
 
         def build():
             def f(p, v):
-                return jnp.zeros((2, 1 << self.qubit_count), dtype=self.dtype).at[:, p].set(v)
+                return jnp.zeros((2, 1 << n), dtype=dtype).at[:, p].set(v)
 
-            return jax.jit(f, out_shardings=self.sharding)
+            return jax.jit(f, out_shardings=sh)
 
-        prog = _program(key + (self.qubit_count,), build)
+        prog = _program(self._key("setperm", n), build)
         self._state = prog(perm, jnp.asarray([ph.real, ph.imag], dtype=self.dtype))
         self.running_norm = 1.0
 
@@ -619,10 +629,12 @@ class QPager(QEngine):
         return gk.from_planes(jax.device_get(self._state[:, offset:offset + length]))
 
     def SetAmplitudePage(self, page, offset: int) -> None:
+        sh = self.sharding
+
         def build():
             return jax.jit(
                 lambda s, v, o: jax.lax.dynamic_update_slice(s, v, (0, o)),
-                out_shardings=self.sharding,
+                out_shardings=sh,
             )
 
         prog = _program(self._key("setpage", len(page)), build)

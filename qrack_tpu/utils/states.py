@@ -1,10 +1,27 @@
-"""Host-side (numpy) state compose/split helpers shared by layers that
-stage structural ops through the host (reference: CombineEngines
-fallback, src/qpager.cpp:316-367)."""
+"""Host-side (numpy) state compose helpers shared by layers that stage
+structural ops through the host (reference: CombineEngines fallback,
+src/qpager.cpp:316-367)."""
 
 from __future__ import annotations
 
 import numpy as np
+
+
+def insertion_axes(n: int, m: int, start: int, lead: int = 0):
+    """Transpose order placing an m-qubit factor at qubit index `start`
+    of an n-qubit state; `lead` extra leading axes pass through (e.g. the
+    real/imag plane axis). Single source of truth for the compose axis
+    algebra (also used by ops/gatekernels.compose)."""
+    axes = list(range(lead))
+    total = n + m
+    for k in range(total - 1, -1, -1):
+        if k < start:
+            axes.append(lead + m + (n - 1 - k))
+        elif k < start + m:
+            axes.append(lead + m - 1 - (k - start))
+        else:
+            axes.append(lead + m + (n - 1 - (k - m)))
+    return axes
 
 
 def compose_states(a: np.ndarray, b: np.ndarray, n: int, m: int, start: int) -> np.ndarray:
@@ -14,13 +31,4 @@ def compose_states(a: np.ndarray, b: np.ndarray, n: int, m: int, start: int) -> 
     if start == n:
         return np.kron(b, a)
     t = np.outer(b, a).reshape((2,) * (m + n))
-    axes = []
-    total = n + m
-    for k in range(total - 1, -1, -1):
-        if k < start:
-            axes.append(m + (n - 1 - k))
-        elif k < start + m:
-            axes.append(m - 1 - (k - start))
-        else:
-            axes.append(m + (n - 1 - (k - m)))
-    return np.transpose(t, axes).reshape(-1).copy()
+    return np.transpose(t, insertion_axes(n, m, start)).reshape(-1).copy()

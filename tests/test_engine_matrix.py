@@ -27,10 +27,21 @@ def _hybrid(n, **kw):
     return QHybrid(n, tpu_threshold_qubits=4, pager_threshold_qubits=7, **kw)
 
 
+def _stabhybrid(n, **kw):
+    from qrack_tpu.layers.stabilizerhybrid import QStabilizerHybrid
+
+    def factory(m, **fkw):
+        fkw.setdefault("rand_global_phase", False)
+        return QEngineCPU(m, **fkw)
+
+    return QStabilizerHybrid(n, engine_factory=factory, **kw)
+
+
 ENGINE_FACTORIES = {
     "tpu": lambda n, **kw: QEngineTPU(n, **kw),
     "pager": _pager,
     "hybrid": _hybrid,
+    "stabhybrid": _stabhybrid,
 }
 
 

@@ -1,0 +1,194 @@
+"""QStabilizer tableau vs the dense oracle on random Clifford circuits.
+
+Reference model: per-gate assertions + cross-engine equivalence
+(test/tests.cpp stabilizer cases)."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.stabilizer import QStabilizer, CliffordError, clifford_sequence
+from qrack_tpu import matrices as mat
+from qrack_tpu.utils.rng import QrackRandom
+
+
+def assert_same_state(stab, dense, atol=1e-8):
+    """Compare up to global phase."""
+    a = stab.GetQuantumState()
+    b = dense.GetQuantumState()
+    fidelity = abs(np.vdot(a, b)) ** 2
+    assert fidelity == pytest.approx(1.0, abs=atol), fidelity
+
+
+def make_pair(n, seed=1):
+    s = QStabilizer(n, rng=QrackRandom(seed))
+    d = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+    return s, d
+
+
+CLIFFORD_1Q = ["H", "X", "Y", "Z", "S", "IS", "SqrtX", "ISqrtX", "SqrtY", "ISqrtY"]
+
+
+def random_clifford(q, rng, depth, n):
+    for _ in range(depth):
+        kind = rng.randint(0, 14)
+        t = rng.randint(0, n)
+        if kind < 10:
+            getattr(q, CLIFFORD_1Q[kind])(t)
+        else:
+            c = rng.randint(0, n)
+            if c == t:
+                continue
+            if kind == 10:
+                q.CNOT(c, t)
+            elif kind == 11:
+                q.CZ(c, t)
+            elif kind == 12:
+                q.Swap(c, t)
+            elif kind == 13:
+                q.CY(c, t)
+
+
+def test_clifford_sequence_covers_group():
+    for name in CLIFFORD_1Q:
+        m = {
+            "H": mat.H2, "X": mat.X2, "Y": mat.Y2, "Z": mat.Z2,
+            "S": mat.S2, "IS": mat.IS2, "SqrtX": mat.SQRTX2, "ISqrtX": mat.ISQRTX2,
+            "SqrtY": mat.SQRTY2, "ISqrtY": mat.ISQRTY2,
+        }[name]
+        assert clifford_sequence(m) is not None, name
+    assert clifford_sequence(mat.T2) is None
+    assert clifford_sequence(mat.u3_mtrx(0.3, 0.1, 0.2)) is None
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_random_clifford_matches_dense(seed):
+    n = 5
+    s, d = make_pair(n, seed)
+    random_clifford(s, QrackRandom(500 + seed), 60, n)
+    random_clifford(d, QrackRandom(500 + seed), 60, n)
+    assert_same_state(s, d)
+
+
+def test_ghz_and_measurement():
+    n = 4
+    s, _ = make_pair(n)
+    s.H(0)
+    for i in range(n - 1):
+        s.CNOT(i, i + 1)
+    assert s.Prob(0) == 0.5
+    assert s.Prob(3) == 0.5
+    s.rng.seed(7)
+    m0 = s.M(0)
+    # all qubits now deterministic and equal
+    for q in range(n):
+        assert s.Prob(q) == (1.0 if m0 else 0.0)
+    assert s.M(3) == m0
+
+
+def test_force_m():
+    s, _ = make_pair(2)
+    s.H(0)
+    s.CNOT(0, 1)
+    s.ForceM(0, True)
+    assert s.Prob(1) == 1.0
+    with pytest.raises(RuntimeError):
+        s.ForceM(1, False)
+
+
+def test_measurement_statistics():
+    ones = 0
+    rng = QrackRandom(99)
+    for _ in range(300):
+        s = QStabilizer(1, rng=rng.spawn())
+        s.H(0)
+        if s.M(0):
+            ones += 1
+    assert 100 < ones < 200
+
+
+def test_non_clifford_raises():
+    s, _ = make_pair(2)
+    with pytest.raises(CliffordError):
+        s.T(0)
+    with pytest.raises(CliffordError):
+        s.MCMtrx((0,), mat.H2, 1)  # controlled-H is not Clifford
+    with pytest.raises(CliffordError):
+        s.CCNOT(0, 1, 1) if False else s.MCMtrxPerm((0, 1), mat.X2, 1, 3)
+
+
+def test_anti_controlled():
+    s, d = make_pair(2)
+    s.AntiCNOT(0, 1)
+    d.AntiCNOT(0, 1)
+    assert_same_state(s, d)
+    assert s.Prob(1) == 1.0  # control q0=0 -> target flipped
+
+
+def test_compose_and_dispose():
+    s1, _ = make_pair(2, seed=3)
+    s1.H(0)
+    s1.CNOT(0, 1)
+    s2 = QStabilizer(1, rng=QrackRandom(4))
+    s2.X(0)
+    start = s1.Compose(s2)
+    assert start == 2 and s1.GetQubitCount() == 3
+    d = QEngineCPU(3, rng=QrackRandom(1), rand_global_phase=False)
+    d.H(0)
+    d.CNOT(0, 1)
+    d.X(2)
+    assert_same_state(s1, d)
+    # dispose the measured qubit
+    s1.ForceM(0, True)
+    s1.Dispose(0, 1)
+    assert s1.GetQubitCount() == 2
+    assert s1.Prob(0) == 1.0  # old q1 followed q0 via CNOT
+    assert s1.Prob(1) == 1.0  # old q2 was X'd
+
+
+def test_separability_checks():
+    s, _ = make_pair(2)
+    s.H(0)
+    assert s.IsSeparableX(0)
+    assert not s.IsSeparableZ(0)
+    s2 = QStabilizer(2, rng=QrackRandom(1))
+    assert s2.IsSeparableZ(0)
+    s2.H(0)
+    s2.CNOT(0, 1)
+    assert not s2.IsSeparableZ(0)
+    assert not s2.IsSeparableX(0)
+    s3 = QStabilizer(1, rng=QrackRandom(2))
+    s3.H(0)
+    s3.S(0)
+    assert s3.IsSeparableY(0)
+
+
+def test_set_quantum_state_synthesis():
+    # random stabilizer kets round-trip through synthesis
+    for seed in (1, 2, 3):
+        n = 4
+        d = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+        random_clifford(d, QrackRandom(700 + seed), 40, n)
+        ket = d.GetQuantumState()
+        s = QStabilizer(n, rng=QrackRandom(seed))
+        s.SetQuantumState(ket)
+        fid = abs(np.vdot(s.GetQuantumState(), ket)) ** 2
+        assert fid == pytest.approx(1.0, abs=1e-8)
+
+
+def test_sampling_through_default_api():
+    s, _ = make_pair(3)
+    s.H(0)
+    s.CNOT(0, 1)
+    s.CNOT(1, 2)
+    shots = s.MultiShotMeasureMask([1, 2, 4], 300)
+    assert set(shots.keys()) <= {0, 7}
+
+
+def test_near_clifford_rotation_not_misrecognized():
+    # regression: coarse key quantization must not match small rotations
+    import math
+    for th in (0.055, 0.1, 0.2, 0.753):
+        c, s_ = math.cos(th), math.sin(th)
+        m = np.array([[c, -s_], [s_, c]])
+        assert clifford_sequence(m) is None, th

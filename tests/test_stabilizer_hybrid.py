@@ -1,0 +1,188 @@
+"""QStabilizerHybrid: tableau fast path, shard buffering, engine switch."""
+
+import math
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.stabilizerhybrid import QStabilizerHybrid
+from qrack_tpu.utils.rng import QrackRandom
+
+from test_engine_matrix import random_circuit
+from test_stabilizer import random_clifford
+
+
+def factory(n, **kw):
+    kw.setdefault("rand_global_phase", False)
+    kw.pop("engine_factory", None)
+    return QEngineCPU(n, **kw)
+
+
+def make(n, seed=1):
+    return QStabilizerHybrid(n, engine_factory=factory, rng=QrackRandom(seed),
+                             rand_global_phase=False)
+
+
+def oracle(n, seed=1):
+    return QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+
+
+def fid(a, b):
+    return abs(np.vdot(a.GetQuantumState(), b.GetQuantumState())) ** 2
+
+
+def test_stays_clifford_on_clifford_circuits():
+    n = 6
+    q = make(n)
+    o = oracle(n)
+    random_clifford(q, QrackRandom(11), 80, n)
+    random_clifford(o, QrackRandom(11), 80, n)
+    assert q.isClifford()
+    assert q.engine is None  # never materialized
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_shard_buffer_folds_back():
+    # T then T = S: stays on tableau
+    q = make(2)
+    q.H(0)
+    q.T(0)
+    assert not q.isClifford(0)  # shard pending
+    assert q.engine is None
+    q.T(0)
+    assert q.isClifford(0)  # folded: T*T = S
+    assert q.engine is None
+    o = oracle(2)
+    o.H(0); o.T(0); o.T(0)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_diagonal_shard_on_control_stays_tableau():
+    # T on a CNOT control commutes (diagonal): must NOT materialize
+    n = 4
+    q = make(n)
+    o = oracle(n)
+    for eng in (q, o):
+        eng.H(0)
+        eng.T(0)
+        eng.CNOT(0, 1)
+        eng.H(1)
+        eng.T(1)
+        eng.CZ(1, 2)
+    assert q.engine is None
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-7)
+
+
+def test_non_clifford_switches_engine():
+    n = 4
+    q = make(n)
+    o = oracle(n)
+    for eng in (q, o):
+        eng.H(0)
+        eng.RY(0.7, 1)   # non-diagonal, non-Clifford shard on q1
+        eng.CNOT(0, 1)   # entangling through the shard target -> switch
+    assert q.engine is not None
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-7)
+
+
+def test_diagonal_shard_commutes_with_cz():
+    q = make(3)
+    o = oracle(3)
+    for eng in (q, o):
+        eng.H(0)
+        eng.H(1)
+        eng.T(0)       # diagonal shard
+        eng.CZ(0, 1)   # diagonal controlled gate commutes: stay on tableau
+    assert q.engine is None
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_measurement_on_tableau_and_engine():
+    q = make(3, seed=5)
+    q.H(0)
+    q.CNOT(0, 1)
+    q.rng.seed(9)
+    m = q.M(0)
+    assert q.M(1) == m
+    assert q.engine is None
+    # now force a switch and measure
+    q2 = make(3, seed=5)
+    q2.H(0)
+    q2.RY(0.7, 0)
+    assert q2.Prob(0) != pytest.approx(0.5, abs=1e-3)
+    q2.CNOT(0, 2)
+    q2.M(2)
+    assert q2.engine is not None
+
+
+def test_random_universal_matches_oracle():
+    n = 5
+    for seed in (1, 2):
+        q = make(n, seed)
+        o = oracle(n, seed)
+        random_circuit(q, QrackRandom(300 + seed), 40, n)
+        random_circuit(o, QrackRandom(300 + seed), 40, n)
+        assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_alu_through_hybrid():
+    q = make(7)
+    o = oracle(7)
+    for eng in (q, o):
+        eng.HReg(0, 3)
+        eng.INC(3, 0, 4)
+        eng.T(0)
+        eng.INC(1, 0, 4)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_compose_on_tableau():
+    a = make(2, seed=3)
+    a.H(0)
+    a.CNOT(0, 1)
+    b = make(1, seed=4)
+    b.X(0)
+    a.Compose(b)
+    assert a.qubit_count == 3
+    assert a.engine is None
+    o = oracle(3)
+    o.H(0); o.CNOT(0, 1); o.X(2)
+    assert fid(a, o) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_teleport_through_hybrid():
+    ok = 0
+    for t in range(10):
+        q = QStabilizerHybrid(3, engine_factory=factory, rng=QrackRandom(40 + t))
+        q.U(0, 0.8, 0.3, -0.5)
+        want = q.Prob(0)
+        q.H(1); q.CNOT(1, 2)
+        q.CNOT(0, 1); q.H(0)
+        m0, m1 = q.M(0), q.M(1)
+        if m1: q.X(2)
+        if m0: q.Z(2)
+        ok += abs(q.Prob(2) - want) < 1e-6
+    assert ok == 10
+
+
+def test_dispose_fresh_allocated_qubits():
+    # regression: disposing freshly-allocated |0> qubits after a random
+    # Clifford circuit must not crash (synthesis is now complete)
+    for seed in range(20):
+        q = make(3, seed)
+        random_clifford(q, QrackRandom(800 + seed), 25, 3)
+        q.Allocate(3, 2)
+        q.Dispose(3, 2)
+        assert q.qubit_count == 3
+
+
+def test_mid_insertion_compose_falls_to_engine():
+    a = make(3, seed=9)
+    a.H(0)
+    b = make(1, seed=10)
+    b.X(0)
+    start = a.Compose(b, 0)  # mid-insertion: tableau can't, engine can
+    assert start == 0 and a.qubit_count == 4
+    assert a.Prob(0) == pytest.approx(1.0, abs=1e-6)
+    assert a.Prob(1) == pytest.approx(0.5, abs=1e-6)
