@@ -1,0 +1,756 @@
+"""QUnit: Schmidt-decomposition qubit factoring.
+
+Re-design of the reference's largest optimizer layer (reference:
+include/qunit.hpp:28, src/qunit.cpp — arXiv:1710.05867): only entangled
+clumps of qubits pay exponential cost. Each logical qubit owns a shard
+(reference: include/qengineshard.hpp:32-100) that is either
+
+  * a cached single-qubit state (amp0, amp1) — gates on it are 2-vector
+    host math, no engine at all, or
+  * a (unit, mapped) reference into a shared lower-layer instance.
+
+Entangling ops Compose the participating units (reference:
+EntangleInCurrentBasis src/qunit.cpp:431, EntangleRange :565-618,
+OrderContiguous :857); measurement and TrySeparate split them back
+(SeparateBit :1350, TrySeparate :696). Controls with definite cached
+values are elided (TrimControls :2549). Swap of two logical qubits is a
+pure shard exchange (no engine work).
+
+Round-1 scope notes: the reference's Pauli-basis shard tags, buffered
+phase-shard fusion, and ACE fidelity-degradation paths
+(include/qunit.hpp:107-128) are later-round performance/approximation
+extensions; this layer is exact (GetUnitaryFidelity == 1).
+"""
+
+from __future__ import annotations
+
+import cmath
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import FP_NORM_EPSILON, TRYDECOMPOSE_EPSILON
+from ..interface import QInterface
+from .. import matrices as mat
+
+
+def _default_unit_factory(n, **kw):
+    from .stabilizerhybrid import QStabilizerHybrid
+
+    return QStabilizerHybrid(n, **kw)
+
+
+class _Shard:
+    __slots__ = ("unit", "mapped", "amp0", "amp1")
+
+    def __init__(self, amp0=1.0 + 0j, amp1=0.0 + 0j):
+        self.unit = None
+        self.mapped = 0
+        self.amp0 = complex(amp0)
+        self.amp1 = complex(amp1)
+
+    @property
+    def cached(self) -> bool:
+        return self.unit is None
+
+
+class QUnit(QInterface):
+    def __init__(self, qubit_count: int, init_state: int = 0,
+                 unit_factory: Optional[Callable] = None,
+                 separability_threshold: Optional[float] = None, **kwargs):
+        super().__init__(qubit_count, init_state=init_state, **kwargs)
+        self._factory = unit_factory or _default_unit_factory
+        self._unit_kwargs = {k: v for k, v in kwargs.items() if k != "rng"}
+        # TrySeparate tolerance (reference: QRACK_QUNIT_SEPARABILITY_THRESHOLD)
+        self.sep_threshold = (
+            separability_threshold if separability_threshold is not None
+            else max(self.config.separability_threshold, TRYDECOMPOSE_EPSILON)
+        )
+        self.reactive_separate = True
+        self.shards: List[_Shard] = []
+        for q in range(qubit_count):
+            s = _Shard()
+            if (init_state >> q) & 1:
+                s.amp0, s.amp1 = 0.0 + 0j, 1.0 + 0j
+            self.shards.append(s)
+
+    def SetReactiveSeparate(self, flag: bool) -> None:
+        self.reactive_separate = bool(flag)
+
+    def GetReactiveSeparate(self) -> bool:
+        return self.reactive_separate
+
+    # ------------------------------------------------------------------
+    # shard/unit plumbing
+    # ------------------------------------------------------------------
+
+    def _unit_qubits(self, unit) -> List[int]:
+        """Logical qubits living in `unit`, sorted by mapped index."""
+        qs = [q for q, s in enumerate(self.shards) if s.unit is unit]
+        qs.sort(key=lambda q: self.shards[q].mapped)
+        return qs
+
+    def _to_unit(self, q: int):
+        s = self.shards[q]
+        if s.unit is not None:
+            return s.unit
+        eng = self._factory(1, rng=self.rng.spawn(), **self._unit_kwargs)
+        eng.SetQuantumState(np.array([s.amp0, s.amp1], dtype=np.complex128))
+        s.unit = eng
+        s.mapped = 0
+        return eng
+
+    def _merge(self, qubits: Sequence[int]):
+        """Compose the units behind `qubits` into one; returns it."""
+        units = []
+        for q in qubits:
+            u = self._to_unit(q)
+            if all(u is not v for v in units):
+                units.append(u)
+        base = units[0]
+        for u in units[1:]:
+            offset = base.qubit_count
+            base.Compose(u)
+            for s in self.shards:
+                if s.unit is u:
+                    s.unit = base
+                    s.mapped += offset
+        return base
+
+    def _order_contiguous(self, qubits: Sequence[int]) -> Tuple[object, int]:
+        """Entangle `qubits` into one unit and arrange them at consecutive
+        mapped positions in the given order (reference: EntangleRange +
+        OrderContiguous, src/qunit.cpp:565-883). Returns (unit, base)."""
+        unit = self._merge(qubits)
+        members = self._unit_qubits(unit)
+        base = min(self.shards[q].mapped for q in qubits)
+        # place qubits[i] at mapped position base + i by in-unit swaps
+        pos_of = {q: self.shards[q].mapped for q in members}
+        qubit_at = {m: q for q, m in pos_of.items()}
+        for i, q in enumerate(qubits):
+            want = base + i
+            cur = pos_of[q]
+            if cur == want:
+                continue
+            other = qubit_at[want]
+            unit.Swap(cur, want)
+            pos_of[q], pos_of[other] = want, cur
+            qubit_at[want], qubit_at[cur] = q, other
+        for q in members:
+            self.shards[q].mapped = pos_of[q]
+        return unit, base
+
+    def _release_if_single(self, unit) -> None:
+        """Collapse a 1-qubit unit back into a cached shard."""
+        if unit.qubit_count != 1:
+            return
+        qs = self._unit_qubits(unit)
+        if len(qs) != 1:
+            return
+        st = np.asarray(unit.GetQuantumState(), dtype=np.complex128)
+        s = self.shards[qs[0]]
+        s.unit = None
+        s.mapped = 0
+        s.amp0, s.amp1 = complex(st[0]), complex(st[1])
+
+    def _separate_bit(self, q: int, value: bool) -> None:
+        """Drop a just-measured qubit out of its unit and re-register it
+        as a cached eigenstate (reference: SeparateBit, src/qunit.cpp:1350)."""
+        s = self.shards[q]
+        unit = s.unit
+        if unit is None:
+            s.amp0, s.amp1 = ((0j, 1 + 0j) if value else (1 + 0j, 0j))
+            return
+        mapped = s.mapped
+        if unit.qubit_count == 1:
+            s.unit = None
+            s.mapped = 0
+            s.amp0, s.amp1 = ((0j, 1 + 0j) if value else (1 + 0j, 0j))
+            return
+        unit.Dispose(mapped, 1, 1 if value else 0)
+        for other in self.shards:
+            if other.unit is unit and other.mapped > mapped:
+                other.mapped -= 1
+        s.unit = None
+        s.mapped = 0
+        s.amp0, s.amp1 = ((0j, 1 + 0j) if value else (1 + 0j, 0j))
+        self._release_if_single(unit)
+
+    # ------------------------------------------------------------------
+    # gate primitive with control trimming
+    # ------------------------------------------------------------------
+
+    def _trim_controls(self, controls, perm) -> Optional[Tuple[tuple, int]]:
+        """Elide controls whose cached value is definite (reference:
+        TrimControls, src/qunit.cpp:2549). Returns None if the gate
+        cannot fire; else (live_controls, live_perm)."""
+        live: List[int] = []
+        live_perm = 0
+        for j, c in enumerate(controls):
+            want = (perm >> j) & 1
+            s = self.shards[c]
+            if s.cached:
+                p1 = abs(s.amp1) ** 2
+                if p1 <= FP_NORM_EPSILON:
+                    have = 0
+                elif p1 >= 1.0 - FP_NORM_EPSILON:
+                    have = 1
+                else:
+                    have = None
+                if have is not None:
+                    if have != want:
+                        return None
+                    continue
+            if want:
+                live_perm |= 1 << len(live)
+            live.append(c)
+        return tuple(live), live_perm
+
+    def MCMtrxPerm(self, controls, mtrx, target, perm) -> None:
+        self._check_qubit(target)
+        m = np.asarray(mtrx, dtype=np.complex128).reshape(2, 2)
+        trimmed = self._trim_controls(tuple(controls), perm)
+        if trimmed is None:
+            return
+        live, live_perm = trimmed
+        s = self.shards[target]
+        if not live:
+            if s.cached:
+                a0 = m[0, 0] * s.amp0 + m[0, 1] * s.amp1
+                a1 = m[1, 0] * s.amp0 + m[1, 1] * s.amp1
+                s.amp0, s.amp1 = a0, a1
+            else:
+                s.unit.MCMtrxPerm((), m, s.mapped, 0)
+            return
+        unit = self._merge(tuple(live) + (target,))
+        mapped_ctrls = tuple(self.shards[c].mapped for c in live)
+        unit.MCMtrxPerm(mapped_ctrls, m, self.shards[target].mapped, live_perm)
+
+    def Swap(self, q1: int, q2: int) -> None:
+        """Logical shard exchange — zero engine work (reference:
+        src/qunit.cpp Swap)."""
+        if q1 == q2:
+            return
+        self.shards[q1], self.shards[q2] = self.shards[q2], self.shards[q1]
+
+    def Apply4x4(self, m: np.ndarray, q1: int, q2: int) -> None:
+        unit = self._merge((q1, q2))
+        if hasattr(unit, "Apply4x4"):
+            unit.Apply4x4(m, self.shards[q1].mapped, self.shards[q2].mapped)
+        else:
+            from ..interface.synth import apply_small_unitary_via_primitive
+
+            apply_small_unitary_via_primitive(self, m, (q1, q2))
+
+    # ------------------------------------------------------------------
+    # measurement / probability
+    # ------------------------------------------------------------------
+
+    def Prob(self, q: int) -> float:
+        self._check_qubit(q)
+        s = self.shards[q]
+        if s.cached:
+            nrm = abs(s.amp0) ** 2 + abs(s.amp1) ** 2
+            return (abs(s.amp1) ** 2) / nrm if nrm > 0 else 0.0
+        return s.unit.Prob(s.mapped)
+
+    def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
+        self._check_qubit(q)
+        s = self.shards[q]
+        p1 = self.Prob(q)
+        if do_force:
+            res = bool(result)
+        elif p1 >= 1.0 - FP_NORM_EPSILON:
+            res = True
+        elif p1 <= FP_NORM_EPSILON:
+            res = False
+        else:
+            res = self.Rand() <= p1
+        nrm_sq = p1 if res else (1.0 - p1)
+        if nrm_sq <= 0.0:
+            raise RuntimeError("ForceM: forced result has zero probability")
+        if not do_apply:
+            return res
+        unit = s.unit
+        if not s.cached:
+            s.unit.ForceM(s.mapped, res, do_force=True)
+        self._separate_bit(q, res)
+        if unit is not None and self.reactive_separate:
+            # collapse often disentangles the rest (e.g. GHZ): peel off any
+            # member that became a Z eigenstate (reference: reactive
+            # TrySeparate on measurement, include/qunit.hpp SetReactiveSeparate)
+            for qq in list(self._unit_qubits(unit)):
+                ss = self.shards[qq]
+                if ss.unit is None:
+                    continue
+                p = ss.unit.Prob(ss.mapped)
+                if p <= FP_NORM_EPSILON:
+                    ss.unit.ForceM(ss.mapped, False, do_force=True)
+                    self._separate_bit(qq, False)
+                elif p >= 1.0 - FP_NORM_EPSILON:
+                    ss.unit.ForceM(ss.mapped, True, do_force=True)
+                    self._separate_bit(qq, True)
+        return res
+
+    def MAll(self) -> int:
+        """Per-unit measurement: cached qubits draw directly; each unit
+        measures once (reference: src/qunit.cpp:1534)."""
+        result = 0
+        done_units: Dict[int, int] = {}
+        for q in range(self.qubit_count):
+            s = self.shards[q]
+            if s.cached:
+                p1 = self.Prob(q)
+                if p1 >= 1.0 - FP_NORM_EPSILON:
+                    bit = True
+                elif p1 <= FP_NORM_EPSILON:
+                    bit = False
+                else:
+                    bit = self.Rand() <= p1
+                if bit:
+                    result |= 1 << q
+                s.amp0, s.amp1 = ((0j, 1 + 0j) if bit else (1 + 0j, 0j))
+            else:
+                uid = id(s.unit)
+                if uid not in done_units:
+                    s.unit.rng = self.rng
+                    done_units[uid] = s.unit.MAll()
+                if (done_units[uid] >> s.mapped) & 1:
+                    result |= 1 << q
+        # everything is separable now
+        for q in range(self.qubit_count):
+            s = self.shards[q]
+            if not s.cached:
+                bit = bool((result >> q) & 1)
+                s.unit = None
+                s.mapped = 0
+                s.amp0, s.amp1 = ((0j, 1 + 0j) if bit else (1 + 0j, 0j))
+        return result
+
+    def ProbParity(self, mask: int) -> float:
+        bits = [q for q in range(self.qubit_count) if (mask >> q) & 1]
+        # split by unit: parity distribution composes by XOR convolution
+        groups: Dict[int, List[int]] = {}
+        singles: List[int] = []
+        for q in bits:
+            s = self.shards[q]
+            if s.cached:
+                singles.append(q)
+            else:
+                groups.setdefault(id(s.unit), []).append(q)
+        odds: List[float] = [self.Prob(q) for q in singles]
+        for qs in groups.values():
+            unit = self.shards[qs[0]].unit
+            sub_mask = 0
+            for q in qs:
+                sub_mask |= 1 << self.shards[q].mapped
+            odds.append(unit.ProbParity(sub_mask))
+        p = 0.0
+        for o in odds:
+            p = p * (1 - o) + (1 - p) * o
+        return p
+
+    # ------------------------------------------------------------------
+    # separation (reference: TrySeparate, src/qunit.cpp:696-781)
+    # ------------------------------------------------------------------
+
+    def TrySeparate(self, qubits, error_tol: Optional[float] = None) -> bool:
+        if isinstance(qubits, (int, np.integer)):
+            qubits = (int(qubits),)
+        tol = error_tol if error_tol is not None else self.sep_threshold
+        ok = True
+        for q in qubits:
+            ok &= self._try_separate_1qb(q, tol)
+        return ok
+
+    def _try_separate_1qb(self, q: int, tol: float) -> bool:
+        s = self.shards[q]
+        if s.cached:
+            return True
+        unit = s.unit
+        # Z-basis eigenstate?
+        p1 = unit.Prob(s.mapped)
+        if p1 <= tol:
+            unit.ForceM(s.mapped, False, do_force=True)
+            self._separate_bit(q, False)
+            return True
+        if p1 >= 1.0 - tol:
+            unit.ForceM(s.mapped, True, do_force=True)
+            self._separate_bit(q, True)
+            return True
+        # X/Y basis probes via cheap conjugation
+        for basis, fwd, inv in (
+            ("x", (mat.H2,), (mat.H2,)),
+            ("y", (mat.H2, mat.IS2), (mat.S2, mat.H2)),
+        ):
+            for g in fwd:
+                unit.MCMtrxPerm((), g, s.mapped, 0)
+            p = unit.Prob(s.mapped)
+            if p <= tol or p >= 1.0 - tol:
+                val = p >= 0.5
+                unit.ForceM(s.mapped, val, do_force=True)
+                self._separate_bit(q, val)
+                ns = self.shards[q]
+                vec = np.array([ns.amp0, ns.amp1], dtype=np.complex128)
+                for g in inv:
+                    vec = np.asarray(g) @ vec
+                ns.amp0, ns.amp1 = complex(vec[0]), complex(vec[1])
+                return True
+            for g in inv:
+                unit.MCMtrxPerm((), g, s.mapped, 0)
+        return False
+
+    # speculative decompose with error check (reference: TryDecompose,
+    # include/qinterface.hpp:452; engine TryDecompose + TRYDECOMPOSE_EPSILON)
+    def TryDecompose(self, start: int, dest, error_tol: float = TRYDECOMPOSE_EPSILON) -> bool:
+        clone = self.Clone()
+        try:
+            clone.Decompose(start, dest)
+        except Exception:
+            return False
+        # verify the product reconstructs the original
+        rebuilt = clone
+        rebuilt.Compose(dest.Clone() if hasattr(dest, "Clone") else dest, start)
+        if rebuilt.SumSqrDiff(self) > error_tol:
+            return False
+        self.Decompose(start, dest)
+        return True
+
+    # ------------------------------------------------------------------
+    # structure
+    # ------------------------------------------------------------------
+
+    def Compose(self, other: "QUnit", start: Optional[int] = None) -> int:
+        if start is None:
+            start = self.qubit_count
+        if isinstance(other, QUnit):
+            clone = other.Clone()
+            new_shards = clone.shards
+        else:
+            # foreign engine: wrap it as one unit
+            eng = other.Clone() if hasattr(other, "Clone") else other
+            new_shards = []
+            for i in range(eng.qubit_count):
+                s = _Shard()
+                s.unit = eng
+                s.mapped = i
+                new_shards.append(s)
+        self.shards[start:start] = new_shards
+        self.qubit_count += len(new_shards)
+        return start
+
+    def Decompose(self, start: int, dest) -> None:
+        length = dest.qubit_count
+        self._check_range(start, length)
+        qubits = list(range(start, start + length))
+        # if the span is exactly a set of whole units + cached shards,
+        # hand them over without touching amplitudes
+        clean = all(
+            self.shards[q].cached or
+            all((qq in qubits) for qq in self._unit_qubits(self.shards[q].unit))
+            for q in qubits
+        )
+        state = None
+        if clean:
+            tmp = QUnit(length, unit_factory=self._factory, rng=self.rng.spawn(),
+                        **self._unit_kwargs)
+            tmp.shards = [self.shards[q] for q in qubits]
+            # remap inside tmp: keep unit refs, mapped stays valid
+            state = tmp.GetQuantumState()
+        else:
+            unit, base = self._order_contiguous(qubits)
+            tmp_dest = self._factory(length, rng=self.rng.spawn(), **self._unit_kwargs)
+            unit.Decompose(base, tmp_dest)
+            for other in self.shards:
+                if other.unit is unit and other.mapped >= base + length:
+                    other.mapped -= length
+            state = np.asarray(tmp_dest.GetQuantumState(), dtype=np.complex128)
+            # detach the span's shards before probing the leftover unit,
+            # or the 1-qubit release check sees stale members
+            for q in qubits:
+                self.shards[q].unit = None
+            self._release_if_single(unit)
+        dest.SetQuantumState(state)
+        del self.shards[start:start + length]
+        self.qubit_count -= length
+
+    def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
+        self._check_range(start, length)
+        if disposed_perm is not None:
+            for i in range(length):
+                self.ForceM(start + i, bool((disposed_perm >> i) & 1))
+        else:
+            for i in range(length):
+                if not self.shards[start + i].cached:
+                    # measure it out (separable disposal contract)
+                    self.M(start + i)
+        del self.shards[start:start + length]
+        self.qubit_count -= length
+
+    def Allocate(self, start: int, length: int = 1) -> int:
+        if start < 0 or start > self.qubit_count:
+            raise ValueError(f"Allocate start {start} out of range (n={self.qubit_count})")
+        self.shards[start:start] = [_Shard() for _ in range(length)]
+        self.qubit_count += length
+        return start
+
+    # ------------------------------------------------------------------
+    # ALU / register ops: entangle the span, forward to the unit
+    # (reference: QUnit ALU forwarding via EntangleRange)
+    # ------------------------------------------------------------------
+
+    def _reg_op(self, name, regs: Sequence[Tuple[int, int]], extra_bits: Sequence[int],
+                call: Callable) -> None:
+        """Entangle all registers + extra bits contiguously and invoke
+        `call(unit, bases, extra_mapped)`."""
+        qubits: List[int] = []
+        for (st, ln) in regs:
+            qubits.extend(range(st, st + ln))
+        qubits.extend(extra_bits)
+        unit, base = self._order_contiguous(qubits)
+        bases = []
+        off = base
+        for (st, ln) in regs:
+            bases.append(off)
+            off += ln
+        extra_mapped = list(range(off, off + len(extra_bits)))
+        call(unit, bases, extra_mapped)
+
+    def INC(self, to_add: int, start: int, length: int) -> None:
+        if not length:
+            return
+        self._reg_op("INC", [(start, length)], [],
+                     lambda u, b, e: u.INC(to_add, b[0], length))
+
+    def CINC(self, to_add: int, start: int, length: int, controls) -> None:
+        trimmed = self._trim_controls(tuple(controls), (1 << len(controls)) - 1)
+        if trimmed is None:
+            return
+        live, _ = trimmed
+        if not live:
+            return self.INC(to_add, start, length)
+        self._reg_op("CINC", [(start, length)], list(live),
+                     lambda u, b, e: u.CINC(to_add, b[0], length, tuple(e)))
+
+    def INCDECC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
+        self._reg_op("INCDECC", [(start, length)], [carry_index],
+                     lambda u, b, e: u.INCDECC(to_add, b[0], length, e[0]))
+
+    def INCS(self, to_add: int, start: int, length: int, overflow_index: int) -> None:
+        self._reg_op("INCS", [(start, length)], [overflow_index],
+                     lambda u, b, e: u.INCS(to_add, b[0], length, e[0]))
+
+    def INCDECSC(self, to_add: int, start: int, length: int, *flags) -> None:
+        self._reg_op("INCDECSC", [(start, length)], list(flags),
+                     lambda u, b, e: u.INCDECSC(to_add, b[0], length, *e))
+
+    def MUL(self, to_mul: int, in_out_start: int, carry_start: int, length: int) -> None:
+        self._reg_op("MUL", [(in_out_start, length), (carry_start, length)], [],
+                     lambda u, b, e: u.MUL(to_mul, b[0], b[1], length))
+
+    def DIV(self, to_div: int, in_out_start: int, carry_start: int, length: int) -> None:
+        self._reg_op("DIV", [(in_out_start, length), (carry_start, length)], [],
+                     lambda u, b, e: u.DIV(to_div, b[0], b[1], length))
+
+    def CMUL(self, to_mul, in_out_start, carry_start, length, controls) -> None:
+        self._reg_op("CMUL", [(in_out_start, length), (carry_start, length)],
+                     list(controls),
+                     lambda u, b, e: u.CMUL(to_mul, b[0], b[1], length, tuple(e)))
+
+    def CDIV(self, to_div, in_out_start, carry_start, length, controls) -> None:
+        self._reg_op("CDIV", [(in_out_start, length), (carry_start, length)],
+                     list(controls),
+                     lambda u, b, e: u.CDIV(to_div, b[0], b[1], length, tuple(e)))
+
+    def MULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
+        ol = self._mod_out_length(mod_n)
+        self._reg_op("MULModNOut", [(in_start, length), (out_start, ol)], [],
+                     lambda u, b, e: u.MULModNOut(to_mul, mod_n, b[0], b[1], length))
+
+    def IMULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
+        ol = self._mod_out_length(mod_n)
+        self._reg_op("IMULModNOut", [(in_start, length), (out_start, ol)], [],
+                     lambda u, b, e: u.IMULModNOut(to_mul, mod_n, b[0], b[1], length))
+
+    def POWModNOut(self, base, mod_n, in_start, out_start, length) -> None:
+        ol = self._mod_out_length(mod_n)
+        self._reg_op("POWModNOut", [(in_start, length), (out_start, ol)], [],
+                     lambda u, b, e: u.POWModNOut(base, mod_n, b[0], b[1], length))
+
+    def IndexedLDA(self, index_start, index_length, value_start, value_length, values,
+                   reset_value: bool = True) -> int:
+        out = []
+        self._reg_op("IndexedLDA", [(index_start, index_length),
+                                    (value_start, value_length)], [],
+                     lambda u, b, e: out.append(u.IndexedLDA(
+                         b[0], index_length, b[1], value_length, values, reset_value)))
+        return out[0]
+
+    def IndexedADC(self, index_start, index_length, value_start, value_length,
+                   carry_index, values) -> int:
+        out = []
+        self._reg_op("IndexedADC", [(index_start, index_length),
+                                    (value_start, value_length)], [carry_index],
+                     lambda u, b, e: out.append(u.IndexedADC(
+                         b[0], index_length, b[1], value_length, e[0], values)))
+        return out[0]
+
+    def IndexedSBC(self, index_start, index_length, value_start, value_length,
+                   carry_index, values) -> int:
+        out = []
+        self._reg_op("IndexedSBC", [(index_start, index_length),
+                                    (value_start, value_length)], [carry_index],
+                     lambda u, b, e: out.append(u.IndexedSBC(
+                         b[0], index_length, b[1], value_length, e[0], values)))
+        return out[0]
+
+    def Hash(self, start: int, length: int, values) -> None:
+        self._reg_op("Hash", [(start, length)], [],
+                     lambda u, b, e: u.Hash(b[0], length, values))
+
+    def PhaseFlipIfLess(self, greater_perm: int, start: int, length: int) -> None:
+        self._reg_op("PhaseFlipIfLess", [(start, length)], [],
+                     lambda u, b, e: u.PhaseFlipIfLess(greater_perm, b[0], length))
+
+    def CPhaseFlipIfLess(self, greater_perm: int, start: int, length: int,
+                         flag_index: int) -> None:
+        self._reg_op("CPhaseFlipIfLess", [(start, length)], [flag_index],
+                     lambda u, b, e: u.CPhaseFlipIfLess(greater_perm, b[0], length, e[0]))
+
+    # ------------------------------------------------------------------
+    # state access
+    # ------------------------------------------------------------------
+
+    def GetQuantumState(self) -> np.ndarray:
+        n = self.qubit_count
+        # factor order: cached qubits and first-appearance units
+        factors: List[Tuple[np.ndarray, List[int]]] = []
+        seen = set()
+        for q in range(n):
+            s = self.shards[q]
+            if s.cached:
+                vec = np.array([s.amp0, s.amp1], dtype=np.complex128)
+                nrm = np.linalg.norm(vec)
+                if nrm > 0:
+                    vec = vec / nrm
+                factors.append((vec, [q]))
+            elif id(s.unit) not in seen:
+                seen.add(id(s.unit))
+                qs = self._unit_qubits(s.unit)
+                factors.append((np.asarray(s.unit.GetQuantumState(),
+                                           dtype=np.complex128), qs))
+        raw = np.array([1.0 + 0j])
+        order: List[int] = []  # raw bit position -> logical qubit
+        for (vec, qs) in factors:
+            raw = np.kron(vec, raw)
+            order.extend(qs)
+        # permute raw bit positions into logical order
+        out = np.zeros(1 << n, dtype=np.complex128)
+        idx = np.arange(1 << n, dtype=np.int64)
+        logical = np.zeros_like(idx)
+        for pos, q in enumerate(order):
+            logical |= ((idx >> pos) & 1) << q
+        out[logical] = raw
+        return out
+
+    def SetQuantumState(self, state) -> None:
+        state = np.asarray(state, dtype=np.complex128).reshape(-1)
+        if state.shape[0] != (1 << self.qubit_count):
+            raise ValueError("state length mismatch")
+        unit = self._factory(self.qubit_count, rng=self.rng.spawn(), **self._unit_kwargs)
+        unit.SetQuantumState(state)
+        for q in range(self.qubit_count):
+            s = self.shards[q]
+            s.unit = unit
+            s.mapped = q
+        # opportunistic re-factoring
+        for q in range(self.qubit_count):
+            self._try_separate_1qb(q, TRYDECOMPOSE_EPSILON)
+
+    def GetAmplitude(self, perm: int) -> complex:
+        amp = 1.0 + 0j
+        seen = {}
+        for q in range(self.qubit_count):
+            s = self.shards[q]
+            if s.cached:
+                vec = np.array([s.amp0, s.amp1])
+                nrm = np.linalg.norm(vec)
+                a = (vec / nrm)[(perm >> q) & 1] if nrm > 0 else 0.0
+                amp *= a
+            else:
+                uid = id(s.unit)
+                if uid in seen:
+                    continue
+                seen[uid] = True
+                sub = 0
+                for qq in self._unit_qubits(s.unit):
+                    if (perm >> qq) & 1:
+                        sub |= 1 << self.shards[qq].mapped
+                amp *= s.unit.GetAmplitude(sub)
+        return complex(amp)
+
+    def SetPermutation(self, perm: int, phase=None) -> None:
+        self.shards = []
+        for q in range(self.qubit_count):
+            s = _Shard()
+            if (perm >> q) & 1:
+                s.amp0, s.amp1 = 0j, 1 + 0j
+            self.shards.append(s)
+        if phase is not None or self.rand_global_phase:
+            ph = (cmath.exp(2j * math.pi * self.Rand())
+                  if phase is None else complex(phase))
+            s0 = self.shards[0] if self.shards else None
+            if s0 is not None:
+                if abs(s0.amp1) > 0.5:
+                    s0.amp1 *= ph
+                else:
+                    s0.amp0 *= ph
+
+    def Clone(self) -> "QUnit":
+        c = QUnit(self.qubit_count, unit_factory=self._factory,
+                  rng=self.rng.spawn(), **self._unit_kwargs)
+        cloned: Dict[int, object] = {}
+        c.shards = []
+        for s in self.shards:
+            ns = _Shard(s.amp0, s.amp1)
+            if s.unit is not None:
+                uid = id(s.unit)
+                if uid not in cloned:
+                    cloned[uid] = s.unit.Clone()
+                ns.unit = cloned[uid]
+                ns.mapped = s.mapped
+            c.shards.append(ns)
+        return c
+
+    def SumSqrDiff(self, other) -> float:
+        a = self.GetQuantumState()
+        b = np.asarray(other.GetQuantumState(), dtype=np.complex128)
+        inner = np.vdot(a, b)
+        return float(max(0.0, 1.0 - abs(inner) ** 2))
+
+    def GetProbs(self) -> np.ndarray:
+        s = self.GetQuantumState()
+        return s.real ** 2 + s.imag ** 2
+
+    # separability introspection (reference: test_are_factorized-style)
+    def GetUnitCount(self) -> int:
+        units = {id(s.unit) for s in self.shards if s.unit is not None}
+        return len(units) + sum(1 for s in self.shards if s.cached)
+
+    def GetMaxUnitSize(self) -> int:
+        sizes = [s.unit.qubit_count for s in self.shards if s.unit is not None]
+        return max(sizes, default=1)
+
+    def Finish(self) -> None:
+        seen = set()
+        for s in self.shards:
+            if s.unit is not None and id(s.unit) not in seen:
+                seen.add(id(s.unit))
+                s.unit.Finish()
+
+    def isClifford(self, q: Optional[int] = None) -> bool:
+        if q is None:
+            return all(s.cached or s.unit.isClifford() for s in self.shards)
+        s = self.shards[q]
+        return s.cached or s.unit.isClifford()

@@ -37,11 +37,39 @@ def _stabhybrid(n, **kw):
     return QStabilizerHybrid(n, engine_factory=factory, **kw)
 
 
+def _qunit(n, **kw):
+    from qrack_tpu.layers.qunit import QUnit
+
+    def factory(m, **fkw):
+        fkw.setdefault("rand_global_phase", False)
+        return QEngineCPU(m, **fkw)
+
+    return QUnit(n, unit_factory=factory, **kw)
+
+
+def _full_stack(n, **kw):
+    """QUnit -> QStabilizerHybrid -> QEngineCPU (the reference's default
+    optimal-stack shape, SURVEY.md §1)."""
+    from qrack_tpu.layers.qunit import QUnit
+    from qrack_tpu.layers.stabilizerhybrid import QStabilizerHybrid
+
+    def eng_factory(m, **fkw):
+        fkw.setdefault("rand_global_phase", False)
+        return QEngineCPU(m, **fkw)
+
+    def sh_factory(m, **fkw):
+        return QStabilizerHybrid(m, engine_factory=eng_factory, **fkw)
+
+    return QUnit(n, unit_factory=sh_factory, **kw)
+
+
 ENGINE_FACTORIES = {
     "tpu": lambda n, **kw: QEngineTPU(n, **kw),
     "pager": _pager,
     "hybrid": _hybrid,
     "stabhybrid": _stabhybrid,
+    "qunit": _qunit,
+    "full_stack": _full_stack,
 }
 
 
@@ -57,10 +85,22 @@ def both(n, seed=11):
     }
 
 
+def align_phase(got, expect):
+    """Rotate `got` by the global phase that best matches `expect`
+    (tableau-backed stacks canonicalize global phase — physically
+    irrelevant, reference tracks it as a separate phaseOffset)."""
+    k = int(np.argmax(np.abs(expect)))
+    if abs(got[k]) < 1e-12:
+        return got
+    ph = expect[k] / got[k]
+    ph /= abs(ph) if abs(ph) > 0 else 1.0
+    return got * ph
+
+
 def assert_match(o, others, atol=2e-5):
     expect = o.GetQuantumState()
     for name, q in others.items():
-        got = q.GetQuantumState()
+        got = align_phase(q.GetQuantumState(), expect)
         np.testing.assert_allclose(got, expect, atol=atol, err_msg=name)
 
 

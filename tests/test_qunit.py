@@ -1,0 +1,220 @@
+"""QUnit Schmidt-factoring layer: correctness + separability accounting."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.qunit import QUnit
+from qrack_tpu.utils.rng import QrackRandom
+
+from test_engine_matrix import random_circuit
+
+
+def factory(n, **kw):
+    kw.setdefault("rand_global_phase", False)
+    return QEngineCPU(n, **kw)
+
+
+def make(n, seed=1, **kw):
+    return QUnit(n, unit_factory=factory, rng=QrackRandom(seed),
+                 rand_global_phase=False, **kw)
+
+
+def oracle(n, seed=1):
+    return QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+
+
+def fid(a, b):
+    return abs(np.vdot(a.GetQuantumState(), b.GetQuantumState())) ** 2
+
+
+def test_single_qubit_gates_never_allocate_units():
+    q = make(50)  # 50 qubits would be impossible densely
+    for i in range(50):
+        q.H(i)
+        q.T(i)
+        q.H(i)
+    assert all(s.cached for s in q.shards)
+    assert q.GetUnitCount() == 50
+    # H T H |0>: P(1) = sin^2(pi/8)
+    assert q.Prob(0) == pytest.approx(0.14644660940672624, abs=1e-9)
+
+
+def test_entangle_and_factor_accounting():
+    q = make(6)
+    q.H(0)
+    q.CNOT(0, 1)          # unit {0,1}
+    q.H(3)
+    q.CNOT(3, 4)          # unit {3,4}
+    assert q.GetUnitCount() == 4  # two 2q units + two cached
+    assert q.GetMaxUnitSize() == 2
+    q.CNOT(1, 3)          # merges into one 4q unit
+    assert q.GetMaxUnitSize() == 4
+    # measurement separates everything
+    q.rng.seed(3)
+    q.MAll()
+    assert all(s.cached for s in q.shards)
+
+
+def test_matches_oracle_random():
+    n = 5
+    for seed in (1, 2, 3):
+        q = make(n, seed)
+        o = oracle(n, seed)
+        random_circuit(q, QrackRandom(400 + seed), 40, n)
+        random_circuit(o, QrackRandom(400 + seed), 40, n)
+        assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_control_elision():
+    q = make(3)
+    # control q0 is definitely |0>: CNOT must not entangle anything
+    q.CNOT(0, 1)
+    assert all(s.cached for s in q.shards)
+    # control definitely |1>: gate applies but without entangling
+    q.X(0)
+    q.CNOT(0, 1)
+    assert all(s.cached for s in q.shards)
+    assert q.Prob(1) == pytest.approx(1.0)
+
+
+def test_swap_is_bookkeeping():
+    q = make(4)
+    q.X(0)
+    q.H(1)
+    q.Swap(0, 1)
+    assert all(s.cached for s in q.shards)
+    assert q.Prob(1) == pytest.approx(1.0)
+    assert q.Prob(0) == pytest.approx(0.5)
+
+
+def test_measurement_separates():
+    q = make(4, seed=7)
+    q.H(0)
+    for i in range(3):
+        q.CNOT(i, i + 1)
+    assert q.GetMaxUnitSize() == 4
+    q.rng.seed(5)
+    m = q.M(2)
+    # GHZ collapse: everything separable again
+    assert all(s.cached for s in q.shards)
+    for i in range(4):
+        assert q.Prob(i) == pytest.approx(1.0 if m else 0.0, abs=1e-9)
+
+
+def test_try_separate():
+    q = make(3, seed=9)
+    q.H(0)
+    q.CNOT(0, 1)
+    q.CNOT(0, 1)  # undone: product state again, but still one unit
+    assert q.GetMaxUnitSize() == 2
+    assert q.TrySeparate(1)
+    assert q.shards[1].cached
+    # X-basis separable qubit
+    q2 = make(2, seed=11)
+    q2.H(0)
+    q2.CNOT(0, 1)
+    q2.H(0)
+    q2.H(1)   # (|00>+|01>+|10>-|11>)? no: H H on bell -> still entangled
+    assert not q2.TrySeparate(0)
+
+
+def test_qft_and_back():
+    n = 6
+    q = make(n, seed=13)
+    o = oracle(n, seed=13)
+    for eng in (q, o):
+        eng.SetPermutation(0b101101)
+        eng.QFT(0, n)
+        eng.IQFT(0, n)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+    assert abs(q.GetAmplitude(0b101101)) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_alu_spanning_units():
+    n = 7
+    q = make(n, seed=15)
+    o = oracle(n, seed=15)
+    for eng in (q, o):
+        eng.HReg(0, 3)
+        eng.INC(5, 0, 3)   # stays within [0,3): MUL's carry reg keeps |0>
+        eng.CINC(2, 0, 3, (6,))
+        eng.MUL(3, 0, 3, 3)
+        eng.PhaseFlipIfLess(3, 0, 3)
+        eng.DIV(3, 0, 3, 3)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+    np.testing.assert_allclose(q.GetQuantumState(), o.GetQuantumState(), atol=1e-8)
+
+
+def test_compose_decompose():
+    a = make(2, seed=17)
+    a.H(0)
+    a.CNOT(0, 1)
+    b = make(2, seed=18)
+    b.X(0)
+    start = a.Compose(b)
+    assert start == 2 and a.qubit_count == 4
+    o = oracle(4)
+    o.H(0); o.CNOT(0, 1); o.X(2)
+    assert fid(a, o) == pytest.approx(1.0, abs=1e-8)
+    dest = make(2, seed=19)
+    a.Decompose(2, dest)
+    assert a.qubit_count == 2
+    assert dest.Prob(0) == pytest.approx(1.0)
+    assert dest.Prob(1) == pytest.approx(0.0)
+
+
+def test_decompose_entangled_span():
+    a = make(4, seed=21)
+    a.H(0)
+    a.CNOT(0, 1)
+    a.H(2)
+    a.CNOT(2, 3)
+    dest = make(2, seed=22)
+    a.Decompose(1, dest)  # span {1, 2}: cuts across two units
+    # original Bell pair is destroyed (q1 was entangled with q0) — but
+    # the operation must complete and preserve norms
+    assert a.qubit_count == 2 and dest.qubit_count == 2
+    p = a.GetProbs()
+    assert np.isclose(p.sum(), 1.0, atol=1e-6)
+
+
+def test_parity_across_units():
+    q = make(4, seed=23)
+    o = oracle(4, seed=23)
+    for eng in (q, o):
+        eng.H(0)
+        eng.CNOT(0, 1)
+        eng.H(2)
+    assert q.ProbParity(0b0111) == pytest.approx(o.ProbParity(0b0111), abs=1e-9)
+    assert q.ProbParity(0b0011) == pytest.approx(o.ProbParity(0b0011), abs=1e-9)
+
+
+def test_multishot_and_expectation():
+    n = 4
+    q = make(n, seed=25)
+    o = oracle(n, seed=25)
+    for eng in (q, o):
+        eng.H(0)
+        eng.CNOT(0, 1)
+        eng.RY(0.8, 2)
+    assert q.ExpectationBitsAll([0, 1, 2, 3]) == pytest.approx(
+        o.ExpectationBitsAll([0, 1, 2, 3]), abs=1e-6)
+    sq = q.MultiShotMeasureMask([1, 2], 800)
+    so = o.MultiShotMeasureMask([1, 2], 800)
+    for k in range(4):
+        assert abs(sq.get(k, 0) - so.get(k, 0)) < 140
+
+
+def test_wide_sparse_circuit():
+    # 40 qubits with only local entanglement: impossible densely, cheap here
+    q = make(40, seed=27)
+    for i in range(0, 40, 4):
+        q.H(i)
+        q.CNOT(i, i + 1)
+        q.T(i + 1)
+    assert q.GetMaxUnitSize() == 2
+    assert q.GetAmplitude(0) != 0
+    q.rng.seed(1)
+    r = q.MAll()
+    assert isinstance(r, int)
