@@ -14,5 +14,11 @@ from .engines import QEngine, QEngineCPU  # noqa: F401
 from .pauli import Pauli  # noqa: F401
 from .config import get_config, set_config  # noqa: F401
 from .hamiltonian import HamiltonianOp, uniform_hamiltonian_op  # noqa: F401
+from .factory import (  # noqa: F401
+    create_quantum_interface,
+    create_arranged_layers_full,
+    build_factory,
+)
+from .qneuron import QNeuron, ActivationFn  # noqa: F401
 
 __version__ = "0.1.0"

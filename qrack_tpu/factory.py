@@ -1,0 +1,142 @@
+"""Stack factory: runtime-composable simulator layer assembly.
+
+Re-design of the reference factory (reference: include/qfactory.hpp:49
+CreateQuantumInterface — recursive layer construction from a type
+vector; :265 CreateArrangedLayersFull — boolean layer toggles; enum
+QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
+:114-131). Layer names here:
+
+  "tensor_network"     QTensorNetwork (circuit buffering + light cone)
+  "noisy"              QInterfaceNoisy wrapper
+  "unit" / "unit_multi" QUnit / QUnitMulti Schmidt factoring
+  "stabilizer_hybrid"  Clifford tableau until forced off
+  "stabilizer"         bare CHP tableau (Clifford-only)
+  "pager"              QPager sharded dense engine over the device mesh
+  "hybrid"             QHybrid CPU<->TPU<->pager width switching
+  "tpu"                QEngineTPU single-device dense engine
+  "cpu"                QEngineCPU host oracle
+
+create_quantum_interface(layers, n) composes them top-down; OPTIMAL is
+["unit", "stabilizer_hybrid", "hybrid"] — the reference's production
+stack shape with the TPU-native dense bottom."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
+OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
+
+_TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer"}
+
+
+def _terminal_factory(name: str, **opts) -> Callable:
+    if name == "cpu":
+        from .engines.cpu import QEngineCPU
+
+        return lambda n, **kw: QEngineCPU(n, **{**opts, **kw})
+    if name == "tpu":
+        from .engines.tpu import QEngineTPU
+
+        return lambda n, **kw: QEngineTPU(n, **{**opts, **kw})
+    if name == "pager":
+        from .parallel.pager import QPager
+
+        return lambda n, **kw: QPager(n, **{**opts, **kw})
+    if name == "hybrid":
+        from .engines.hybrid import QHybrid
+
+        return lambda n, **kw: QHybrid(n, **{**opts, **kw})
+    if name == "stabilizer":
+        from .layers.stabilizer import QStabilizer
+
+        return lambda n, **kw: QStabilizer(n, **{**opts, **kw})
+    raise ValueError(f"unknown terminal layer {name!r}")
+
+
+def build_factory(layers: Sequence[str], **opts) -> Callable:
+    """Compose a constructor fn(n, **kw) from a top-down layer list
+    (reference: CreateQuantumInterface recursion, qfactory.hpp:189-258)."""
+    if not layers:
+        raise ValueError("empty layer list")
+    head, rest = layers[0], layers[1:]
+    if head in _TERMINAL:
+        if rest:
+            raise ValueError(f"terminal layer {head!r} must be last")
+        return _terminal_factory(head, **opts)
+    below = build_factory(rest, **opts) if rest else None
+
+    if head == "unit":
+        from .layers.qunit import QUnit
+
+        return lambda n, **kw: QUnit(n, unit_factory=below, **kw)
+    if head == "unit_multi":
+        from .layers.qunitmulti import QUnitMulti
+
+        return lambda n, **kw: QUnitMulti(n, unit_factory=below, **kw)
+    if head == "stabilizer_hybrid":
+        from .layers.stabilizerhybrid import QStabilizerHybrid
+
+        return lambda n, **kw: QStabilizerHybrid(n, engine_factory=below, **kw)
+    if head == "tensor_network":
+        from .layers.qtensornetwork import QTensorNetwork
+
+        return lambda n, **kw: QTensorNetwork(n, stack_factory=below, **kw)
+    if head == "noisy":
+        from .layers.noisy import QInterfaceNoisy
+
+        noise = opts.get("noise")
+        return lambda n, **kw: QInterfaceNoisy(n, inner_factory=below, noise=noise, **kw)
+    raise ValueError(f"unknown layer {head!r}")
+
+
+def create_quantum_interface(layers: Union[str, Sequence[str]], qubit_count: int,
+                             init_state: int = 0, **kwargs):
+    """Build a simulator stack (reference: CreateQuantumInterface,
+    include/qfactory.hpp:49).
+
+    `layers` may be "optimal", "optimal_multi", a single layer name, or a
+    top-down sequence, e.g. ["tensor_network", "unit",
+    "stabilizer_hybrid", "hybrid"]."""
+    if isinstance(layers, str):
+        if layers == "optimal":
+            layers = OPTIMAL
+        elif layers == "optimal_multi":
+            layers = OPTIMAL_MULTI
+        else:
+            layers = (layers,)
+    opts = {k: kwargs.pop(k) for k in ("noise", "devices", "n_pages", "dtype")
+            if k in kwargs}
+    factory = build_factory(tuple(layers), **opts)
+    return factory(qubit_count, init_state=init_state, **kwargs)
+
+
+def create_arranged_layers_full(nw: bool = False, md: bool = False, sd: bool = True,
+                                sh: bool = True, bdt: bool = False, pg: bool = True,
+                                tn: bool = False, hy: bool = True, oc: bool = True,
+                                qubit_count: int = 1, **kwargs):
+    """Boolean layer toggles matching the reference's pinvoke `init`
+    signature (reference: include/qfactory.hpp:265
+    CreateArrangedLayersFull; pinvoke init_count_type
+    include/pinvoke_api.hpp:42): nw=noisy wrapper, md=multi-device QUnit,
+    sd=Schmidt decomposition (QUnit), sh=stabilizer hybrid, bdt=binary
+    decision tree (pending), pg=paging, tn=tensor network, hy=hybrid,
+    oc="OpenCL"→accelerator (TPU here)."""
+    layers: List[str] = []
+    if nw:
+        layers.append("noisy")
+    if tn:
+        layers.append("tensor_network")
+    if sd:
+        layers.append("unit_multi" if md else "unit")
+    if sh:
+        layers.append("stabilizer_hybrid")
+    if hy:
+        layers.append("hybrid")
+    elif pg and oc:
+        layers.append("pager")
+    elif oc:
+        layers.append("tpu")
+    else:
+        layers.append("cpu")
+    return create_quantum_interface(layers, qubit_count, **kwargs)

@@ -184,6 +184,31 @@ class GatesMixin:
     def AntiCIAI(self, control: int, target: int, azimuth: float, inclination: float) -> None:
         self.MACMtrx((control,), np.conj(mat.ai_mtrx(azimuth, inclination).T), target)
 
+    # ---------------- uniformly controlled rotations ----------------
+    # (reference: UniformlyControlledSingleBit / UniformlyControlledRY/RZ,
+    #  include/qinterface.hpp; kernel uniformlycontrolled qengine.cl:409)
+
+    def UniformlyControlledSingleBit(self, controls, target: int, mtrxs) -> None:
+        self.UCMtrx(tuple(controls), mtrxs, target)
+
+    def UniformlyControlledRY(self, controls, target: int, angles) -> None:
+        import numpy as _np
+
+        ms = []
+        for a in angles:
+            c, s = math.cos(a / 2), math.sin(a / 2)
+            ms.append(_np.array([[c, -s], [s, c]], dtype=_np.complex128))
+        self.UCMtrx(tuple(controls), ms, target)
+
+    def UniformlyControlledRZ(self, controls, target: int, angles) -> None:
+        import numpy as _np
+
+        ms = []
+        for a in angles:
+            ms.append(_np.array([[cmath.exp(-0.5j * a), 0], [0, cmath.exp(0.5j * a)]],
+                                dtype=_np.complex128))
+        self.UCMtrx(tuple(controls), ms, target)
+
     # ---------------- multi-target X/Z/phase masks ----------------
 
     def XMask(self, mask: int) -> None:

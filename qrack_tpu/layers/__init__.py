@@ -1,2 +1,7 @@
 from .stabilizer import QStabilizer, CliffordError  # noqa: F401
 from .stabilizerhybrid import QStabilizerHybrid  # noqa: F401
+from .qunit import QUnit  # noqa: F401
+from .qunitmulti import QUnitMulti  # noqa: F401
+from .qcircuit import QCircuit, QCircuitGate  # noqa: F401
+from .qtensornetwork import QTensorNetwork  # noqa: F401
+from .noisy import QInterfaceNoisy  # noqa: F401

@@ -1,0 +1,188 @@
+"""QCircuit: gate intermediate representation with algebraic merging.
+
+Re-design of the reference's circuit IR (reference:
+include/qcircuit.hpp:52 QCircuitGate — {target, payloads: map<control
+permutation -> 2x2>, controls}; AppendGate merging src/qcircuit.cpp:101;
+Run :173; PastLightCone :824). TPU-native addition: `compile_fn` traces
+the whole circuit into ONE jittable XLA program over split-plane kets —
+the reference's per-gate GPU dispatch chain becomes a single fused
+executable (SURVEY.md §7 step 4 "batched command path").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import matrices as mat
+
+
+class QCircuitGate:
+    __slots__ = ("target", "controls", "payloads")
+
+    def __init__(self, target: int, payloads: Dict[int, np.ndarray],
+                 controls: Tuple[int, ...] = ()):
+        self.target = target
+        self.controls = tuple(controls)
+        self.payloads = {p: np.asarray(m, dtype=np.complex128).reshape(2, 2)
+                         for p, m in payloads.items()}
+
+    @classmethod
+    def single(cls, target: int, m: np.ndarray) -> "QCircuitGate":
+        return cls(target, {0: m})
+
+    @classmethod
+    def controlled(cls, controls, target: int, m: np.ndarray, perm: int) -> "QCircuitGate":
+        return cls(target, {perm: m}, tuple(controls))
+
+    def qubits(self) -> Tuple[int, ...]:
+        return (self.target,) + self.controls
+
+    def can_merge(self, other: "QCircuitGate") -> bool:
+        return (self.target == other.target and self.controls == other.controls)
+
+    def merge(self, later: "QCircuitGate") -> None:
+        """Compose `later`'s payloads after self's (matrix product)."""
+        for perm in set(self.payloads) | set(later.payloads):
+            a = self.payloads.get(perm, mat.I2)
+            b = later.payloads.get(perm, mat.I2)
+            self.payloads[perm] = b @ a
+        # drop only removable payloads: exact identity always; identity up
+        # to global phase only when uncontrolled (a controlled e^{i0}I is a
+        # physical phase on the control subspace and must be kept)
+        def removable(m):
+            return mat.is_identity(m) and (not self.controls or abs(m[0, 0] - 1.0) <= 1e-12)
+
+        for perm in [p for p, m in self.payloads.items() if removable(m)]:
+            del self.payloads[perm]
+
+    def is_identity(self) -> bool:
+        return not self.payloads
+
+    def is_phase(self) -> bool:
+        return all(mat.is_phase(m) for m in self.payloads.values())
+
+    def clone(self) -> "QCircuitGate":
+        return QCircuitGate(self.target, {p: m.copy() for p, m in self.payloads.items()},
+                            self.controls)
+
+
+class QCircuit:
+    def __init__(self, qubit_count: int = 0):
+        self.qubit_count = qubit_count
+        self.gates: List[QCircuitGate] = []
+
+    # ------------------------------------------------------------------
+
+    def AppendGate(self, gate: QCircuitGate) -> None:
+        """Append with peephole merging (reference: src/qcircuit.cpp:101 —
+        algebraic combining of same-target/controls neighbors and
+        commuting past disjoint gates)."""
+        self.qubit_count = max(self.qubit_count, max(gate.qubits()) + 1)
+        # walk back past gates on disjoint qubits to find a merge partner
+        i = len(self.gates) - 1
+        gset = set(gate.qubits())
+        while i >= 0:
+            g = self.gates[i]
+            if g.can_merge(gate):
+                g.merge(gate)
+                if g.is_identity():
+                    del self.gates[i]
+                return
+            if set(g.qubits()) & gset:
+                break  # overlapping, cannot commute further back
+            i -= 1
+        self.gates.append(gate.clone())
+
+    def append_1q(self, target: int, m: np.ndarray) -> None:
+        self.AppendGate(QCircuitGate.single(target, m))
+
+    def append_ctrl(self, controls, target: int, m: np.ndarray, perm: int) -> None:
+        self.AppendGate(QCircuitGate.controlled(controls, target, m, perm))
+
+    def GetDepth(self) -> int:
+        depth: Dict[int, int] = {}
+        d = 0
+        for g in self.gates:
+            lvl = 1 + max((depth.get(q, 0) for q in g.qubits()), default=0)
+            for q in g.qubits():
+                depth[q] = lvl
+            d = max(d, lvl)
+        return d
+
+    def GetGateCount(self) -> int:
+        return len(self.gates)
+
+    # ------------------------------------------------------------------
+
+    def Run(self, qsim) -> None:
+        """Execute on any QInterface (reference: src/qcircuit.cpp:173)."""
+        for g in self.gates:
+            for perm, m in g.payloads.items():
+                qsim.MCMtrxPerm(g.controls, m, g.target, perm)
+
+    def PastLightCone(self, qubits: Sequence[int]) -> "QCircuit":
+        """Sub-circuit causally relevant to `qubits` (reference:
+        include/qcircuit.hpp:824; used by QTensorNetwork)."""
+        cone = set(qubits)
+        keep: List[QCircuitGate] = []
+        for g in reversed(self.gates):
+            if set(g.qubits()) & cone:
+                cone |= set(g.qubits())
+                keep.append(g)
+        out = QCircuit(self.qubit_count)
+        out.gates = [g.clone() for g in reversed(keep)]
+        return out
+
+    def Inverse(self) -> "QCircuit":
+        out = QCircuit(self.qubit_count)
+        for g in reversed(self.gates):
+            out.gates.append(QCircuitGate(
+                g.target,
+                {p: np.conj(m.T) for p, m in g.payloads.items()},
+                g.controls,
+            ))
+        return out
+
+    def clone(self) -> "QCircuit":
+        out = QCircuit(self.qubit_count)
+        out.gates = [g.clone() for g in self.gates]
+        return out
+
+    # ------------------------------------------------------------------
+    # TPU batch path: the whole circuit as one traced program
+    # ------------------------------------------------------------------
+
+    def compile_fn(self, n: int):
+        """Return a pure jittable fn(planes) applying the whole circuit
+        over (2, 2^n) split planes — one fused XLA executable."""
+        from ..ops import gatekernels as gk
+
+        gates = [(g.target, g.controls, dict(g.payloads)) for g in self.gates]
+
+        def fn(planes):
+            for (target, controls, payloads) in gates:
+                for perm, m in payloads.items():
+                    cmask = 0
+                    cval = 0
+                    for j, c in enumerate(controls):
+                        cmask |= 1 << c
+                        if (perm >> j) & 1:
+                            cval |= 1 << c
+                    if mat.is_phase(m):
+                        planes = gk.apply_diag(
+                            planes, m[0, 0].real, m[0, 0].imag,
+                            m[1, 1].real, m[1, 1].imag,
+                            n, 1 << target, cmask, cval)
+                    elif mat.is_invert(m):
+                        planes = gk.apply_invert(
+                            planes, m[0, 1].real, m[0, 1].imag,
+                            m[1, 0].real, m[1, 0].imag,
+                            n, target, cmask, cval)
+                    else:
+                        mp = gk.mtrx_planes(m, planes.dtype)
+                        planes = gk.apply_2x2(planes, mp, n, target, cmask, cval)
+            return planes
+
+        return fn

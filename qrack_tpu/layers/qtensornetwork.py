@@ -1,0 +1,195 @@
+"""QTensorNetwork: circuit buffering with past-light-cone elision.
+
+Re-design of the reference layer (reference:
+include/qtensornetwork.hpp:30 — buffers gates into a QCircuit; on any
+observable query materializes only the past light cone of the measured
+qubits into the stack below; RunAsAmplitudes :73-83, MakeLayerStack
+src/qtensornetwork.cpp:115). Round-1 simplification: the first
+collapsing measurement materializes the full light cone and the layer
+stays materialized (the reference's measurement-layer re-buffering is a
+later-round extension)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..interface import QInterface
+from .qcircuit import QCircuit
+
+
+def _default_stack_factory(n, **kw):
+    from .qunit import QUnit
+
+    return QUnit(n, **kw)
+
+
+class QTensorNetwork(QInterface):
+    def __init__(self, qubit_count: int, init_state: int = 0,
+                 stack_factory: Optional[Callable] = None, **kwargs):
+        super().__init__(qubit_count, init_state=init_state, **kwargs)
+        self._factory = stack_factory or _default_stack_factory
+        self._kw = {k: v for k, v in kwargs.items() if k != "rng"}
+        self._init_state = init_state
+        self.circuit = QCircuit(qubit_count)
+        self.sim = None  # materialized lower stack
+        # dedicated stream for stack construction so materialization never
+        # consumes from the measurement stream (reproducibility)
+        self._stack_rng = self.rng.spawn()
+
+    # ------------------------------------------------------------------
+
+    def _buffering(self) -> bool:
+        return self.sim is None
+
+    def _materialize(self, qubits=None) -> None:
+        """Build the lower stack and run the (light-cone) circuit
+        (reference: MakeLayerStack)."""
+        if self.sim is not None:
+            return
+        circ = (self.circuit if qubits is None
+                else self.circuit.PastLightCone(qubits))
+        self.sim = self._factory(self.qubit_count, init_state=self._init_state,
+                                 rng=self._stack_rng.spawn(), **self._kw)
+        circ.Run(self.sim)
+        self.circuit = QCircuit(self.qubit_count)
+
+    def _light_cone_query(self, qubits, fn):
+        """Query an observable through a temporary light-cone stack
+        without materializing (reference: RunAsAmplitudes)."""
+        if self.sim is not None:
+            return fn(self.sim)
+        circ = self.circuit.PastLightCone(qubits)
+        tmp = self._factory(self.qubit_count, init_state=self._init_state,
+                            rng=self._stack_rng.spawn(), **self._kw)
+        circ.Run(tmp)
+        return fn(tmp)
+
+    # ------------------------------------------------------------------
+    # gate primitive: buffer
+    # ------------------------------------------------------------------
+
+    def MCMtrxPerm(self, controls, mtrx, target, perm) -> None:
+        if self.sim is not None:
+            return self.sim.MCMtrxPerm(controls, mtrx, target, perm)
+        m = np.asarray(mtrx, dtype=np.complex128).reshape(2, 2)
+        self.circuit.append_ctrl(tuple(controls), target, m, perm)
+
+    # ------------------------------------------------------------------
+    # observables
+    # ------------------------------------------------------------------
+
+    def Prob(self, q: int) -> float:
+        return self._light_cone_query([q], lambda s: s.Prob(q))
+
+    def GetAmplitude(self, perm: int) -> complex:
+        return self._light_cone_query(range(self.qubit_count),
+                                      lambda s: s.GetAmplitude(perm))
+
+    def GetQuantumState(self) -> np.ndarray:
+        return self._light_cone_query(range(self.qubit_count),
+                                      lambda s: np.asarray(s.GetQuantumState()))
+
+    def GetProbs(self) -> np.ndarray:
+        return self._light_cone_query(range(self.qubit_count),
+                                      lambda s: np.asarray(s.GetProbs()))
+
+    def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
+        if do_apply:
+            self._materialize()
+            self.sim.rng = self.rng
+            return self.sim.ForceM(q, result, do_force, do_apply)
+        return self._light_cone_query([q], lambda s: s.ForceM(q, result, do_force, False))
+
+    def MultiShotMeasureMask(self, q_powers, shots: int) -> dict:
+        from ..utils.bits import log2
+
+        bits = [log2(int(p)) for p in q_powers]
+        return self._light_cone_query(
+            bits, lambda s: s.MultiShotMeasureMask(q_powers, shots))
+
+    def ExpectationBitsAll(self, bits, offset: int = 0) -> float:
+        return self._light_cone_query(
+            list(bits), lambda s: s.ExpectationBitsAll(bits, offset))
+
+    # ------------------------------------------------------------------
+    # structure / state
+    # ------------------------------------------------------------------
+
+    def SetPermutation(self, perm: int, phase=None) -> None:
+        self.circuit = QCircuit(self.qubit_count)
+        self.sim = None
+        self._init_state = perm
+
+    def SetQuantumState(self, state) -> None:
+        self._materialize()
+        self.sim.SetQuantumState(state)
+
+    def Compose(self, other, start: Optional[int] = None) -> int:
+        self._materialize()
+        inner = other
+        if isinstance(other, QTensorNetwork):
+            oc = other.Clone()
+            oc._materialize()
+            inner = oc.sim
+        res = self.sim.Compose(inner, start)
+        self.qubit_count = self.sim.qubit_count
+        self.circuit.qubit_count = self.qubit_count
+        return res
+
+    def Decompose(self, start: int, dest) -> None:
+        self._materialize()
+        if isinstance(dest, QTensorNetwork):
+            dest._materialize()
+            self.sim.Decompose(start, dest.sim)
+            dest.qubit_count = dest.sim.qubit_count
+        else:
+            self.sim.Decompose(start, dest)
+        self.qubit_count = self.sim.qubit_count
+
+    def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
+        self._materialize()
+        self.sim.Dispose(start, length, disposed_perm)
+        self.qubit_count = self.sim.qubit_count
+
+    def Allocate(self, start: int, length: int = 1) -> int:
+        if self.sim is not None:
+            res = self.sim.Allocate(start, length)
+            self.qubit_count = self.sim.qubit_count
+            return res
+        # buffered: just widen the register (new qubits start |0>)
+        if (any(max(g.qubits()) >= start for g in self.circuit.gates)
+                or (self._init_state >> start)):
+            # shifting buffered gate/init-state indices is a later-round
+            # refinement; materialize and let the stack insert
+            self._materialize()
+            return self.Allocate(start, length)
+        self.qubit_count += length
+        self.circuit.qubit_count = self.qubit_count
+        return start
+
+    def Clone(self) -> "QTensorNetwork":
+        c = QTensorNetwork(self.qubit_count, init_state=self._init_state,
+                           stack_factory=self._factory, rng=self.rng.spawn(),
+                           **self._kw)
+        c._stack_rng = self._stack_rng.spawn()
+        c.circuit = self.circuit.clone()
+        c.sim = self.sim.Clone() if self.sim is not None else None
+        return c
+
+    def SumSqrDiff(self, other) -> float:
+        a = self.GetQuantumState()
+        b = np.asarray(other.GetQuantumState(), dtype=np.complex128)
+        inner = np.vdot(a, b)
+        return float(max(0.0, 1.0 - abs(inner) ** 2))
+
+    def GetDepth(self) -> int:
+        return self.circuit.GetDepth()
+
+    def Finish(self) -> None:
+        if self.sim is not None:
+            self.sim.Finish()
+
+    def isBuffering(self) -> bool:
+        return self.sim is None

@@ -1,0 +1,71 @@
+"""QUnitMulti: QUnit with per-subsystem device placement.
+
+Re-design of the reference layer (reference: include/qunitmulti.hpp:66;
+src/qunitmulti.cpp — each separable subsystem is a whole engine placed
+on one device; RedistributeQEngines greedily re-packs the biggest
+subsystems onto the most capable devices after every entangle/separate
+event :138-166,217; device table DeviceInfo :55; env
+QRACK_QUNITMULTI_DEVICES :72-117).
+
+Here a "device" is a JAX device id (meaningful when units are
+QEngineTPU/QHybrid-backed; the CPU oracle ignores placement). All
+devices are one chip class, so capability weighting is uniform and
+redistribution is size-greedy round-robin."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .qunit import QUnit
+
+
+class QUnitMulti(QUnit):
+    def __init__(self, qubit_count: int, init_state: int = 0,
+                 device_ids: Optional[Sequence[int]] = None, **kwargs):
+        super().__init__(qubit_count, init_state=init_state, **kwargs)
+        if device_ids is None:
+            try:
+                import jax
+
+                device_ids = [d.id for d in jax.devices()]
+            except Exception:
+                device_ids = [0]
+        self.device_ids = list(device_ids)
+        self._next_dev = 0
+
+    def SetDeviceList(self, device_ids: Sequence[int]) -> None:
+        self.device_ids = list(device_ids)
+
+    def GetDeviceList(self) -> List[int]:
+        return list(self.device_ids)
+
+    def _to_unit(self, q: int):
+        fresh = self.shards[q].unit is None
+        unit = super()._to_unit(q)
+        if fresh and hasattr(unit, "SetDevice"):
+            unit.SetDevice(self.device_ids[self._next_dev % len(self.device_ids)])
+            self._next_dev += 1
+        return unit
+
+    def _merge(self, qubits):
+        unit = super()._merge(qubits)
+        self.RedistributeQEngines()
+        return unit
+
+    def _separate_bit(self, q: int, value: bool) -> None:
+        super()._separate_bit(q, value)
+        self.RedistributeQEngines()
+
+    def RedistributeQEngines(self) -> None:
+        """Greedy size-descending placement across the device list
+        (reference: src/qunitmulti.cpp:217)."""
+        units = []
+        seen = set()
+        for s in self.shards:
+            if s.unit is not None and id(s.unit) not in seen:
+                seen.add(id(s.unit))
+                units.append(s.unit)
+        units.sort(key=lambda u: -u.qubit_count)
+        for i, u in enumerate(units):
+            if hasattr(u, "SetDevice"):
+                u.SetDevice(self.device_ids[i % len(self.device_ids)])

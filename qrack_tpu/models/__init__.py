@@ -1,1 +1,2 @@
 from . import qft  # noqa: F401
+from . import algorithms  # noqa: F401

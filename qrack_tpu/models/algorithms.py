@@ -1,0 +1,135 @@
+"""Algorithm workloads over any QInterface stack.
+
+TPU-native counterparts of the reference teaching programs (reference:
+examples/grovers.cpp, teleport.cpp, shors_factoring.cpp,
+quantum_volume.cpp, test/benchmarks.cpp GHZ/RCS cases)."""
+
+from __future__ import annotations
+
+import math
+from fractions import Fraction
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def ghz(qsim, n: Optional[int] = None) -> None:
+    """GHZ preparation (reference: test/benchmarks.cpp:531)."""
+    n = n if n is not None else qsim.GetQubitCount()
+    qsim.H(0)
+    for i in range(n - 1):
+        qsim.CNOT(i, i + 1)
+
+
+def grover_search(qsim, target: int, n: Optional[int] = None) -> int:
+    """Grover search for |target> via phase-flip oracle (reference:
+    examples/grovers.cpp:1-68 — same oracle construction from
+    PhaseFlipIfLess pairs). Returns the measured index."""
+    n = n if n is not None else qsim.GetQubitCount()
+    for i in range(n):
+        qsim.H(i)
+    iters = int(math.floor(math.pi / 4 * math.sqrt(1 << n)))
+    for _ in range(iters):
+        qsim.PhaseFlipIfLess(target + 1, 0, n)
+        qsim.PhaseFlipIfLess(target, 0, n)
+        for i in range(n):
+            qsim.H(i)
+        qsim.PhaseFlipIfLess(1, 0, n)
+        for i in range(n):
+            qsim.H(i)
+    return qsim.MAll()
+
+
+def teleport(qsim, prepare=None) -> Tuple[float, float]:
+    """Teleport qubit 0 onto qubit 2 (reference: examples/teleport.cpp).
+    Returns (payload P(1) before, target P(1) after)."""
+    if prepare is not None:
+        prepare(qsim)
+    before = qsim.Prob(0)
+    qsim.H(1)
+    qsim.CNOT(1, 2)
+    qsim.CNOT(0, 1)
+    qsim.H(0)
+    m0 = qsim.M(0)
+    m1 = qsim.M(1)
+    if m1:
+        qsim.X(2)
+    if m0:
+        qsim.Z(2)
+    return before, qsim.Prob(2)
+
+
+def shor_order_find(qsim, base: int, to_factor: int, width: int, rng=None) -> Optional[int]:
+    """One period-finding round of Shor's algorithm (reference:
+    examples/shors_factoring.cpp:98-160). Needs 2*width qubits.
+    Returns a nontrivial factor or None."""
+    qsim.SetPermutation(0)
+    for i in range(width):
+        qsim.H(i)
+    qsim.POWModNOut(base, to_factor, 0, width, width)
+    qsim.IQFT(0, width)
+    y = qsim.MReg(0, width)
+    if y == 0:
+        return None
+    # continued-fraction reconstruction of the order
+    frac = Fraction(y, 1 << width).limit_denominator(to_factor)
+    r = frac.denominator
+    if r % 2:
+        r *= 2
+    apow = pow(base, r // 2, to_factor)
+    f1 = math.gcd(apow + 1, to_factor)
+    f2 = math.gcd(apow - 1, to_factor)
+    for f in (f1, f2):
+        if 1 < f < to_factor and to_factor % f == 0:
+            return f
+    return None
+
+
+def random_circuit_sampling(qsim, depth: int, rng, n: Optional[int] = None) -> None:
+    """Nearest-neighbor RCS layer structure (reference:
+    test/benchmarks.cpp:4141 test_random_circuit_sampling_nn): random
+    single-qubit roots + brick-wall couplers."""
+    n = n if n is not None else qsim.GetQubitCount()
+    for d in range(depth):
+        for q in range(n):
+            g = rng.randint(0, 3)
+            if g == 0:
+                qsim.SqrtX(q)
+            elif g == 1:
+                qsim.SqrtY(q)
+            else:
+                qsim.SqrtW(q)
+        off = d & 1
+        for q in range(off, n - 1, 2):
+            qsim.ISwap(q, q + 1)
+
+
+def quantum_volume(qsim, depth: Optional[int] = None, rng=None) -> int:
+    """QV-style circuit: `depth` rounds of random SU(4)-ish blocks on a
+    random qubit pairing (reference: examples/quantum_volume.cpp:1-110).
+    Returns the heavy-output count proxy (measured value)."""
+    n = qsim.GetQubitCount()
+    depth = depth if depth is not None else n
+    for _ in range(depth):
+        perm = list(range(n))
+        for i in range(n - 1, 0, -1):
+            j = rng.randint(0, i + 1)
+            perm[i], perm[j] = perm[j], perm[i]
+        for k in range(0, n - 1, 2):
+            a, b = perm[k], perm[k + 1]
+            for q in (a, b):
+                qsim.U(q, rng.rand() * math.pi, rng.rand() * 2 * math.pi,
+                       rng.rand() * 2 * math.pi)
+            qsim.CNOT(a, b)
+            for q in (a, b):
+                qsim.U(q, rng.rand() * math.pi, rng.rand() * 2 * math.pi,
+                       rng.rand() * 2 * math.pi)
+    return qsim.MAll()
+
+
+def xeb_fidelity(probs_ideal: np.ndarray, samples) -> float:
+    """Linear cross-entropy benchmark fidelity (reference:
+    test_universal_circuit_digital_cross_entropy, test/benchmarks.cpp:4560)."""
+    d = probs_ideal.shape[0]
+    mean_p = float(np.mean([probs_ideal[int(s)] for s in samples]))
+    return d * mean_p - 1.0

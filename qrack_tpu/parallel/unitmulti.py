@@ -1,0 +1,5 @@
+"""Compatibility shim: QUnitMulti lives in qrack_tpu.layers.qunitmulti
+(it is a QUnit subclass); re-exported here because device placement is
+conceptually part of the parallel subsystem (SURVEY.md §2.3)."""
+
+from ..layers.qunitmulti import QUnitMulti  # noqa: F401

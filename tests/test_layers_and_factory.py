@@ -1,0 +1,270 @@
+"""QCircuit / QTensorNetwork / QInterfaceNoisy / factory / models / QNeuron."""
+
+import math
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU, create_quantum_interface, QNeuron
+from qrack_tpu.layers.qcircuit import QCircuit, QCircuitGate
+from qrack_tpu.layers.qtensornetwork import QTensorNetwork
+from qrack_tpu.layers.noisy import QInterfaceNoisy
+from qrack_tpu.layers.qunitmulti import QUnitMulti
+from qrack_tpu import matrices as mat
+from qrack_tpu.models import algorithms as algo
+from qrack_tpu.utils.rng import QrackRandom
+
+from test_engine_matrix import random_circuit
+
+
+def cpu_factory(n, **kw):
+    kw.setdefault("rand_global_phase", False)
+    return QEngineCPU(n, **kw)
+
+
+def fid(a, b):
+    return abs(np.vdot(np.asarray(a.GetQuantumState()),
+                       np.asarray(b.GetQuantumState()))) ** 2
+
+
+# ---------------- QCircuit ----------------
+
+def test_circuit_merging():
+    c = QCircuit(2)
+    c.append_1q(0, mat.H2)
+    c.append_1q(0, mat.H2)   # H H = I: should cancel
+    assert c.GetGateCount() == 0
+    c.append_1q(0, mat.T2)
+    c.append_1q(1, mat.H2)   # disjoint
+    c.append_1q(0, mat.T2)   # merges with earlier T across disjoint H
+    assert c.GetGateCount() == 2
+
+
+def test_circuit_run_and_inverse():
+    rng = QrackRandom(3)
+    c = QCircuit(4)
+    gates = []
+    for _ in range(15):
+        t = rng.randint(0, 4)
+        m = mat.u3_mtrx(rng.rand(), rng.rand(), rng.rand())
+        if rng.rand() < 0.4:
+            ctl = rng.randint(0, 4)
+            if ctl != t:
+                c.append_ctrl((ctl,), t, m, 1)
+                continue
+        c.append_1q(t, m)
+    q = cpu_factory(4, rng=QrackRandom(1))
+    c.Run(q)
+    c.Inverse().Run(q)
+    assert abs(q.GetAmplitude(0)) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_circuit_past_light_cone():
+    c = QCircuit(4)
+    c.append_1q(0, mat.H2)
+    c.append_ctrl((0,), 1, mat.X2, 1)
+    c.append_1q(3, mat.H2)   # disjoint from qubit 0/1 cone
+    cone = c.PastLightCone([1])
+    assert cone.GetGateCount() == 2
+    assert all(3 not in g.qubits() for g in cone.gates)
+
+
+def test_circuit_compile_fn_matches_run():
+    import jax
+
+    from qrack_tpu.ops import gatekernels as gk
+
+    rng = QrackRandom(7)
+    c = QCircuit(5)
+    for _ in range(20):
+        t = rng.randint(0, 5)
+        k = rng.randint(0, 3)
+        if k == 0:
+            c.append_1q(t, mat.H2)
+        elif k == 1:
+            c.append_1q(t, mat.u3_mtrx(rng.rand(), rng.rand(), rng.rand()))
+        else:
+            ctl = rng.randint(0, 5)
+            if ctl != t:
+                c.append_ctrl((ctl,), t, mat.X2, 1)
+    q = cpu_factory(5, rng=QrackRandom(1))
+    c.Run(q)
+    fn = jax.jit(c.compile_fn(5))
+    planes = fn(gk.to_planes(np.eye(1, 32, 0).ravel()))
+    np.testing.assert_allclose(gk.from_planes(planes), q.GetQuantumState(), atol=3e-6)
+
+
+# ---------------- QTensorNetwork ----------------
+
+def test_tensornetwork_light_cone_elision():
+    # a QUnit below makes full-width materialization cheap (the reference
+    # stacks QTensorNetwork over QUnit the same way, SURVEY.md §1)
+    from qrack_tpu.layers.qunit import QUnit
+
+    def unit_stack(n, **kw):
+        kw.setdefault("rand_global_phase", False)
+        return QUnit(n, unit_factory=cpu_factory, **kw)
+
+    q = QTensorNetwork(30, stack_factory=unit_stack, rng=QrackRandom(1),
+                       rand_global_phase=False)
+    # gates over 30 qubits, but the queried qubit's cone is 2 qubits wide
+    for i in range(30):
+        q.H(i)
+    q.CNOT(0, 1)
+    assert q.isBuffering()
+    assert q.Prob(1) == pytest.approx(0.5, abs=1e-6)
+    assert q.isBuffering()  # probability query must not materialize
+
+
+def test_tensornetwork_matches_oracle():
+    n = 5
+    q = QTensorNetwork(n, stack_factory=cpu_factory, rng=QrackRandom(5),
+                       rand_global_phase=False)
+    o = cpu_factory(n, rng=QrackRandom(5))
+    random_circuit(q, QrackRandom(600), 30, n)
+    random_circuit(o, QrackRandom(600), 30, n)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+    # measurement materializes and stays consistent
+    q.rng.seed(9)
+    o.rng.seed(9)
+    assert q.M(2) == o.M(2)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+
+
+# ---------------- noisy wrapper ----------------
+
+def test_noisy_wrapper_degrades_fidelity():
+    q = QInterfaceNoisy(2, inner_factory=cpu_factory, noise=0.2,
+                        rng=QrackRandom(3))
+    for _ in range(30):
+        q.H(0)
+        q.CNOT(0, 1)
+    assert q.GetUnitaryFidelity() < 0.01
+    q.ResetUnitaryFidelity()
+    assert q.GetUnitaryFidelity() == 1.0
+    # zero noise is exact
+    q0 = QInterfaceNoisy(3, inner_factory=cpu_factory, noise=0.0,
+                         rng=QrackRandom(4), rand_global_phase=False)
+    o = cpu_factory(3, rng=QrackRandom(4))
+    random_circuit(q0, QrackRandom(700), 20, 3)
+    random_circuit(o, QrackRandom(700), 20, 3)
+    assert fid(q0, o) == pytest.approx(1.0, abs=1e-8)
+
+
+# ---------------- factory ----------------
+
+@pytest.mark.parametrize("layers", [
+    "cpu", "tpu", "optimal",
+    ["unit", "stabilizer_hybrid", "cpu"],
+    ["tensor_network", "unit", "cpu"],
+    ["noisy", "unit", "cpu"],
+    ["unit_multi", "cpu"],
+    ["stabilizer"],
+])
+def test_factory_stacks_run_teleport(layers):
+    ok = 0
+    for t in range(5):
+        q = create_quantum_interface(layers, 3, rng=QrackRandom(50 + t))
+        if layers == ["stabilizer"]:
+            q.H(0)  # Clifford-only payload
+        else:
+            q.U(0, 0.8, 0.3, -0.5)
+        before, after = algo.teleport(q)
+        ok += abs(after - before) < 1e-5
+    assert ok == 5
+
+
+def test_arranged_layers_full():
+    from qrack_tpu import create_arranged_layers_full
+
+    q = create_arranged_layers_full(sd=True, sh=True, hy=False, pg=False,
+                                    oc=False, qubit_count=4,
+                                    rng=QrackRandom(1), rand_global_phase=False)
+    algo.ghz(q)
+    q.rng.seed(3)
+    r = q.MAll()
+    assert r in (0, 0b1111)
+
+
+# ---------------- models ----------------
+
+def test_grover_model():
+    q = create_quantum_interface("cpu", 7, rng=QrackRandom(5))
+    assert algo.grover_search(q, 42) == 42
+
+
+def test_shor_model():
+    for seed in range(6):
+        q = create_quantum_interface("cpu", 8, rng=QrackRandom(80 + seed))
+        f = algo.shor_order_find(q, 7, 15, 4)
+        if f is not None:
+            assert f in (3, 5)
+            return
+    pytest.fail("no factor found in 6 rounds")
+
+
+def test_rcs_and_xeb():
+    n = 6
+    q = cpu_factory(n, rng=QrackRandom(9))
+    algo.random_circuit_sampling(q, 4, QrackRandom(10))
+    probs = q.GetProbs()
+    shots = q.MultiShotMeasureMask([1 << i for i in range(n)], 300)
+    samples = [k for k, v in shots.items() for _ in range(v)]
+    x = algo.xeb_fidelity(probs, samples)
+    assert x > 0.3  # ideal sampler: XEB ~ 1
+
+
+def test_quantum_volume_model():
+    q = create_quantum_interface("optimal", 5, rng=QrackRandom(11))
+    r = algo.quantum_volume(q, rng=QrackRandom(12))
+    assert 0 <= r < 32
+
+
+def test_qunit_multi_placement():
+    q = QUnitMulti(6, unit_factory=cpu_factory, rng=QrackRandom(13),
+                   device_ids=[0, 1], rand_global_phase=False)
+    q.H(0)
+    q.CNOT(0, 1)
+    q.H(3)
+    q.CNOT(3, 4)
+    o = cpu_factory(6, rng=QrackRandom(13))
+    o.H(0); o.CNOT(0, 1); o.H(3); o.CNOT(3, 4)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+
+
+# ---------------- QNeuron ----------------
+
+def test_qneuron_learns_identity():
+    q = create_quantum_interface("cpu", 2, rng=QrackRandom(21))
+    neuron = QNeuron(q, [0], 1)
+    # teach: output should equal input
+    for epoch in range(40):
+        for val in (False, True):
+            q.SetPermutation(1 if val else 0)
+            neuron.LearnPermutation(eta=0.25, expected=val)
+    correct = 0
+    for val in (False, True):
+        q.SetPermutation(1 if val else 0)
+        p = neuron.Predict(expected=val)
+        correct += p > 0.8
+    assert correct == 2
+
+
+def test_controlled_phase_identity_not_dropped():
+    # regression: CS then CIS-like payload product = i*I controlled must
+    # keep the relative phase on the control subspace
+    c = QCircuit(2)
+    c.append_ctrl((0,), 1, np.diag([1, 1j]), 1)
+    c.append_ctrl((0,), 1, np.diag([1j, 1]), 1)
+    q = cpu_factory(2, rng=QrackRandom(1))
+    q.H(0)
+    c.Run(q)
+    o = cpu_factory(2, rng=QrackRandom(1))
+    o.H(0)
+    o.MCMtrxPerm((0,), np.diag([1j, 1j]), 1, 1)
+    np.testing.assert_allclose(q.GetQuantumState(), o.GetQuantumState(), atol=1e-10)
+    # uncontrolled global-phase identity IS droppable
+    c2 = QCircuit(1)
+    c2.append_1q(0, np.diag([1j, 1j]) @ mat.H2)
+    c2.append_1q(0, np.conj((np.diag([1j, 1j]) @ mat.H2).T))
+    assert c2.GetGateCount() == 0
