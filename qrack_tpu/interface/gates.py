@@ -192,21 +192,17 @@ class GatesMixin:
         self.UCMtrx(tuple(controls), mtrxs, target)
 
     def UniformlyControlledRY(self, controls, target: int, angles) -> None:
-        import numpy as _np
-
         ms = []
         for a in angles:
             c, s = math.cos(a / 2), math.sin(a / 2)
-            ms.append(_np.array([[c, -s], [s, c]], dtype=_np.complex128))
+            ms.append(np.array([[c, -s], [s, c]], dtype=np.complex128))
         self.UCMtrx(tuple(controls), ms, target)
 
     def UniformlyControlledRZ(self, controls, target: int, angles) -> None:
-        import numpy as _np
-
         ms = []
         for a in angles:
-            ms.append(_np.array([[cmath.exp(-0.5j * a), 0], [0, cmath.exp(0.5j * a)]],
-                                dtype=_np.complex128))
+            ms.append(np.array([[cmath.exp(-0.5j * a), 0], [0, cmath.exp(0.5j * a)]],
+                               dtype=np.complex128))
         self.UCMtrx(tuple(controls), ms, target)
 
     # ---------------- multi-target X/Z/phase masks ----------------

@@ -56,10 +56,7 @@ class QInterfaceNoisy(QInterface):
         self._apply_noise((target,) + tuple(controls))
 
     def Apply4x4(self, m, q1, q2) -> None:
-        if hasattr(self.inner, "Apply4x4"):
-            self.inner.Apply4x4(m, q1, q2)
-        else:
-            super().Apply4x4(m, q1, q2)
+        self.inner.Apply4x4(m, q1, q2)
         self._apply_noise((q1, q2))
 
     def Swap(self, q1: int, q2: int) -> None:
@@ -80,7 +77,11 @@ class QInterfaceNoisy(QInterface):
         return self.inner.MAll()
 
     def Compose(self, other, start=None) -> int:
-        inner = other.inner if isinstance(other, QInterfaceNoisy) else other
+        if isinstance(other, QInterfaceNoisy):
+            inner = other.inner
+            self.log_fidelity += other.log_fidelity
+        else:
+            inner = other
         res = self.inner.Compose(inner, start)
         self.qubit_count = self.inner.qubit_count
         return res

@@ -25,7 +25,14 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..interface import QInterface
+from ..native import get_tableau_lib
 from .. import matrices as mat
+
+
+def _as_u8p(arr):
+    import ctypes
+
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
 class CliffordError(Exception):
@@ -265,7 +272,11 @@ class QStabilizer(QInterface):
 
     def Prob(self, q: int) -> float:
         self._check_qubit(q)
-        if self._find_random_row(q) is not None:
+        lib = get_tableau_lib()
+        if lib is not None and self.x.flags["C_CONTIGUOUS"]:
+            if not lib.tb_is_separable_z(_as_u8p(self.x), self.qubit_count, q):
+                return 0.5
+        elif self._find_random_row(q) is not None:
             return 0.5
         return 1.0 if self._deterministic_outcome(q) else 0.0
 
@@ -282,6 +293,19 @@ class QStabilizer(QInterface):
     def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
         self._check_qubit(q)
         n = self.qubit_count
+        lib = get_tableau_lib()
+        if (lib is not None and self.x.flags["C_CONTIGUOUS"]
+                and self.z.flags["C_CONTIGUOUS"]):
+            rand_bit = 0
+            if not do_force and self._find_random_row(q) is not None:
+                rand_bit = 1 if self.Rand() < 0.5 else 0
+            out = lib.tb_force_m(_as_u8p(self.x), _as_u8p(self.z), _as_u8p(self.r),
+                                 n, q, 1 if result else 0,
+                                 1 if do_force else 0, 1 if do_apply else 0,
+                                 rand_bit)
+            if out < 0:
+                raise RuntimeError("ForceM: forced result has zero probability")
+            return bool(out)
         p = self._find_random_row(q)
         if p is None:
             out = self._deterministic_outcome(q)
@@ -311,9 +335,16 @@ class QStabilizer(QInterface):
     def _canonical_stab(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Gaussian-eliminated copy of the stabilizer block."""
         n = self.qubit_count
+        # MUST be copies: ascontiguousarray on a contiguous slice returns
+        # an aliasing view, and canonicalization would corrupt the live
+        # stabilizer rows against their destabilizer pairs
         x = self.x[n:2 * n].copy()
         z = self.z[n:2 * n].copy()
         r = self.r[n:2 * n].copy()
+        lib = get_tableau_lib()
+        if lib is not None:
+            x_rank = int(lib.tb_canonical(_as_u8p(x), _as_u8p(z), _as_u8p(r), n))
+            return x, z, r, x_rank
 
         def mul_into(h, i):
             phase = 2 * int(r[h]) + 2 * int(r[i]) + int(

@@ -59,7 +59,7 @@ def teleport(qsim, prepare=None) -> Tuple[float, float]:
     return before, qsim.Prob(2)
 
 
-def shor_order_find(qsim, base: int, to_factor: int, width: int, rng=None) -> Optional[int]:
+def shor_order_find(qsim, base: int, to_factor: int, width: int) -> Optional[int]:
     """One period-finding round of Shor's algorithm (reference:
     examples/shors_factoring.cpp:98-160). Needs 2*width qubits.
     Returns a nontrivial factor or None."""
@@ -108,6 +108,8 @@ def quantum_volume(qsim, depth: Optional[int] = None, rng=None) -> int:
     """QV-style circuit: `depth` rounds of random SU(4)-ish blocks on a
     random qubit pairing (reference: examples/quantum_volume.cpp:1-110).
     Returns the heavy-output count proxy (measured value)."""
+    if rng is None:
+        rng = qsim.rng
     n = qsim.GetQubitCount()
     depth = depth if depth is not None else n
     for _ in range(depth):

@@ -1,0 +1,69 @@
+"""Native kernel loader: builds and binds the C++ tableau hot loops.
+
+Build-on-first-use with g++ (the image's native toolchain), cached as a
+shared object beside the source; every entry point has a pure-Python
+fallback in the stabilizer engine, so absence of a compiler only costs
+speed (reference analogue: the OpenCL JIT + binary cache,
+src/common/oclengine.cpp:150-202)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "tableau.cpp")
+_SO = os.path.join(_HERE, "_tableau.so")
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
+            return True
+        res = subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO + ".tmp"],
+            capture_output=True, timeout=120,
+        )
+        if res.returncode != 0:
+            return False
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except Exception:
+        return False
+
+
+def get_tableau_lib():
+    """Return the bound ctypes library, or None (use Python fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("QRACK_TPU_NO_NATIVE"):
+            return None
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.tb_force_m.restype = ctypes.c_int
+            lib.tb_force_m.argtypes = [u8p, u8p, u8p, ctypes.c_long, ctypes.c_long,
+                                       ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_int]
+            lib.tb_is_separable_z.restype = ctypes.c_int
+            lib.tb_is_separable_z.argtypes = [u8p, ctypes.c_long, ctypes.c_long]
+            lib.tb_canonical.restype = ctypes.c_long
+            lib.tb_canonical.argtypes = [u8p, u8p, u8p, ctypes.c_long]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib

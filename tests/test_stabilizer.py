@@ -192,3 +192,16 @@ def test_near_clifford_rotation_not_misrecognized():
         c, s_ = math.cos(th), math.sin(th)
         m = np.array([[c, -s_], [s_, c]])
         assert clifford_sequence(m) is None, th
+
+
+def test_get_state_does_not_corrupt_tableau():
+    # regression: ket extraction must not alias/canonicalize the live rows
+    for seed in (4, 5, 6):
+        s, d = make_pair(4, seed)
+        random_clifford(s, QrackRandom(1200 + seed), 40, 4)
+        random_clifford(d, QrackRandom(1200 + seed), 40, 4)
+        _ = s.GetQuantumState()
+        _ = s.GetQuantumState()
+        for q in range(4):
+            assert s.Prob(q) == pytest.approx(d.Prob(q), abs=1e-9), (seed, q)
+        assert_same_state(s, d)
