@@ -11,6 +11,7 @@ QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
   "unit" / "unit_multi" QUnit / QUnitMulti Schmidt factoring
   "stabilizer_hybrid"  Clifford tableau until forced off
   "stabilizer"         bare CHP tableau (Clifford-only)
+  "bdt" / "bdt_hybrid" QBdt decision tree / auto-switching hybrid
   "pager"              QPager sharded dense engine over the device mesh
   "hybrid"             QHybrid CPU<->TPU<->pager width switching
   "tpu"                QEngineTPU single-device dense engine
@@ -27,7 +28,7 @@ from typing import Callable, List, Optional, Sequence, Union
 OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
 OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
-_TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer"}
+_TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt"}
 
 
 def _terminal_factory(name: str, **opts) -> Callable:
@@ -51,6 +52,10 @@ def _terminal_factory(name: str, **opts) -> Callable:
         from .layers.stabilizer import QStabilizer
 
         return lambda n, **kw: QStabilizer(n, **{**opts, **kw})
+    if name == "bdt":
+        from .layers.qbdt import QBdt
+
+        return lambda n, **kw: QBdt(n, **{**opts, **kw})
     raise ValueError(f"unknown terminal layer {name!r}")
 
 
@@ -82,6 +87,10 @@ def build_factory(layers: Sequence[str], **opts) -> Callable:
         from .layers.qtensornetwork import QTensorNetwork
 
         return lambda n, **kw: QTensorNetwork(n, stack_factory=below, **kw)
+    if head == "bdt_hybrid":
+        from .layers.qbdthybrid import QBdtHybrid
+
+        return lambda n, **kw: QBdtHybrid(n, engine_factory=below, **kw)
     if head == "noisy":
         from .layers.noisy import QInterfaceNoisy
 
@@ -120,7 +129,7 @@ def create_arranged_layers_full(nw: bool = False, md: bool = False, sd: bool = T
     CreateArrangedLayersFull; pinvoke init_count_type
     include/pinvoke_api.hpp:42): nw=noisy wrapper, md=multi-device QUnit,
     sd=Schmidt decomposition (QUnit), sh=stabilizer hybrid, bdt=binary
-    decision tree (pending), pg=paging, tn=tensor network, hy=hybrid,
+    decision tree hybrid, pg=paging, tn=tensor network, hy=hybrid,
     oc="OpenCL"→accelerator (TPU here)."""
     layers: List[str] = []
     if nw:
@@ -131,6 +140,8 @@ def create_arranged_layers_full(nw: bool = False, md: bool = False, sd: bool = T
         layers.append("unit_multi" if md else "unit")
     if sh:
         layers.append("stabilizer_hybrid")
+    if bdt:
+        layers.append("bdt_hybrid")
     if hy:
         layers.append("hybrid")
     elif pg and oc:

@@ -5,3 +5,5 @@ from .qunitmulti import QUnitMulti  # noqa: F401
 from .qcircuit import QCircuit, QCircuitGate  # noqa: F401
 from .qtensornetwork import QTensorNetwork  # noqa: F401
 from .noisy import QInterfaceNoisy  # noqa: F401
+from .qbdt import QBdt  # noqa: F401
+from .qbdthybrid import QBdtHybrid  # noqa: F401

@@ -1,0 +1,146 @@
+"""QBdtHybrid: decision-tree representation until it stops compressing.
+
+Re-design of the reference layer (reference: include/qbdthybrid.hpp:33
+— SwitchMode between QBdt and QHybrid on entanglement/compression
+ratio). The tree wins while node_count << 2^n; once a gate inflates the
+tree past `ratio_threshold * 2^n` nodes, the ket materializes into the
+dense engine stack and stays there (the reverse direction is a
+later-round refinement, as in the reference's one-way hysteresis)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..interface import QInterface
+from .qbdt import QBdt
+
+
+def _default_engine_factory(n, **kw):
+    from ..engines.hybrid import QHybrid
+
+    return QHybrid(n, **kw)
+
+
+class QBdtHybrid(QInterface):
+    def __init__(self, qubit_count: int, init_state: int = 0,
+                 engine_factory: Optional[Callable] = None,
+                 ratio_threshold: float = 0.25, **kwargs):
+        super().__init__(qubit_count, init_state=init_state, **kwargs)
+        self._factory = engine_factory or _default_engine_factory
+        self._kw = {k: v for k, v in kwargs.items() if k != "rng"}
+        self.ratio = ratio_threshold
+        self.bdt: Optional[QBdt] = QBdt(qubit_count, init_state=init_state,
+                                        rng=self.rng.spawn(), **self._kw)
+        self.engine = None
+
+    def _live(self):
+        return self.engine if self.engine is not None else self.bdt
+
+    def SwitchToEngine(self) -> None:
+        if self.engine is not None:
+            return
+        state = self.bdt.GetQuantumState()
+        self.engine = self._factory(self.qubit_count, rng=self.rng.spawn(), **self._kw)
+        self.engine.SetQuantumState(state)
+        self.bdt = None
+
+    def _maybe_switch(self) -> None:
+        if self.engine is not None:
+            return
+        # switch on compression failure: ratio of the dense size for
+        # narrow registers, absolute node budget for wide ones (a wide
+        # tree must hand off before it exhausts host memory)
+        budget = min(self.ratio * (1 << min(self.qubit_count, 30)), float(1 << 20))
+        if self.bdt.node_count() > budget + 8:
+            self.SwitchToEngine()
+
+    def MCMtrxPerm(self, controls, mtrx, target, perm) -> None:
+        self._live().MCMtrxPerm(controls, mtrx, target, perm)
+        self._maybe_switch()
+
+    def Prob(self, q: int) -> float:
+        return self._live().Prob(q)
+
+    def ForceM(self, q, result, do_force=True, do_apply=True) -> bool:
+        live = self._live()
+        live.rng = self.rng
+        return live.ForceM(q, result, do_force, do_apply)
+
+    def MAll(self) -> int:
+        live = self._live()
+        live.rng = self.rng
+        return live.MAll()
+
+    def GetQuantumState(self) -> np.ndarray:
+        return np.asarray(self._live().GetQuantumState())
+
+    def SetQuantumState(self, state) -> None:
+        if self.engine is not None:
+            self.engine.SetQuantumState(state)
+        else:
+            self.bdt.SetQuantumState(state)
+            self._maybe_switch()
+
+    def GetAmplitude(self, perm: int) -> complex:
+        return self._live().GetAmplitude(perm)
+
+    def SetPermutation(self, perm: int, phase=None) -> None:
+        # reset returns to the compressed representation; phase (explicit
+        # or random-global) must survive the rebuild
+        self.engine = None
+        self.bdt = QBdt(self.qubit_count, rng=self.rng.spawn(), **self._kw)
+        self.bdt.rand_global_phase = self.rand_global_phase
+        self.bdt.SetPermutation(perm, phase)
+
+    def Compose(self, other, start=None) -> int:
+        inner = other._live() if isinstance(other, QBdtHybrid) else other
+        res = self._live().Compose(
+            inner.Clone() if hasattr(inner, "Clone") else inner, start)
+        self.qubit_count = self._live().qubit_count
+        self._maybe_switch()
+        return res
+
+    def Decompose(self, start, dest) -> None:
+        inner = dest._live() if isinstance(dest, QBdtHybrid) else dest
+        self._live().Decompose(start, inner)
+        if isinstance(dest, QBdtHybrid):
+            dest.qubit_count = inner.qubit_count
+        self.qubit_count = self._live().qubit_count
+
+    def Dispose(self, start, length, disposed_perm=None) -> None:
+        self._live().Dispose(start, length, disposed_perm)
+        self.qubit_count = self._live().qubit_count
+
+    def Allocate(self, start, length=1) -> int:
+        res = self._live().Allocate(start, length)
+        self.qubit_count = self._live().qubit_count
+        return res
+
+    def Clone(self) -> "QBdtHybrid":
+        c = QBdtHybrid(self.qubit_count, engine_factory=self._factory,
+                       ratio_threshold=self.ratio, rng=self.rng.spawn(), **self._kw)
+        if self.engine is not None:
+            c.engine = self.engine.Clone()
+            c.bdt = None
+        else:
+            c.bdt = self.bdt.Clone()
+        return c
+
+    def SumSqrDiff(self, other) -> float:
+        a = self.GetQuantumState()
+        b = np.asarray(other.GetQuantumState(), dtype=np.complex128)
+        inner = np.vdot(a, b)
+        return float(max(0.0, 1.0 - abs(inner) ** 2))
+
+    def GetProbs(self) -> np.ndarray:
+        s = self.GetQuantumState()
+        return s.real ** 2 + s.imag ** 2
+
+    def isBinaryDecisionTree(self) -> bool:
+        return self.engine is None
+
+    def Finish(self) -> None:
+        if self.engine is not None:
+            self.engine.Finish()

@@ -27,13 +27,17 @@ def _build() -> bool:
         src_mtime = os.path.getmtime(_SRC)
         if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
             return True
+        # no -march=native: a cached .so may outlive the build host's ISA
+        # (SIGILL beats the graceful fallback); per-PID temp avoids
+        # concurrent-build races corrupting the installed object
+        tmp = f"{_SO}.{os.getpid()}.tmp"
         res = subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC, "-o", _SO + ".tmp"],
+            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
             capture_output=True, timeout=120,
         )
         if res.returncode != 0:
             return False
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
         return True
     except Exception:
         return False

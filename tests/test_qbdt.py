@@ -1,0 +1,137 @@
+"""QBdt binary-decision-diagram engine vs the dense oracle."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.qbdt import QBdt
+from qrack_tpu.utils.rng import QrackRandom
+
+from test_engine_matrix import random_circuit, align_phase
+
+
+def make_pair(n, seed=1):
+    b = QBdt(n, rng=QrackRandom(seed), rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+    return b, d
+
+
+def assert_match(b, d, atol=1e-7):
+    got = align_phase(b.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=atol)
+
+
+def test_basis_and_1q_gates():
+    b, d = make_pair(4)
+    for eng in (b, d):
+        eng.SetPermutation(0b1010)
+        eng.H(0)
+        eng.T(1)
+        eng.U(2, 0.3, 0.7, -0.4)
+    assert_match(b, d)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_random_circuits(seed):
+    n = 5
+    b, d = make_pair(n, seed)
+    random_circuit(b, QrackRandom(1500 + seed), 40, n)
+    random_circuit(d, QrackRandom(1500 + seed), 40, n)
+    assert_match(b, d, atol=1e-6)
+
+
+def test_control_below_target():
+    # control deeper than target in the tree
+    b, d = make_pair(3)
+    for eng in (b, d):
+        eng.H(2)
+        eng.CNOT(2, 0)   # control qubit 2 (deep), target 0 (root)
+        eng.MCMtrxPerm((1, 2), np.array([[0, 1], [1, 0]]), 0, 0b10)
+    assert_match(b, d)
+
+
+def test_measurement():
+    b, d = make_pair(4, seed=7)
+    for eng in (b, d):
+        eng.H(0)
+        eng.CNOT(0, 1)
+        eng.CNOT(1, 2)
+        eng.rng.seed(9)
+    assert b.Prob(2) == pytest.approx(d.Prob(2), abs=1e-9)
+    assert b.M(1) == d.M(1)
+    assert_match(b, d)
+
+
+def test_ghz_compression():
+    # GHZ at 40 qubits: dense impossible, tree is O(n) nodes
+    b = QBdt(40, rng=QrackRandom(3), rand_global_phase=False)
+    b.H(0)
+    for i in range(39):
+        b.CNOT(i, i + 1)
+    assert b.node_count() <= 2 * 40 + 4
+    assert b.Prob(35) == pytest.approx(0.5, abs=1e-9)
+    b.rng.seed(5)
+    m = b.M(20)
+    assert b.Prob(0) == pytest.approx(1.0 if m else 0.0, abs=1e-9)
+    amp = b.GetAmplitude((1 << 40) - 1 if m else 0)
+    assert abs(amp) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_set_get_state_roundtrip():
+    from helpers import rand_state
+
+    psi = rand_state(5, 9)
+    b = QBdt(5, rng=QrackRandom(1), rand_global_phase=False)
+    b.SetQuantumState(psi)
+    np.testing.assert_allclose(b.GetQuantumState(), psi, atol=1e-10)
+
+
+def test_compose_and_clone():
+    a, d = make_pair(2, seed=3)
+    for eng in (a, d):
+        eng.H(0)
+        eng.CNOT(0, 1)
+    other = QBdt(1, rng=QrackRandom(4), rand_global_phase=False)
+    other.X(0)
+    od = QEngineCPU(1, rng=QrackRandom(4), rand_global_phase=False)
+    od.X(0)
+    a.Compose(other)
+    d.Compose(od)
+    assert a.qubit_count == 3
+    assert_match(a, d)
+    c = a.Clone()
+    c.X(0)
+    assert abs(np.vdot(a.GetQuantumState(), c.GetQuantumState())) < 0.8
+
+
+def test_bdt_hybrid_switches_on_blowup():
+    from qrack_tpu.layers.qbdthybrid import QBdtHybrid
+
+    def factory(n, **kw):
+        kw.setdefault("rand_global_phase", False)
+        return QEngineCPU(n, **kw)
+
+    q = QBdtHybrid(6, engine_factory=factory, ratio_threshold=0.2,
+                   rng=QrackRandom(5), rand_global_phase=False)
+    d = QEngineCPU(6, rng=QrackRandom(5), rand_global_phase=False)
+    # GHZ stays a tree
+    for eng in (q, d):
+        eng.H(0)
+        for i in range(5):
+            eng.CNOT(i, i + 1)
+    assert q.isBinaryDecisionTree()
+    # dense-entangling random circuit blows the tree up -> engine
+    random_circuit(q, QrackRandom(1600), 60, 6)
+    random_circuit(d, QrackRandom(1600), 60, 6)
+    assert not q.isBinaryDecisionTree()
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
+
+
+def test_bdt_through_factory():
+    from qrack_tpu import create_quantum_interface
+    from qrack_tpu.models import algorithms as algo
+
+    q = create_quantum_interface(["bdt_hybrid", "cpu"], 3, rng=QrackRandom(7))
+    before, after = algo.teleport(q, prepare=lambda s: s.U(0, 0.8, 0.3, -0.5))
+    assert abs(after - before) < 1e-5
