@@ -1,35 +1,52 @@
-"""Round benchmark: fused whole-circuit QFT wall-clock on one TPU chip.
+"""Round benchmark: fused whole-circuit wall-clock on one TPU chip.
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
-Protocol follows the reference's benchmark discipline (reference:
-test/benchmarks.cpp:98-300 benchmarkLoopVariable — warm-up excluded,
-average over samples). vs_baseline = CPU-oracle wall-clock / ours at
-the same width (cached in bench_baseline.json after first measurement;
-the oracle is this framework's numpy engine, the BASELINE.md parity
-reference)."""
+Workload selectable via QRACK_BENCH=qft|rcs (default qft; rcs is the
+reference's test_random_circuit_sampling_nn structure at depth
+QRACK_BENCH_DEPTH). Protocol follows the reference's benchmark
+discipline (reference: test/benchmarks.cpp:98-300 benchmarkLoopVariable
+— warm-up excluded, average over samples). vs_baseline = CPU-oracle
+wall-clock / ours for the same workload (cached in
+bench_baseline.json; the oracle is this framework's numpy engine, the
+BASELINE.md parity reference)."""
 
 import json
 import os
 import sys
 import time
 
+WORKLOAD = os.environ.get("QRACK_BENCH", "qft")
 WIDTH = int(os.environ.get("QRACK_BENCH_QB", "26"))
+DEPTH = int(os.environ.get("QRACK_BENCH_DEPTH", "8"))
 SAMPLES = int(os.environ.get("QRACK_BENCH_SAMPLES", "5"))
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+
+
+def _make_fn():
+    from qrack_tpu.models import qft as qftm
+
+    if WORKLOAD not in ("qft", "rcs"):
+        raise ValueError(f"unknown QRACK_BENCH workload {WORKLOAD!r}")
+    if WORKLOAD == "rcs":
+        from qrack_tpu.models import rcs as rcsm
+
+        return rcsm.make_rcs_fn(WIDTH, DEPTH, seed=7), qftm.basis_planes(WIDTH, 0)
+    return qftm.make_qft_fn(WIDTH), qftm.basis_planes(WIDTH, 12345)
 
 
 def _tpu_seconds() -> float:
     import jax
 
+    plat = os.environ.get("QRACK_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"))
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
-    from qrack_tpu.models import qft as qftm
-
-    fn = jax.jit(qftm.make_qft_fn(WIDTH), donate_argnums=(0,))
-    planes = qftm.basis_planes(WIDTH, 12345)
+    body, planes = _make_fn()
+    fn = jax.jit(body, donate_argnums=(0,))
     # warm-up: compile + first run (excluded, reference benchmark style)
     planes = fn(planes)
     planes.block_until_ready()
@@ -43,23 +60,34 @@ def _tpu_seconds() -> float:
 
 
 def _cpu_baseline_seconds() -> float:
+    key = (f"cpu_rcs_d{DEPTH}_s" if WORKLOAD == "rcs" else "cpu_qft_s")
+    data = {}
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
             data = json.load(f)
-        if data.get("width") == WIDTH:
-            return float(data["cpu_qft_s"])
+        if data.get("width") == WIDTH and key in data:
+            return float(data[key])
     import numpy as np
 
     from qrack_tpu import QEngineCPU, set_config
     from qrack_tpu.utils.rng import QrackRandom
 
     set_config(max_cpu_qubits=max(WIDTH, 28))
-    q = QEngineCPU(WIDTH, dtype=np.complex64, rng=QrackRandom(1))
+    q = QEngineCPU(WIDTH, dtype=np.complex64, rng=QrackRandom(1),
+                   rand_global_phase=False)
     t0 = time.perf_counter()
-    q.QFT(0, WIDTH)
+    if WORKLOAD == "rcs":
+        from qrack_tpu.models import rcs as rcsm
+
+        rcsm.reference_rcs_state(WIDTH, DEPTH, 7, q)
+    else:
+        q.QFT(0, WIDTH)
     cpu_s = time.perf_counter() - t0
+    if data.get("width") != WIDTH:
+        data = {"width": WIDTH}
+    data[key] = cpu_s
     with open(BASELINE_FILE, "w") as f:
-        json.dump({"width": WIDTH, "cpu_qft_s": cpu_s}, f)
+        json.dump(data, f)
     return cpu_s
 
 
@@ -71,7 +99,7 @@ def main() -> None:
     except Exception:
         vs = 0.0
     print(json.dumps({
-        "metric": f"qft{WIDTH}_fused_wall",
+        "metric": f"{WORKLOAD}{WIDTH}_fused_wall",
         "value": round(tpu_s, 6),
         "unit": "s",
         "vs_baseline": round(vs, 3),

@@ -1,2 +1,3 @@
 from .qengine import QEngine  # noqa: F401
 from .cpu import QEngineCPU  # noqa: F401
+from .sparse import QEngineSparse  # noqa: F401

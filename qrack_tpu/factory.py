@@ -11,11 +11,13 @@ QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
   "unit" / "unit_multi" QUnit / QUnitMulti Schmidt factoring
   "stabilizer_hybrid"  Clifford tableau until forced off
   "stabilizer"         bare CHP tableau (Clifford-only)
+  "unit_clifford"      QUnit factoring over per-subsystem tableaus
   "bdt" / "bdt_hybrid" QBdt decision tree / auto-switching hybrid
   "pager"              QPager sharded dense engine over the device mesh
   "hybrid"             QHybrid CPU<->TPU<->pager width switching
   "tpu"                QEngineTPU single-device dense engine
   "cpu"                QEngineCPU host oracle
+  "sparse"             QEngineSparse map-style sparse state vector
 
 create_quantum_interface(layers, n) composes them top-down; OPTIMAL is
 ["unit", "stabilizer_hybrid", "hybrid"] — the reference's production
@@ -28,7 +30,8 @@ from typing import Callable, List, Optional, Sequence, Union
 OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
 OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
-_TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt"}
+_TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt",
+             "unit_clifford", "sparse"}
 
 
 def _terminal_factory(name: str, **opts) -> Callable:
@@ -56,6 +59,14 @@ def _terminal_factory(name: str, **opts) -> Callable:
         from .layers.qbdt import QBdt
 
         return lambda n, **kw: QBdt(n, **{**opts, **kw})
+    if name == "sparse":
+        from .engines.sparse import QEngineSparse
+
+        return lambda n, **kw: QEngineSparse(n, **{**opts, **kw})
+    if name == "unit_clifford":
+        from .layers.qunitclifford import QUnitClifford
+
+        return lambda n, **kw: QUnitClifford(n, **{**opts, **kw})
     raise ValueError(f"unknown terminal layer {name!r}")
 
 

@@ -7,3 +7,4 @@ from .qtensornetwork import QTensorNetwork  # noqa: F401
 from .noisy import QInterfaceNoisy  # noqa: F401
 from .qbdt import QBdt  # noqa: F401
 from .qbdthybrid import QBdtHybrid  # noqa: F401
+from .qunitclifford import QUnitClifford  # noqa: F401

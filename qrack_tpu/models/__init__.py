@@ -1,2 +1,3 @@
 from . import qft  # noqa: F401
 from . import algorithms  # noqa: F401
+from . import rcs  # noqa: F401

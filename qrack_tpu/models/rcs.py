@@ -1,0 +1,66 @@
+"""Fused random-circuit-sampling programs — the RCS headline benchmark.
+
+The reference's RCS benchmarks dispatch one kernel per gate (reference:
+test/benchmarks.cpp:4141 test_random_circuit_sampling_nn — random
+sqrt-root layers + brick-wall ISwap couplers). TPU-native, a whole
+depth-d circuit traces into one XLA executable: single-qubit roots are
+plane-mixing 2x2 contractions, couplers are one 4x4 contraction each,
+and XLA fuses across layers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .. import matrices as mat
+from ..ops import gatekernels as gk
+from ..utils.rng import QrackRandom
+
+_ISWAP4 = np.array(
+    [[1, 0, 0, 0], [0, 0, 1j, 0], [0, 1j, 0, 0], [0, 0, 0, 1]],
+    dtype=np.complex128,
+)
+_ROOTS = (mat.SQRTX2, mat.SQRTY2, mat.SQRTW2)
+
+
+def rcs_layers(n: int, depth: int, seed: int):
+    """Deterministic gate plan: per layer, a random root per qubit and the
+    brick-wall ISwap pairing (matches models.algorithms.random_circuit_sampling)."""
+    rng = QrackRandom(seed)
+    plan = []
+    for d in range(depth):
+        roots = [rng.randint(0, 3) for _ in range(n)]
+        off = d & 1
+        pairs = [(q, q + 1) for q in range(off, n - 1, 2)]
+        plan.append((roots, pairs))
+    return plan
+
+
+def make_rcs_fn(n: int, depth: int, seed: int):
+    """Jittable single-chip whole-RCS program over (2, 2^n) planes."""
+    plan = rcs_layers(n, depth, seed)
+
+    def fn(planes):
+        for (roots, pairs) in plan:
+            for q, g in enumerate(roots):
+                mp = gk.mtrx_planes(_ROOTS[g], planes.dtype)
+                planes = gk.apply_2x2(planes, mp, n, q)
+            for (a, b) in pairs:
+                mp4 = gk.mtrx_planes(_ISWAP4, planes.dtype)
+                planes = gk.apply_4x4(planes, mp4, n, a, b)
+        return planes
+
+    return fn
+
+
+def reference_rcs_state(n: int, depth: int, seed: int, engine) -> np.ndarray:
+    """Same plan through a gate-at-a-time engine (parity checking)."""
+    plan = rcs_layers(n, depth, seed)
+    for (roots, pairs) in plan:
+        for q, g in enumerate(roots):
+            engine.Mtrx(_ROOTS[g], q)
+        for (a, b) in pairs:
+            engine.Apply4x4(_ISWAP4, a, b)
+    return np.asarray(engine.GetQuantumState())

@@ -45,3 +45,16 @@ def test_sharded_qft_matches_oracle():
     ifn, _ = qftm.make_sharded_qft_fn(mesh, n, inverse=True)
     back = ifn(jax.device_put(out, sharding))
     np.testing.assert_allclose(gk.from_planes(jax.device_get(back)), psi, atol=5e-5)
+
+
+def test_fused_rcs_matches_gate_path():
+    import jax
+
+    from qrack_tpu.models import rcs as rcsm
+
+    n, depth = 6, 4
+    o = QEngineCPU(n, rng=QrackRandom(1), rand_global_phase=False)
+    expect = rcsm.reference_rcs_state(n, depth, seed=7, engine=o)
+    fn = jax.jit(rcsm.make_rcs_fn(n, depth, seed=7))
+    planes = fn(gk.to_planes(np.eye(1, 1 << n, 0).ravel()))
+    np.testing.assert_allclose(gk.from_planes(planes), expect, atol=3e-6)

@@ -1,0 +1,83 @@
+"""QUnitClifford: factored Clifford simulation vs oracle."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.qunitclifford import QUnitClifford
+from qrack_tpu.layers.stabilizer import CliffordError
+from qrack_tpu.utils.rng import QrackRandom
+
+from test_stabilizer import random_clifford
+
+
+def fid(a, b):
+    return abs(np.vdot(np.asarray(a.GetQuantumState()),
+                       np.asarray(b.GetQuantumState()))) ** 2
+
+
+def test_random_clifford_matches_oracle():
+    n = 6
+    for seed in (1, 2, 3):
+        q = QUnitClifford(n, rng=QrackRandom(seed), rand_global_phase=False)
+        d = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+        random_clifford(q, QrackRandom(2000 + seed), 60, n)
+        random_clifford(d, QrackRandom(2000 + seed), 60, n)
+        assert fid(q, d) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_factoring_accounting():
+    q = QUnitClifford(40, rng=QrackRandom(5))
+    # disjoint Bell pairs: units stay width 2 on a 40-qubit register
+    for i in range(0, 40, 2):
+        q.H(i)
+        q.CNOT(i, i + 1)
+    assert q.GetMaxUnitSize() == 2
+    assert q.Prob(39) == pytest.approx(0.5)
+    q.rng.seed(7)
+    m = q.M(38)
+    assert q.Prob(39) == (1.0 if m else 0.0)
+
+
+def test_non_clifford_rejected():
+    q = QUnitClifford(2, rng=QrackRandom(1))
+    with pytest.raises(CliffordError):
+        q.T(0)
+
+
+def test_measurement_and_separation():
+    q = QUnitClifford(5, rng=QrackRandom(9), rand_global_phase=False)
+    q.H(0)
+    for i in range(4):
+        q.CNOT(i, i + 1)
+    assert q.GetMaxUnitSize() == 5
+    q.rng.seed(11)
+    q.M(2)
+    assert all(s.cached for s in q.shards)
+
+
+def test_through_factory():
+    from qrack_tpu import create_quantum_interface
+
+    q = create_quantum_interface(["unit_clifford"], 4, rng=QrackRandom(3))
+    q.H(0)
+    q.CNOT(0, 1)
+    q.CNOT(1, 2)
+    shots = q.MultiShotMeasureMask([1, 2, 4], 200)
+    assert set(shots.keys()) <= {0, 7}
+
+
+def test_trimmed_controlled_non_clifford_rejected():
+    # regression: definite control trims away — payload must still be
+    # rejected at THIS gate, not a later one
+    import cmath
+
+    q = QUnitClifford(2, rng=QrackRandom(1))
+    q.X(0)
+    q.H(1)
+    with pytest.raises(CliffordError):
+        q.MCPhase((0,), 1.0, cmath.exp(0.25j * 3.14159265), 1)  # controlled-T
+    # untriggerable gate (control definitely 0) is a legal no-op
+    q2 = QUnitClifford(2, rng=QrackRandom(2))
+    q2.MCPhase((0,), 1.0, 1j, 1)  # CS with |0> control: cannot fire
+    assert q2.Prob(1) == 0.0

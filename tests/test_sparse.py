@@ -1,0 +1,118 @@
+"""QEngineSparse vs dense oracle + wide-register capabilities."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.engines.sparse import QEngineSparse
+from qrack_tpu.utils.rng import QrackRandom
+
+from test_engine_matrix import random_circuit
+
+
+def make_pair(n, seed=1):
+    s = QEngineSparse(n, rng=QrackRandom(seed), rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+    return s, d
+
+
+def assert_match(s, d, atol=1e-8):
+    np.testing.assert_allclose(s.GetQuantumState(), d.GetQuantumState(), atol=atol)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_random_circuits(seed):
+    n = 5
+    s, d = make_pair(n, seed)
+    random_circuit(s, QrackRandom(3000 + seed), 40, n)
+    random_circuit(d, QrackRandom(3000 + seed), 40, n)
+    assert_match(s, d, atol=1e-7)
+
+
+def test_wide_sparse_register():
+    # 50 qubits: impossible densely, trivial sparsely
+    s = QEngineSparse(50, rng=QrackRandom(5), rand_global_phase=False)
+    s.X(45)
+    s.H(0)
+    s.CNOT(0, 49)
+    assert s.nnz() == 2
+    assert s.Prob(49) == pytest.approx(0.5)
+    assert s.Prob(45) == pytest.approx(1.0)
+    s.INC(100, 10, 20)   # wide ALU on sparse support
+    assert s.nnz() == 2
+    s.rng.seed(7)
+    r = s.MAll()
+    assert (r >> 45) & 1 == 1
+
+
+def test_measurement_and_multishot():
+    s, d = make_pair(4, seed=9)
+    for eng in (s, d):
+        eng.H(0)
+        eng.CNOT(0, 1)
+        eng.CNOT(1, 2)
+        eng.rng.seed(11)
+    sh_s = s.MultiShotMeasureMask([1, 2, 4], 400)
+    sh_d = d.MultiShotMeasureMask([1, 2, 4], 400)
+    assert set(sh_s.keys()) <= {0, 7}
+    assert sh_s == sh_d
+    assert s.M(1) == d.M(1)
+    assert_match(s, d, atol=1e-7)
+
+
+def test_alu_forward_maps():
+    s, d = make_pair(7, seed=13)
+    for eng in (s, d):
+        eng.HReg(0, 3)
+        eng.INC(5, 0, 5)
+        eng.CINC(2, 0, 3, (6,))
+        eng.INCDECC(3, 0, 3, 5)
+        eng.ROL(2, 0, 5)
+        eng.Hash(0, 2, [2, 0, 3, 1])
+        eng.PhaseFlipIfLess(3, 0, 3)
+        eng.XMask(0b1010)
+    assert_match(s, d, atol=1e-8)
+
+
+def test_truncation_controls():
+    s = QEngineSparse(8, rng=QrackRandom(15), rand_global_phase=False,
+                      max_entries=16)
+    for i in range(8):
+        s.H(i)    # would be 256 entries; truncated to 16
+    assert s.nnz() <= 16
+    nrm = float(np.sum(np.abs(s._amp) ** 2))
+    assert nrm == pytest.approx(1.0, abs=1e-9)
+
+
+def test_compose_dispose_roundtrip():
+    s, d = make_pair(3, seed=17)
+    for eng in (s, d):
+        eng.H(0)
+        eng.CNOT(0, 1)
+    o_s = QEngineSparse(2, rng=QrackRandom(18), rand_global_phase=False)
+    o_s.X(0)
+    o_d = QEngineCPU(2, rng=QrackRandom(18), rand_global_phase=False)
+    o_d.X(0)
+    s.Compose(o_s)
+    d.Compose(o_d)
+    assert s.qubit_count == 5
+    assert_match(s, d)
+    s.Dispose(3, 2, 0b01)
+    d.Dispose(3, 2, 0b01)
+    assert_match(s, d)
+
+
+def test_through_factory():
+    from qrack_tpu import create_quantum_interface
+    from qrack_tpu.models import algorithms as algo
+
+    q = create_quantum_interface(["unit", "sparse"], 3, rng=QrackRandom(21))
+    before, after = algo.teleport(q, prepare=lambda s: s.U(0, 0.8, 0.3, -0.5))
+    assert abs(after - before) < 1e-6
+
+
+def test_compose_width_guard():
+    a = QEngineSparse(40, rng=QrackRandom(1), rand_global_phase=False)
+    b = QEngineSparse(40, rng=QrackRandom(2), rand_global_phase=False)
+    with pytest.raises(MemoryError):
+        a.Compose(b)
