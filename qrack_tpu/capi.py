@@ -1,0 +1,401 @@
+"""Flat simulator-registry API (the pinvoke surface).
+
+Re-design of the reference's C ABI used by PyQrack and the Q# runtime
+(reference: include/pinvoke_api.hpp:42-349 — simulator registry
+`init_count_type(...)` mapping layer toggles onto
+CreateArrangedLayersFull, flat gate/measure/expectation functions keyed
+by simulator id). Here the registry is process-local Python — the same
+function names and sid-based calling convention, so a PyQrack-style
+consumer ports by changing its import, and a future C shim can bind
+these 1:1 (ctypes/cffi) without reshaping the surface."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .factory import create_arranged_layers_full
+from .utils.rng import QrackRandom
+
+_REGISTRY: Dict[int, object] = {}
+_TOGGLES: Dict[int, dict] = {}
+_NEXT = [0]
+_LOCK = threading.Lock()
+
+
+def _new_sid() -> int:
+    with _LOCK:
+        sid = _NEXT[0]
+        _NEXT[0] += 1
+    return sid
+
+
+def _sim(sid: int):
+    q = _REGISTRY.get(sid)
+    if q is None:
+        raise KeyError(f"no simulator with id {sid}")
+    return q
+
+
+# ---------------------------------------------------------------------------
+# lifecycle (reference: init_count_type / destroy / seed,
+# include/pinvoke_api.hpp:42-60)
+# ---------------------------------------------------------------------------
+
+def init_count_type(q: int, tn: bool = False, md: bool = False, sd: bool = True,
+                    sh: bool = True, bdt: bool = False, pg: bool = True,
+                    nw: bool = False, hy: bool = True, oc: bool = True,
+                    hp: bool = False) -> int:
+    """Create a simulator with the reference's layer toggles; returns sid.
+    (hp=host-pointer is meaningless here and accepted for parity.)"""
+    sid = _new_sid()
+    toggles = dict(nw=nw, md=md, sd=sd, sh=sh, bdt=bdt, pg=pg, tn=tn, hy=hy, oc=oc)
+    _TOGGLES[sid] = toggles
+    _REGISTRY[sid] = create_arranged_layers_full(
+        qubit_count=q, rng=QrackRandom(), **toggles)
+    return sid
+
+
+def init_count(q: int) -> int:
+    return init_count_type(q)
+
+
+def init() -> int:
+    return init_count(1)
+
+
+def init_clone(sid: int) -> int:
+    nid = _new_sid()
+    _REGISTRY[nid] = _sim(sid).Clone()
+    _TOGGLES[nid] = dict(_TOGGLES.get(sid, {}))
+    return nid
+
+
+def destroy(sid: int) -> None:
+    _REGISTRY.pop(sid, None)
+    _TOGGLES.pop(sid, None)
+
+
+def seed(sid: int, s: int) -> None:
+    _sim(sid).SetRandomSeed(s)
+
+
+def num_qubits(sid: int) -> int:
+    return _sim(sid).GetQubitCount()
+
+
+def allocateQubit(sid: int, qid: int) -> None:
+    q = _sim(sid)
+    if qid >= q.GetQubitCount():
+        q.Allocate(q.GetQubitCount(), qid - q.GetQubitCount() + 1)
+
+
+def release(sid: int, qid: int) -> bool:
+    q = _sim(sid)
+    resp = q.Prob(qid) <= 1e-9
+    q.Dispose(qid, 1, None if not resp else 0)
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# gates (reference: include/pinvoke_api.hpp:66-220)
+# ---------------------------------------------------------------------------
+
+def X(sid, q): _sim(sid).X(q)
+def Y(sid, q): _sim(sid).Y(q)
+def Z(sid, q): _sim(sid).Z(q)
+def H(sid, q): _sim(sid).H(q)
+def S(sid, q): _sim(sid).S(q)
+def T(sid, q): _sim(sid).T(q)
+def AdjS(sid, q): _sim(sid).IS(q)
+def AdjT(sid, q): _sim(sid).IT(q)
+def SqrtX(sid, q): _sim(sid).SqrtX(q)
+def AdjSqrtX(sid, q): _sim(sid).ISqrtX(q)
+def U(sid, q, theta, phi, lambd): _sim(sid).U(q, theta, phi, lambd)
+def Mtrx(sid, m, q): _sim(sid).Mtrx(np.asarray(m, dtype=np.complex128).reshape(2, 2), q)
+def R(sid, basis, phi, q):
+    from .pauli import Pauli
+
+    b = Pauli(basis)
+    if b == Pauli.PauliX:
+        _sim(sid).RX(phi, q)
+    elif b == Pauli.PauliY:
+        _sim(sid).RY(phi, q)
+    elif b == Pauli.PauliZ:
+        _sim(sid).RZ(phi, q)
+    else:
+        # reference RHelper applies e^{i*phi/4} on both target halves
+        # (pinvoke_api.cpp:408-414)
+        _sim(sid).Exp(phi / 4, q)
+
+
+def MCX(sid, c: Sequence[int], q): _sim(sid).MCInvert(tuple(c), 1.0, 1.0, q)
+def MCY(sid, c, q): _sim(sid).MCInvert(tuple(c), -1j, 1j, q)
+def MCZ(sid, c, q): _sim(sid).MCPhase(tuple(c), 1.0, -1.0, q)
+def MCH(sid, c, q):
+    from . import matrices as mat
+
+    _sim(sid).MCMtrx(tuple(c), mat.H2, q)
+def MCS(sid, c, q): _sim(sid).MCPhase(tuple(c), 1.0, 1j, q)
+def MCT(sid, c, q):
+    import cmath, math
+
+    _sim(sid).MCPhase(tuple(c), 1.0, cmath.exp(0.25j * math.pi), q)
+def MCU(sid, c, q, theta, phi, lambd): _sim(sid).CU(tuple(c), q, theta, phi, lambd)
+def MCMtrx(sid, c, m, q):
+    _sim(sid).MCMtrx(tuple(c), np.asarray(m, dtype=np.complex128).reshape(2, 2), q)
+def MACMtrx(sid, c, m, q):
+    _sim(sid).MACMtrx(tuple(c), np.asarray(m, dtype=np.complex128).reshape(2, 2), q)
+def MCR(sid, basis, phi, c, q):
+    """Multi-controlled Pauli rotation with the FULL control list
+    (reference: MCRHelper, pinvoke_api.cpp:438)."""
+    import cmath
+    import math as _m
+
+    from .pauli import Pauli
+
+    sim = _sim(sid)
+    ctrls = tuple(c)
+    b = Pauli(basis)
+    cos, sin = _m.cos(phi / 2), _m.sin(phi / 2)
+    if b == Pauli.PauliX:
+        m = np.array([[cos, -1j * sin], [-1j * sin, cos]], dtype=np.complex128)
+        sim.MCMtrx(ctrls, m, q)
+    elif b == Pauli.PauliY:
+        m = np.array([[cos, -sin], [sin, cos]], dtype=np.complex128)
+        sim.MCMtrx(ctrls, m, q)
+    elif b == Pauli.PauliZ:
+        sim.MCPhase(ctrls, complex(cos, -sin), complex(cos, sin), q)
+    else:
+        ph = cmath.exp(0.25j * phi)
+        sim.MCPhase(ctrls, ph, ph, q)
+
+
+def SWAP(sid, q1, q2): _sim(sid).Swap(q1, q2)
+def ISWAP(sid, q1, q2): _sim(sid).ISwap(q1, q2)
+def AdjISWAP(sid, q1, q2): _sim(sid).IISwap(q1, q2)
+def FSim(sid, theta, phi, q1, q2): _sim(sid).FSim(theta, phi, q1, q2)
+def CSWAP(sid, c, q1, q2): _sim(sid).CSwap(tuple(c), q1, q2)
+def AND(sid, qi1, qi2, qo): _sim(sid).AND(qi1, qi2, qo)
+def OR(sid, qi1, qi2, qo): _sim(sid).OR(qi1, qi2, qo)
+def XOR(sid, qi1, qi2, qo): _sim(sid).XOR(qi1, qi2, qo)
+
+
+# ---------------------------------------------------------------------------
+# measurement / observables (reference: include/pinvoke_api.hpp:230-300)
+# ---------------------------------------------------------------------------
+
+def M(sid, q) -> bool:
+    return _sim(sid).M(q)
+
+
+def ForceM(sid, q, result: bool) -> bool:
+    return _sim(sid).ForceM(q, result)
+
+
+def MAll(sid) -> int:
+    return _sim(sid).MAll()
+
+
+def _transform_pauli_basis(q, bases, qubits) -> int:
+    """Rotate X/Y observables into Z; returns the joint mask (reference:
+    TransformPauliBasis, src/pinvoke_api.cpp)."""
+    from .pauli import Pauli
+
+    mask = 0
+    for b, qi in zip(bases, qubits):
+        p = Pauli(b)
+        if p == Pauli.PauliX:
+            q.H(qi)
+        elif p == Pauli.PauliY:
+            q.IS(qi)
+            q.H(qi)
+        if p != Pauli.PauliI:
+            mask |= 1 << qi
+    return mask
+
+
+def _revert_pauli_basis(q, bases, qubits) -> None:
+    from .pauli import Pauli
+
+    for b, qi in zip(bases, qubits):
+        p = Pauli(b)
+        if p == Pauli.PauliX:
+            q.H(qi)
+        elif p == Pauli.PauliY:
+            q.H(qi)
+            q.S(qi)
+
+
+def Measure(sid, bases: Sequence[int], qubits: Sequence[int]) -> bool:
+    """Joint Pauli measurement by basis conjugation (reference: Measure)."""
+    q = _sim(sid)
+    mask = _transform_pauli_basis(q, bases, qubits)
+    res = q.ForceMParity(mask, False, do_force=False)
+    _revert_pauli_basis(q, bases, qubits)
+    return res
+
+
+def MeasureShots(sid, qubits: Sequence[int], shots: int) -> List[int]:
+    """Independently-ordered samples (reference fills an i.i.d. array;
+    counts are expanded then shuffled with the simulator's stream —
+    exchangeable with i.i.d. draws)."""
+    q = _sim(sid)
+    counts = q.MultiShotMeasureMask([1 << qi for qi in qubits], shots)
+    out: List[int] = []
+    for k, v in counts.items():
+        out.extend([k] * v)
+    arr = np.asarray(out)
+    q.rng._gen.shuffle(arr)
+    return arr.tolist()
+
+
+def Prob(sid, q) -> float:
+    return _sim(sid).Prob(q)
+
+
+def PermutationProb(sid, qubits: Sequence[int], perm: int) -> float:
+    mask = 0
+    val = 0
+    for j, qi in enumerate(qubits):
+        mask |= 1 << qi
+        if (perm >> j) & 1:
+            val |= 1 << qi
+    return _sim(sid).ProbMask(mask, val)
+
+
+def PermutationExpectation(sid, qubits: Sequence[int]) -> float:
+    return _sim(sid).ExpectationBitsAll(list(qubits))
+
+
+def Variance(sid, qubits: Sequence[int]) -> float:
+    return _sim(sid).VarianceBitsAll(list(qubits))
+
+
+def JointEnsembleProbability(sid, bases, qubits) -> float:
+    q = _sim(sid)
+    mask = _transform_pauli_basis(q, bases, qubits)
+    p = q.ProbParity(mask)
+    _revert_pauli_basis(q, bases, qubits)
+    return p
+
+
+def ResetAll(sid) -> None:
+    _sim(sid).SetPermutation(0)
+
+
+# ---------------------------------------------------------------------------
+# structure / state (reference: Compose/Decompose/Dispose, amplitude IO,
+# lossy TurboQuant files include/pinvoke_api.hpp:55-56,302-320)
+# ---------------------------------------------------------------------------
+
+def Compose(sid1, sid2) -> int:
+    return _sim(sid1).Compose(_sim(sid2).Clone())
+
+
+def Decompose(sid, qubits_start: int, length: int) -> int:
+    """Split `length` qubits into a new simulator; returns its sid."""
+    nid = _new_sid()
+    src = _sim(sid)
+    # fresh destination with the same layer toggles (no O(2^n) clone)
+    toggles = _TOGGLES.get(sid, {})
+    dest = create_arranged_layers_full(qubit_count=length, rng=QrackRandom(),
+                                       **toggles)
+    src.Decompose(qubits_start, dest)
+    _REGISTRY[nid] = dest
+    _TOGGLES[nid] = dict(toggles)
+    return nid
+
+
+def Dispose(sid, start: int, length: int, perm: Optional[int] = None) -> None:
+    _sim(sid).Dispose(start, length, perm)
+
+
+def GetAmplitude(sid, perm: int) -> complex:
+    return _sim(sid).GetAmplitude(perm)
+
+
+def InKet(sid, ket: np.ndarray) -> None:
+    _sim(sid).SetQuantumState(ket)
+
+
+def OutKet(sid) -> np.ndarray:
+    return np.asarray(_sim(sid).GetQuantumState())
+
+
+def OutProbs(sid) -> np.ndarray:
+    return np.asarray(_sim(sid).GetProbs())
+
+
+def lossy_out_to_file(sid, path: str) -> None:
+    _sim(sid).LossySaveStateVector(path)
+
+
+def lossy_in_from_file(sid, path: str) -> None:
+    _sim(sid).LossyLoadStateVector(path)
+
+
+def TrySeparate1Qb(sid, q) -> bool:
+    return _sim(sid).TrySeparate(q)
+
+
+def TrySeparate2Qb(sid, q1, q2) -> bool:
+    return _sim(sid).TrySeparate((q1, q2))
+
+
+def GetUnitaryFidelity(sid) -> float:
+    return _sim(sid).GetUnitaryFidelity()
+
+
+def SetReactiveSeparate(sid, flag: bool) -> None:
+    _sim(sid).SetReactiveSeparate(flag)
+
+
+# ---------------------------------------------------------------------------
+# ALU (reference: include/pinvoke_api.hpp ALU block)
+# ---------------------------------------------------------------------------
+
+def ADD(sid, a: int, start: int, length: int) -> None:
+    _sim(sid).INC(a, start, length)
+
+
+def SUB(sid, a: int, start: int, length: int) -> None:
+    _sim(sid).DEC(a, start, length)
+
+
+def ADDS(sid, a, s_index, start, length) -> None:
+    _sim(sid).INCS(a, start, length, s_index)
+
+
+def MUL(sid, a, start, carry_start, length) -> None:
+    _sim(sid).MUL(a, start, carry_start, length)
+
+
+def DIV(sid, a, start, carry_start, length) -> None:
+    _sim(sid).DIV(a, start, carry_start, length)
+
+
+def MULN(sid, a, mod_n, in_start, out_start, length) -> None:
+    _sim(sid).MULModNOut(a, mod_n, in_start, out_start, length)
+
+
+def POWN(sid, a, mod_n, in_start, out_start, length) -> None:
+    _sim(sid).POWModNOut(a, mod_n, in_start, out_start, length)
+
+
+def LDA(sid, qi, ql, vi, vl, values) -> int:
+    return _sim(sid).IndexedLDA(qi, ql, vi, vl, values)
+
+
+def ADC(sid, c, qi, ql, vi, vl, values) -> int:
+    return _sim(sid).IndexedADC(qi, ql, vi, vl, c, values)
+
+
+def SBC(sid, c, qi, ql, vi, vl, values) -> int:
+    return _sim(sid).IndexedSBC(qi, ql, vi, vl, c, values)
+
+
+def Hash(sid, start, length, values) -> None:
+    _sim(sid).Hash(start, length, values)

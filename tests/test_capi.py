@@ -1,0 +1,97 @@
+"""Flat registry API (pinvoke-surface parity)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from qrack_tpu import capi
+from qrack_tpu.pauli import Pauli
+
+
+def test_lifecycle_and_gates():
+    sid = capi.init_count_type(3, sd=True, sh=True, hy=False, pg=False, oc=False)
+    capi.seed(sid, 42)
+    assert capi.num_qubits(sid) == 3
+    capi.H(sid, 0)
+    capi.MCX(sid, [0], 1)
+    capi.MCX(sid, [1], 2)
+    assert capi.Prob(sid, 2) == pytest.approx(0.5, abs=1e-6)
+    shots = capi.MeasureShots(sid, [0, 1, 2], 200)
+    assert set(shots) <= {0, 7}
+    r = capi.MAll(sid)
+    assert r in (0, 7)
+    cid = capi.init_clone(sid)
+    assert capi.MAll(cid) == r
+    capi.destroy(cid)
+    capi.destroy(sid)
+
+
+def test_pauli_measure_and_expectation():
+    sid = capi.init_count_type(2, hy=False, pg=False, oc=False)
+    capi.seed(sid, 7)
+    capi.H(sid, 0)
+    capi.MCX(sid, [0], 1)
+    # <ZZ> on a Bell state: parity always even
+    p = capi.JointEnsembleProbability(sid, [Pauli.PauliZ, Pauli.PauliZ], [0, 1])
+    assert p == pytest.approx(0.0, abs=1e-9)
+    assert capi.Measure(sid, [Pauli.PauliZ, Pauli.PauliZ], [0, 1]) is False
+    capi.ResetAll(sid)
+    capi.H(sid, 0)
+    assert capi.PermutationExpectation(sid, [0]) == pytest.approx(0.5, abs=1e-6)
+    capi.destroy(sid)
+
+
+def test_compose_decompose_registry():
+    a = capi.init_count_type(2, hy=False, pg=False, oc=False)
+    b = capi.init_count_type(1, hy=False, pg=False, oc=False)
+    capi.X(b, 0)
+    capi.H(a, 0)
+    start = capi.Compose(a, b)
+    assert start == 2 and capi.num_qubits(a) == 3
+    assert capi.Prob(a, 2) == pytest.approx(1.0)
+    nid = capi.Decompose(a, 2, 1)
+    assert capi.num_qubits(a) == 2
+    assert capi.Prob(nid, 0) == pytest.approx(1.0)
+    capi.destroy(a)
+    capi.destroy(b)
+    capi.destroy(nid)
+
+
+def test_alu_and_state_io():
+    sid = capi.init_count_type(6, hy=False, pg=False, oc=False)
+    capi.seed(sid, 9)
+    capi.ADD(sid, 5, 0, 4)
+    assert capi.MAll(sid) == 5
+    capi.ResetAll(sid)
+    capi.H(sid, 0)
+    ket = capi.OutKet(sid)
+    assert abs(ket[0]) == pytest.approx(1 / math.sqrt(2), abs=1e-3)
+    capi.InKet(sid, np.eye(1, 64, 3).ravel())
+    assert capi.MAll(sid) == 3
+    capi.destroy(sid)
+
+
+def test_mcr_multi_control_and_identity_basis():
+    # regression: all controls honored; PauliI is a controlled global phase
+    sid = capi.init_count_type(3, hy=False, pg=False, oc=False)
+    capi.seed(sid, 3)
+    capi.X(sid, 0)  # only control 0 set; control 1 stays |0>
+    capi.MCR(sid, Pauli.PauliX, math.pi, [0, 1], 2)
+    assert capi.Prob(sid, 2) == pytest.approx(0.0, abs=1e-9)
+    capi.X(sid, 1)
+    capi.MCR(sid, Pauli.PauliX, math.pi, [0, 1], 2)
+    assert capi.Prob(sid, 2) == pytest.approx(1.0, abs=1e-9)
+    capi.destroy(sid)
+
+
+def test_measure_shots_ordering():
+    sid = capi.init_count_type(2, hy=False, pg=False, oc=False)
+    capi.seed(sid, 11)
+    capi.H(sid, 0)
+    capi.MCX(sid, [0], 1)
+    shots = capi.MeasureShots(sid, [0, 1], 200)
+    # Bell: half 0, half 3 — and the list must be interleaved, not grouped
+    first_half = shots[:100]
+    assert 10 < sum(1 for s in first_half if s == 0) < 90
+    capi.destroy(sid)
