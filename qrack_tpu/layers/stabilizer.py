@@ -12,9 +12,12 @@ Clifford-only by contract: MCMtrxPerm raises CliffordError for any
 non-Clifford payload, which is the signal QStabilizerHybrid uses to
 buffer/switch (reference: src/qstabilizerhybrid.cpp:206-239).
 
-Phase note: ket extraction fixes the first support amplitude positive
-real (global phase is arbitrary), unlike the reference's tracked
-phaseOffset.
+Phase note: a `phase_offset` factor is tracked at the IO boundaries
+(SetPermutation / SetQuantumState / Compose / ket extraction), matching
+the reference's phaseOffset role there; per-GATE global-phase tracking
+(e.g. Z on a |1> eigenstate) remains canonicalized — a later-round
+parity item (reference: src/qstabilizer.cpp per-gate phaseOffset
+updates).
 """
 
 from __future__ import annotations
@@ -111,6 +114,7 @@ class QStabilizer(QInterface):
         self.x = np.zeros((2 * n + 1, n), dtype=np.uint8)
         self.z = np.zeros((2 * n + 1, n), dtype=np.uint8)
         self.r = np.zeros(2 * n + 1, dtype=np.uint8)
+        self.phase_offset: complex = 1.0 + 0j
         for i in range(n):
             self.x[i, i] = 1          # destabilizer X_i
             self.z[n + i, i] = 1      # stabilizer Z_i
@@ -419,6 +423,8 @@ class QStabilizer(QInterface):
         # tracking the accumulated Pauli product phase exactly
         state[v0] = norm
         if k == 0:
+            if self.phase_offset != 1.0 + 0j:
+                state *= self.phase_offset
             return state
         cur_x = np.zeros(n, dtype=np.uint8)
         cur_z = np.zeros(n, dtype=np.uint8)
@@ -446,6 +452,8 @@ class QStabilizer(QInterface):
             for c in np.nonzero(cur_x)[0]:
                 idx ^= 1 << int(c)
             state[idx] = norm * (1j ** ph)
+        if self.phase_offset != 1.0 + 0j:
+            state *= self.phase_offset
         return state
 
     def GetAmplitude(self, perm: int) -> complex:
@@ -486,6 +494,7 @@ class QStabilizer(QInterface):
         r[n + n1:2 * n] = other.r[n2:2 * n2]
         self.x, self.z, self.r = x, z, r
         self.qubit_count = n
+        self.phase_offset *= getattr(other, "phase_offset", 1.0 + 0j)
         return start
 
     def Allocate(self, start: int, length: int = 1) -> int:
@@ -546,6 +555,7 @@ class QStabilizer(QInterface):
                 vec = vec / nrm
             sub.SetQuantumState(vec)
             self.x, self.z, self.r = sub.x, sub.z, sub.r
+            self.phase_offset = sub.phase_offset
             self.qubit_count = new_n
             return
         raise NotImplementedError("wide tableau disposal pending")
@@ -566,6 +576,7 @@ class QStabilizer(QInterface):
         shrunk = QStabilizer(n - length, rng=self.rng.spawn())
         shrunk.SetQuantumState(tmp.GetQuantumState())
         self.x, self.z, self.r = shrunk.x, shrunk.z, shrunk.r
+        self.phase_offset = shrunk.phase_offset
         self.qubit_count = n - length
         dest.SetQuantumState(tmp_dest.GetQuantumState())
 
@@ -578,6 +589,14 @@ class QStabilizer(QInterface):
         self.x[:] = 0
         self.z[:] = 0
         self.r[:] = 0
+        if phase is not None:
+            ph = complex(phase)
+            self.phase_offset = ph / abs(ph) if abs(ph) > 0 else 1.0 + 0j
+        elif self.rand_global_phase:
+            ang = 2.0 * math.pi * self.Rand()
+            self.phase_offset = complex(math.cos(ang), math.sin(ang))
+        else:
+            self.phase_offset = 1.0 + 0j
         for i in range(n):
             self.x[i, i] = 1
             self.z[n + i, i] = 1
@@ -596,7 +615,8 @@ class QStabilizer(QInterface):
         # basis state?
         nz = np.nonzero(np.abs(state) > 1e-8)[0]
         if nz.size == 1:
-            self.SetPermutation(int(nz[0]))
+            amp = complex(state[nz[0]])
+            self.SetPermutation(int(nz[0]), phase=amp / abs(amp))
             return
         # general stabilizer synthesis via Clifford circuit extraction
         self._synthesize_from_ket(state)
@@ -682,8 +702,9 @@ class QStabilizer(QInterface):
                         expect += 2
             if cph(u) != expect % 4:
                 raise CliffordError("support phases not quadratic")
-        # build the state on a fresh tableau
-        self.SetPermutation(0)
+        # build the state on a fresh tableau; the construction realizes
+        # amp(v0) = +1/sqrt(2^k), so the input's v0 phase is the offset
+        self.SetPermutation(0, phase=amp0 / abs(amp0))
         for b in range(n):
             if (v0 >> b) & 1:
                 self._x_gate(b)
@@ -706,6 +727,7 @@ class QStabilizer(QInterface):
         c.x = self.x.copy()
         c.z = self.z.copy()
         c.r = self.r.copy()
+        c.phase_offset = self.phase_offset
         return c
 
     def SumSqrDiff(self, other) -> float:

@@ -205,3 +205,46 @@ def test_get_state_does_not_corrupt_tableau():
         for q in range(4):
             assert s.Prob(q) == pytest.approx(d.Prob(q), abs=1e-9), (seed, q)
         assert_same_state(s, d)
+
+
+def test_phase_offset_io_tracking():
+    # basis-state phase survives SetQuantumState round-trips
+    amp = (0.6 - 0.8j)
+    s = QStabilizer(2, rng=QrackRandom(1))
+    ket = np.zeros(4, dtype=np.complex128)
+    ket[2] = amp
+    s.SetQuantumState(ket)
+    np.testing.assert_allclose(s.GetQuantumState(), ket, atol=1e-12)
+    # superposed stabilizer ket with nontrivial global phase
+    d = QEngineCPU(2, rng=QrackRandom(2), rand_global_phase=False)
+    d.H(0)
+    d.CNOT(0, 1)
+    bell = d.GetQuantumState() * np.exp(0.7j)
+    s2 = QStabilizer(2, rng=QrackRandom(3))
+    s2.SetQuantumState(bell)
+    np.testing.assert_allclose(s2.GetQuantumState(), bell, atol=1e-10)
+    # Compose multiplies offsets
+    s3 = QStabilizer(1, rng=QrackRandom(4))
+    s3.SetQuantumState(np.array([0, 1j], dtype=np.complex128))
+    s2.Compose(s3)
+    expect = np.kron(np.array([0, 1j]), bell)
+    np.testing.assert_allclose(s2.GetQuantumState(), expect, atol=1e-10)
+
+
+def test_phase_offset_survives_decompose_dispose():
+    # regression: split/dispose rebuilds must adopt the recomputed offset
+    a_ket = np.array([1, 1j], dtype=np.complex128) / np.sqrt(2)
+    b_ket = np.array([0, 1], dtype=np.complex128)
+    full = np.kron(b_ket, a_ket) * np.exp(0.9j)
+    s = QStabilizer(2, rng=QrackRandom(1))
+    s.SetQuantumState(full)
+    dest = QStabilizer(1, rng=QrackRandom(2))
+    s.Decompose(1, dest)
+    rebuilt = np.kron(dest.GetQuantumState(), s.GetQuantumState())
+    np.testing.assert_allclose(rebuilt, full, atol=1e-10)
+    # dispose path
+    s2 = QStabilizer(2, rng=QrackRandom(3))
+    s2.SetQuantumState(full)
+    s2.ForceM(1, True)
+    s2.Dispose(1, 1)
+    np.testing.assert_allclose(s2.GetQuantumState(), a_ket * np.exp(0.9j), atol=1e-10)
