@@ -154,6 +154,55 @@ class QCircuit:
     # TPU batch path: the whole circuit as one traced program
     # ------------------------------------------------------------------
 
+    def compile_sharded_fn(self, mesh, n: int):
+        """One jitted program applying the whole circuit to a ket sharded
+        across the 'pages' mesh axis: in-page gates per device, paged
+        targets over lax.ppermute, diagonals always collective-free.
+        Returns (fn, sharding) like models.qft.make_sharded_qft_fn."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops import gatekernels as gk
+        from ..ops import sharded as sh
+        from ..utils.bits import control_offset
+
+        npg = mesh.devices.size
+        g_bits = npg.bit_length() - 1
+        assert (1 << g_bits) == npg, "page count must be a power of two"
+        L = n - g_bits
+        sharding = NamedSharding(mesh, P(None, "pages"))
+        gates = [(g.target, g.controls, dict(g.payloads)) for g in self.gates]
+
+        def body(local):
+            for (target, controls, payloads) in gates:
+                for perm, m in payloads.items():
+                    cmask = 0
+                    for c in controls:
+                        cmask |= 1 << c
+                    cval = control_offset(controls, perm)
+                    lm, lv, gm, gv = sh.split_masks(cmask, cval, L)
+                    if mat.is_phase(m):
+                        tmask = 1 << target
+                        local = sh.apply_diag(
+                            local, m[0, 0].real, m[0, 0].imag,
+                            m[1, 1].real, m[1, 1].imag,
+                            tmask & ((1 << L) - 1), tmask >> L, lm, lv, gm, gv)
+                    elif target < L:
+                        mp = gk.mtrx_planes(m, local.dtype)
+                        local = sh.apply_local_2x2(local, mp, L, target, lm, lv, gm, gv)
+                    else:
+                        mp = gk.mtrx_planes(m, local.dtype)
+                        local = sh.apply_global_2x2(local, mp, npg, target - L,
+                                                    lm, lv, gm, gv)
+            return local
+
+        fn = jax.jit(
+            jax.shard_map(body, mesh=mesh, in_specs=P(None, "pages"),
+                          out_specs=P(None, "pages")),
+            donate_argnums=(0,),
+        )
+        return fn, sharding
+
     def compile_fn(self, n: int):
         """Return a pure jittable fn(planes) applying the whole circuit
         over (2, 2^n) split planes — one fused XLA executable."""

@@ -1,0 +1,68 @@
+"""Pure per-shard gate bodies for 'pages'-mesh programs.
+
+Single source of truth for the sharded gate algebra used by both the
+QPager engine programs (qrack_tpu/parallel/pager.py) and the fused
+sharded-circuit compiler (QCircuit.compile_sharded_fn). All functions
+run INSIDE a shard_map body over mesh axis 'pages': `local` is this
+page's (2, 2^L) planes, page selection/masks are split into (local,
+page) parts so no global index is ever built (exact past int32).
+
+Reference mapping (SURVEY.md §2.3): in-page broadcast =
+src/qpager.cpp:369-397; paged-target pair exchange = :400-447
+(ShuffleBuffers becomes lax.ppermute over ICI); meta-controlled page
+subsets = :453,563.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import gatekernels as gk
+
+
+def page_id():
+    return jax.lax.axis_index("pages")
+
+
+def apply_local_2x2(local, mp, L: int, target: int, lmask, lval, gmask, gval):
+    """Non-diagonal gate on an in-page target, optionally page-selected."""
+    out = gk.apply_2x2(local, mp, L, target, lmask, lval)
+    ok = (page_id() & gmask) == gval
+    return jnp.where(ok, out, local)
+
+
+def apply_global_2x2(local, mp, npg: int, gpos: int, lmask, lval, gmask, gval):
+    """Non-diagonal gate on a paged target: ppermute pair exchange."""
+    perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
+    pid = page_id()
+    b = (pid >> gpos) & 1
+    other = jax.lax.ppermute(local, "pages", perm)
+    re, im = mp[0], mp[1]
+    dd_re = jnp.where(b == 0, re[0, 0], re[1, 1])
+    dd_im = jnp.where(b == 0, im[0, 0], im[1, 1])
+    od_re = jnp.where(b == 0, re[0, 1], re[1, 0])
+    od_im = jnp.where(b == 0, im[0, 1], im[1, 0])
+    out = gk.cmul(dd_re, dd_im, local) + gk.cmul(od_re, od_im, other)
+    idx = gk.iota_for(local)
+    ok = ((idx & lmask) == lval) & ((pid & gmask) == gval)
+    return jnp.where(ok, out, local)
+
+
+def apply_diag(local, d0re, d0im, d1re, d1im, tlo, thi, clo, cvlo, chi, cvhi):
+    """Diagonal gate with split target/control masks — collective-free."""
+    pid = page_id()
+    idx = gk.iota_for(local)
+    bit = ((idx & tlo) != 0) | ((pid & thi) != 0)
+    fre = jnp.where(bit, d1re, d0re)
+    fim = jnp.where(bit, d1im, d0im)
+    ok = ((idx & clo) == cvlo) & ((pid & chi) == cvhi)
+    fre = jnp.where(ok, fre, jnp.ones((), local.dtype))
+    fim = jnp.where(ok, fim, jnp.zeros((), local.dtype))
+    return gk.cmul(fre, fim, local)
+
+
+def split_masks(mask: int, val: int, local_bits: int):
+    lmask = mask & ((1 << local_bits) - 1)
+    lval = val & ((1 << local_bits) - 1)
+    return lmask, lval, mask >> local_bits, val >> local_bits

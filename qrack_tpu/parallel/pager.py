@@ -61,12 +61,7 @@ def _state_specs(n_scalars: int):
     return (P(None, "pages"),) + (P(),) * n_scalars
 
 
-def _split_masks(mask, val, local_bits):
-    lmask = mask & ((1 << local_bits) - 1)
-    lval = val & ((1 << local_bits) - 1)
-    gmask = mask >> local_bits
-    gval = val >> local_bits
-    return lmask, lval, gmask, gval
+from ..ops.sharded import split_masks as _split_masks  # single source of truth
 
 
 class QPager(QEngine):
@@ -152,14 +147,13 @@ class QPager(QEngine):
         return (self.n_pages, self.local_bits, id(self.mesh)) + parts
 
     def _p_local_2x2(self, target):
-        L, mesh, npg = self.local_bits, self.mesh, self.n_pages
+        from ..ops import sharded as shb
+
+        L, mesh = self.local_bits, self.mesh
 
         def build():
             def f(local, mp, lmask, lval, gmask, gval):
-                out = gk.apply_2x2(local, mp, L, target, lmask, lval)
-                pid = jax.lax.axis_index("pages")
-                ok = (pid & gmask) == gval
-                return jnp.where(ok, out, local)
+                return shb.apply_local_2x2(local, mp, L, target, lmask, lval, gmask, gval)
 
             return jax.jit(jax.shard_map(
                 f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
@@ -168,23 +162,13 @@ class QPager(QEngine):
         return _program(self._key("l2x2", target), build)
 
     def _p_global_2x2(self, gpos):
-        L, mesh, npg = self.local_bits, self.mesh, self.n_pages
-        perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
+        from ..ops import sharded as shb
+
+        mesh, npg = self.mesh, self.n_pages
 
         def build():
             def f(local, mp, lmask, lval, gmask, gval):
-                pid = jax.lax.axis_index("pages")
-                b = (pid >> gpos) & 1
-                other = jax.lax.ppermute(local, "pages", perm)
-                re, im = mp[0], mp[1]
-                dd_re = jnp.where(b == 0, re[0, 0], re[1, 1])
-                dd_im = jnp.where(b == 0, im[0, 0], im[1, 1])
-                od_re = jnp.where(b == 0, re[0, 1], re[1, 0])
-                od_im = jnp.where(b == 0, im[0, 1], im[1, 0])
-                out = gk.cmul(dd_re, dd_im, local) + gk.cmul(od_re, od_im, other)
-                idx = gk.iota_for(local)
-                ok = ((idx & lmask) == lval) & ((pid & gmask) == gval)
-                return jnp.where(ok, out, local)
+                return shb.apply_global_2x2(local, mp, npg, gpos, lmask, lval, gmask, gval)
 
             return jax.jit(jax.shard_map(
                 f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
@@ -193,22 +177,14 @@ class QPager(QEngine):
         return _program(self._key("g2x2", gpos), build)
 
     def _p_diag(self):
-        L, mesh = self.local_bits, self.mesh
+        from ..ops import sharded as shb
+
+        mesh = self.mesh
 
         def build():
-            def f(local, d0re, d0im, d1re, d1im, tlo, thi, clo, cvlo, chi, cvhi):
-                pid = jax.lax.axis_index("pages")
-                idx = gk.iota_for(local)
-                bit = ((idx & tlo) != 0) | ((pid & thi) != 0)
-                fre = jnp.where(bit, d1re, d0re)
-                fim = jnp.where(bit, d1im, d0im)
-                ok = ((idx & clo) == cvlo) & ((pid & chi) == cvhi)
-                fre = jnp.where(ok, fre, jnp.ones((), local.dtype))
-                fim = jnp.where(ok, fim, jnp.zeros((), local.dtype))
-                return gk.cmul(fre, fim, local)
-
             return jax.jit(jax.shard_map(
-                f, mesh=mesh, in_specs=_state_specs(10), out_specs=P(None, "pages")
+                shb.apply_diag, mesh=mesh, in_specs=_state_specs(10),
+                out_specs=P(None, "pages")
             ), donate_argnums=(0,))
 
         return _program(self._key("diag"), build)

@@ -58,3 +58,38 @@ def test_fused_rcs_matches_gate_path():
     fn = jax.jit(rcsm.make_rcs_fn(n, depth, seed=7))
     planes = fn(gk.to_planes(np.eye(1, 1 << n, 0).ravel()))
     np.testing.assert_allclose(gk.from_planes(planes), expect, atol=3e-6)
+
+
+def test_compiled_sharded_circuit_matches_oracle():
+    from jax.sharding import Mesh
+
+    from qrack_tpu.layers.qcircuit import QCircuit
+    from qrack_tpu import matrices as mat
+
+    n = 7
+    rng = QrackRandom(9)
+    c = QCircuit(n)
+    for _ in range(30):
+        t = rng.randint(0, n)
+        k = rng.randint(0, 4)
+        if k == 0:
+            c.append_1q(t, mat.H2)
+        elif k == 1:
+            c.append_1q(t, mat.u3_mtrx(rng.rand(), rng.rand(), rng.rand()))
+        elif k == 2:
+            ctl = rng.randint(0, n)
+            if ctl != t:
+                c.append_ctrl((ctl,), t, mat.X2, 1)
+        else:
+            ctl = rng.randint(0, n)
+            if ctl != t:
+                c.append_ctrl((ctl,), t, mat.phase_mtrx(1, np.exp(0.4j)), 1)
+    o = QEngineCPU(n, rng=QrackRandom(1), rand_global_phase=False)
+    c.Run(o)
+    devs = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devs), ("pages",))
+    fn, sharding = c.compile_sharded_fn(mesh, n)
+    planes = jax.device_put(gk.to_planes(np.eye(1, 1 << n, 0).ravel()), sharding)
+    out = fn(planes)
+    np.testing.assert_allclose(gk.from_planes(jax.device_get(out)),
+                               o.GetQuantumState(), atol=3e-6)
