@@ -72,6 +72,7 @@ class QCircuit:
     def __init__(self, qubit_count: int = 0):
         self.qubit_count = qubit_count
         self.gates: List[QCircuitGate] = []
+        self._fused_cache: Dict[int, object] = {}  # width -> jitted program
 
     # ------------------------------------------------------------------
 
@@ -80,6 +81,7 @@ class QCircuit:
         algebraic combining of same-target/controls neighbors and
         commuting past disjoint gates)."""
         self.qubit_count = max(self.qubit_count, max(gate.qubits()) + 1)
+        self._fused_cache.clear()
         # walk back past gates on disjoint qubits to find a merge partner
         i = len(self.gates) - 1
         gset = set(gate.qubits())
@@ -121,6 +123,32 @@ class QCircuit:
         for g in self.gates:
             for perm, m in g.payloads.items():
                 qsim.MCMtrxPerm(g.controls, m, g.target, perm)
+
+    def RunFused(self, qsim) -> None:
+        """Execute, preferring one fused XLA program when the target is a
+        plane-backed dense engine (single-chip TPU) — per-gate dispatch
+        otherwise. The TPU-native analogue of the reference's queued
+        kernel chain collapsing into one submission."""
+        from ..engines.tpu import QEngineTPU
+
+        if isinstance(qsim, QEngineTPU) and self.gates:
+            import jax
+
+            n = qsim.qubit_count
+            # the per-gate path validates through _check_qubit; the fused
+            # path must reject out-of-range qubits just as loudly
+            for g in self.gates:
+                for q in g.qubits():
+                    if q < 0 or q >= n:
+                        raise ValueError(
+                            f"qubit index {q} out of range (n={n})")
+            fn = self._fused_cache.get(n)
+            if fn is None:
+                fn = jax.jit(self.compile_fn(n), donate_argnums=(0,))
+                self._fused_cache[n] = fn
+            qsim._state = fn(qsim._state)
+            return
+        self.Run(qsim)
 
     def PastLightCone(self, qubits: Sequence[int]) -> "QCircuit":
         """Sub-circuit causally relevant to `qubits` (reference:

@@ -52,7 +52,7 @@ class QTensorNetwork(QInterface):
                 else self.circuit.PastLightCone(qubits))
         self.sim = self._factory(self.qubit_count, init_state=self._init_state,
                                  rng=self._stack_rng.spawn(), **self._kw)
-        circ.Run(self.sim)
+        circ.RunFused(self.sim)
         self.circuit = QCircuit(self.qubit_count)
 
     def _light_cone_query(self, qubits, fn):
@@ -63,7 +63,7 @@ class QTensorNetwork(QInterface):
         circ = self.circuit.PastLightCone(qubits)
         tmp = self._factory(self.qubit_count, init_state=self._init_state,
                             rng=self._stack_rng.spawn(), **self._kw)
-        circ.Run(tmp)
+        circ.RunFused(tmp)
         return fn(tmp)
 
     # ------------------------------------------------------------------

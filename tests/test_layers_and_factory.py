@@ -268,3 +268,50 @@ def test_controlled_phase_identity_not_dropped():
     c2.append_1q(0, np.diag([1j, 1j]) @ mat.H2)
     c2.append_1q(0, np.conj((np.diag([1j, 1j]) @ mat.H2).T))
     assert c2.GetGateCount() == 0
+
+
+def test_tensornetwork_fused_materialization_on_tpu_engine():
+    import time
+
+    from qrack_tpu.engines.tpu import QEngineTPU
+
+    def tpu_factory(n, **kw):
+        kw.setdefault("rand_global_phase", False)
+        return QEngineTPU(n, **kw)
+
+    n = 8
+    q = QTensorNetwork(n, stack_factory=tpu_factory, rng=QrackRandom(31),
+                       rand_global_phase=False)
+    o = cpu_factory(n, rng=QrackRandom(31))
+    random_circuit(q, QrackRandom(900), 40, n)
+    random_circuit(o, QrackRandom(900), 40, n)
+    # observable query runs the light cone through ONE fused program
+    assert q.Prob(3) == pytest.approx(o.Prob(3), abs=2e-6)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+    # collapsing measurement materializes through the fused path too
+    q.rng.seed(5)
+    o.rng.seed(5)
+    assert q.M(2) == o.M(2)
+
+
+def test_runfused_validates_and_caches():
+    import jax
+
+    from qrack_tpu.engines.tpu import QEngineTPU
+    from qrack_tpu.layers.qcircuit import QCircuit
+
+    c = QCircuit(2)
+    c.append_1q(5, mat.H2)  # widens the circuit, exceeds the engine below
+    eng = QEngineTPU(4, rng=QrackRandom(1), rand_global_phase=False)
+    with pytest.raises(ValueError):
+        c.RunFused(eng)
+    # caching: same jitted object reused until the circuit changes
+    c2 = QCircuit(3)
+    c2.append_1q(0, mat.H2)
+    e2 = QEngineTPU(3, rng=QrackRandom(2), rand_global_phase=False)
+    c2.RunFused(e2)
+    first = c2._fused_cache[3]
+    c2.RunFused(e2)
+    assert c2._fused_cache[3] is first
+    c2.append_1q(1, mat.H2)
+    assert 3 not in c2._fused_cache
