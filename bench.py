@@ -91,19 +91,63 @@ def _cpu_baseline_seconds() -> float:
     return cpu_s
 
 
-def main() -> None:
-    tpu_s = _tpu_seconds()
+def _emit(tpu_s: float, label_suffix: str = "") -> None:
     try:
         cpu_s = _cpu_baseline_seconds()
         vs = cpu_s / tpu_s if tpu_s > 0 else 0.0
     except Exception:
         vs = 0.0
     print(json.dumps({
-        "metric": f"{WORKLOAD}{WIDTH}_fused_wall",
+        "metric": f"{WORKLOAD}{WIDTH}_fused_wall{label_suffix}",
         "value": round(tpu_s, 6),
         "unit": "s",
         "vs_baseline": round(vs, 3),
     }))
+
+
+def main() -> None:
+    if os.environ.get("QRACK_BENCH_CHILD"):
+        print(f"CHILD_RESULT {_tpu_seconds():.9f}")
+        return
+    if os.environ.get("QRACK_BENCH_PLATFORM"):
+        # platform explicitly pinned: measure in-process
+        _emit(_tpu_seconds())
+        return
+    # The TPU tunnel in this environment can wedge indefinitely (see
+    # docs/ROADMAP.md); measure in a watchdogged child so a dead chip
+    # degrades to a labeled CPU-platform measurement instead of a hang.
+    import subprocess
+
+    timeout_s = int(os.environ.get("QRACK_BENCH_TIMEOUT", "1500"))
+
+    def _run_child(extra_env):
+        env = dict(os.environ, QRACK_BENCH_CHILD="1", **extra_env)
+        try:
+            res = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 capture_output=True, text=True,
+                                 timeout=timeout_s, env=env)
+        except subprocess.TimeoutExpired:
+            print("bench child timed out", file=sys.stderr)
+            return None, None
+        for line in res.stdout.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                return float(line.split()[1]), res
+        # crashed rather than hung: surface the real failure before any
+        # fallback masks it
+        print(f"bench child exited {res.returncode}:\n{res.stderr[-2000:]}",
+              file=sys.stderr)
+        return None, res
+
+    value, _ = _run_child({})
+    if value is not None:
+        _emit(value)
+        return
+    value, res = _run_child({"QRACK_BENCH_PLATFORM": "cpu"})
+    if value is not None:
+        _emit(value, label_suffix="_cpu_xla_fallback")
+        return
+    raise RuntimeError("bench child produced no result:\n"
+                       + (res.stderr[-2000:] if res is not None else "<timeout>"))
 
 
 if __name__ == "__main__":

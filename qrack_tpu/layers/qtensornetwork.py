@@ -63,7 +63,11 @@ class QTensorNetwork(QInterface):
         circ = self.circuit.PastLightCone(qubits)
         tmp = self._factory(self.qubit_count, init_state=self._init_state,
                             rng=self._stack_rng.spawn(), **self._kw)
-        circ.RunFused(tmp)
+        # per-gate path here: light-cone circuits are fresh objects per
+        # query, so a fused compile could never be cache-hit — the
+        # module-level per-gate kernels are already compiled process-wide.
+        # RunFused stays reserved for the one-shot full materialization.
+        circ.Run(tmp)
         return fn(tmp)
 
     # ------------------------------------------------------------------
