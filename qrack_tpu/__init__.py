@@ -10,7 +10,7 @@ stacks by a factory.
 """
 
 from .interface import QInterface  # noqa: F401
-from .engines import QEngine, QEngineCPU  # noqa: F401
+from .engines import QEngine, QEngineCPU, QEngineSparse  # noqa: F401
 from .pauli import Pauli  # noqa: F401
 from .config import get_config, set_config  # noqa: F401
 from .hamiltonian import HamiltonianOp, uniform_hamiltonian_op  # noqa: F401
