@@ -1,0 +1,49 @@
+"""Host-platform pinning for the axon-TPU container.
+
+The axon plugin force-sets jax_platforms="axon,cpu" from sitecustomize at
+interpreter start, so the JAX_PLATFORMS env var alone is ineffective and
+any backend touch (jax.devices()) initializes the TPU tunnel — which can
+wedge and hang indefinitely.  CPU-mesh validation paths (tests, the
+driver's dryrun_multichip) must pin the cpu backend BEFORE any backend
+init, and size the virtual host device count.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def pin_host_cpu(n_devices: int = 8) -> None:
+    """Pin JAX to the cpu backend with >= n_devices virtual host devices.
+
+    Must be called before any JAX backend initialization (jax.devices(),
+    first jit execution, ...) — XLA_FLAGS and jax_platforms are read only
+    at first backend init, so a late call would silently do nothing.
+    Raises RuntimeError in that case instead.  Safe to call when
+    XLA_FLAGS already holds a smaller device count: the flag is
+    rewritten upward.
+    """
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:
+            if jax.default_backend() == "cpu" and len(jax.devices("cpu")) >= n_devices:
+                return  # already pinned adequately (idempotent call)
+            raise RuntimeError(
+                "pin_host_cpu called after a JAX backend was initialized; "
+                "the cpu pin and host device count cannot take effect")
+    except ImportError:  # private API moved: fall through, best effort
+        pass
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    pat = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+    m = pat.search(flags)
+    if m is None:
+        flags = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        flags = pat.sub(f"--xla_force_host_platform_device_count={n_devices}", flags)
+    os.environ["XLA_FLAGS"] = flags
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,123 @@
+"""Populate bench_baseline.json from the reference C++ build's benchmarks.
+
+The reference (unitaryfoundation/qrack) is built CPU-only out-of-tree:
+
+    mkdir /tmp/qrack_ref_build && cd /tmp/qrack_ref_build
+    cmake -G Ninja -DENABLE_OPENCL=OFF -DCMAKE_BUILD_TYPE=Release /root/reference
+    ninja benchmarks
+
+then this script runs its benchmark cases (reference protocol:
+test/benchmarks.cpp:98-300 benchmarkLoopVariable — per-width avg/sigma/
+quartiles CSV rows) and records per-width wall-clocks with provenance as
+the vs_baseline denominators for bench.py.
+
+Two engine stacks are recorded per workload:
+  * dense "QEngine -> CPU" rows   -> the fused-ket denominator (honest
+    apples-to-apples for our single-chip fused XLA programs)
+  * "QUnit -> ..." optimal rows   -> the optimizer-stack denominator
+
+Usage:
+    python scripts/make_ref_baseline.py --binary /tmp/qrack_ref_build/benchmarks \
+        --max-qubits 26 --samples 3 [--skip-rcs]
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_FILE = os.path.join(HERE, "bench_baseline.json")
+
+CASES = {
+    "qft": ("test_qft_permutation_init", []),
+    "rcs_d8": ("test_random_circuit_sampling_nn", ["--benchmark-depth", "8"]),
+}
+
+SECTION_RE = re.compile(r"^#+ (.+?) #+$")
+ROW_RE = re.compile(r"^(\d+), ([0-9.e+-]+),")
+
+
+def parse_sections(text):
+    """Yield (section_name, width, avg_seconds) from benchmark output."""
+    section = None
+    for line in text.splitlines():
+        m = SECTION_RE.match(line.strip())
+        if m:
+            section = m.group(1).strip()
+            continue
+        m = ROW_RE.match(line.strip())
+        if m and section:
+            yield section, int(m.group(1)), float(m.group(2)) / 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--binary", required=True)
+    ap.add_argument("--max-qubits", type=int, default=26)
+    ap.add_argument("--min-qubits", type=int, default=16)
+    ap.add_argument("--samples", type=int, default=3)
+    ap.add_argument("--timeout", type=int, default=3600)
+    ap.add_argument("--skip-rcs", action="store_true")
+    ap.add_argument("--single", action="store_true",
+                    help="only the max width, not the full sweep")
+    args = ap.parse_args()
+
+    data = {}
+    if os.path.exists(BASELINE_FILE):
+        try:
+            with open(BASELINE_FILE) as f:
+                data = json.load(f)
+            if "width" in data:  # legacy flat format: drop (numpy oracle)
+                data = {}
+        except Exception:
+            data = {}
+
+    for wl, (case, extra) in CASES.items():
+        if args.skip_rcs and wl.startswith("rcs"):
+            continue
+        cmd = [args.binary, case, "--proc-cpu", "-m", str(args.max_qubits),
+               "--samples", str(args.samples)] + extra
+        if args.single:
+            cmd.append("--single")
+        print("running:", " ".join(cmd), file=sys.stderr)
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=args.timeout)
+        except subprocess.TimeoutExpired as exc:
+            print(f"{case} timed out after {args.timeout}s; keeping earlier "
+                  f"results", file=sys.stderr)
+            # salvage whatever rows were printed before the kill
+            res = exc
+            res.stdout = (exc.stdout or b"").decode() if isinstance(
+                exc.stdout, bytes) else (exc.stdout or "")
+        else:
+            if res.returncode != 0:
+                print(f"{case} exited {res.returncode}:\n{res.stderr[-1000:]}",
+                      file=sys.stderr)
+        for section, width, avg_s in parse_sections(res.stdout):
+            if width < args.min_qubits:
+                continue
+            dense = section.startswith("QEngine")
+            key = wl if dense else f"{wl}_optimal"
+            src = ("reference-cpp QEngineCPU dense (cmake -DENABLE_OPENCL=OFF, "
+                   "Release, 1-core container)" if dense else
+                   "reference-cpp QUnit optimal stack (CPU-only build)")
+            data.setdefault(key, {})[str(width)] = {
+                "seconds": avg_s,
+                "source": src,
+                "samples": args.samples,
+                "case": case,
+            }
+            print(f"  {key} w={width}: {avg_s:.3f}s", file=sys.stderr)
+
+        # write after every workload so a later timeout can't lose results
+        with open(BASELINE_FILE, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+    print(f"wrote {BASELINE_FILE}")
+
+
+if __name__ == "__main__":
+    main()

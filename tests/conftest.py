@@ -2,17 +2,17 @@
 multi-chip sharding paths run without TPU hardware (the driver separately
 dry-runs the sharded path via __graft_entry__.dryrun_multichip).
 
-This environment's axon TPU plugin force-sets jax_platforms="axon,cpu"
-from sitecustomize at interpreter start, so JAX_PLATFORMS env alone is
-ineffective — the config must be updated back before any backend init
-(otherwise a wedged TPU tunnel hangs the whole suite)."""
+The pinning itself lives in qrack_tpu.utils.platform (shared with the
+driver entry point): the axon TPU plugin force-sets
+jax_platforms="axon,cpu" from sitecustomize at interpreter start, so the
+config must be updated back before any backend init (otherwise a wedged
+TPU tunnel hangs the whole suite)."""
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_host_cpu(8)
