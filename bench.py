@@ -138,12 +138,15 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     except Exception as exc:  # corrupt baseline file must never kill the bench
         print(f"baseline lookup failed: {exc!r}", file=sys.stderr)
         base_s, base_src = None, None
-    vs = (base_s / stats["avg"]) if (base_s and stats["avg"] > 0) else 0.0
+    # null (not 0.0) when no denominator exists for this width, so a
+    # missing baseline is distinguishable from a measured zero speedup
+    vs = (round(base_s / stats["avg"], 3)
+          if (base_s and stats["avg"] > 0) else None)
     line = {
         "metric": f"{_workload_key()}{width}_fused_wall{label_suffix}",
         "value": round(stats["avg"], 6),
         "unit": "s",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": vs,
         "stats": {k: (round(v, 6) if isinstance(v, float) else v)
                   for k, v in stats.items()},
     }

@@ -16,10 +16,33 @@ OrderContiguous :857); measurement and TrySeparate split them back
 values are elided (TrimControls :2549). Swap of two logical qubits is a
 pure shard exchange (no engine work).
 
-Round-1 scope notes: the reference's Pauli-basis shard tags, buffered
-phase-shard fusion, and ACE fidelity-degradation paths
-(include/qunit.hpp:107-128) are later-round performance/approximation
-extensions; this layer is exact (GetUnitaryFidelity == 1).
+Gate-fusion buffers (reference: PhaseShard maps + Pauli basis tags,
+include/qengineshard.hpp:32-100, applied in Mtrx src/qunit.cpp:2433-2487)
+are re-designed here as a two-level lazy stack per shard:
+
+  logical state = (per-shard pending 2x2)  .  (2-qubit phase links)  .  base
+
+* ``pending`` — a buffered single-qubit unitary per shard.  Any 1q gate
+  on an entangled shard is a 2x2 host multiply, never an engine
+  dispatch; H.H, basis changes, and rotation merges cancel
+  algebraically.  This generalizes the reference's X/Y/Z basis tags (a
+  shard "in the X basis" is exactly ``pending == H``).
+* ``links`` — buffered 2-qubit *diagonal* gates (CZ/CPhase/controlled
+  rotations) between any two shards, entangled or not.  All 2-qubit
+  diagonals commute, so links form an unordered bag keyed by shard
+  pair; merging is elementwise phase multiplication and CZ.CZ == I
+  cancels to nothing — the gate never reaches an engine and never
+  entangles.  A link whose endpoint collapses to a definite bit
+  *reduces* to a 1-qubit phase on its partner (the reference's buffered
+  CZ elision on measurement).
+
+Buffers are flushed (links resolved bottom-up, then pendings) only when
+an operation genuinely needs the engine: non-diagonal multi-qubit gates,
+state reads, ALU spans.  Z-basis probabilities and parities need no
+flush at all — diagonal links never change Z marginals, and monomial
+pendings just relabel outcomes.  ``QRACK_QUNIT_PHASE_FUSION=0`` or
+``phase_fusion=False`` disables buffering (dispatch-per-gate, round-1
+behavior); ``dispatch_count`` counts engine gate dispatches for tests.
 """
 
 from __future__ import annotations
@@ -41,27 +64,109 @@ def _default_unit_factory(n, **kw):
     return QStabilizerHybrid(n, **kw)
 
 
+_EPS = 1e-10
+_ID2 = np.eye(2, dtype=np.complex128)
+
+
+def _mat_kind(m: Optional[np.ndarray]) -> str:
+    """Classify a 2x2: 'id' | 'diag' | 'anti' (anti-diagonal) | 'gen'."""
+    if m is None:
+        return "id"
+    if abs(m[0, 1]) < _EPS and abs(m[1, 0]) < _EPS:
+        if abs(m[0, 0] - 1) < _EPS and abs(m[1, 1] - 1) < _EPS:
+            return "id"
+        return "diag"
+    if abs(m[0, 0]) < _EPS and abs(m[1, 1]) < _EPS:
+        return "anti"
+    return "gen"
+
+
+class _PhaseLink:
+    """A buffered 2-qubit diagonal gate between shards a and b.
+
+    d[bit_a][bit_b] holds the unit-modulus phase applied to each joint
+    basis state (reference analogue: PhaseShard,
+    include/qengineshard.hpp:32-61, diagonal/"phase" case)."""
+
+    __slots__ = ("a", "b", "d")
+
+    def __init__(self, a: "_Shard", b: "_Shard", d: np.ndarray):
+        self.a = a
+        self.b = b
+        self.d = d
+
+    def phases_for(self, shard: "_Shard", bit: int) -> np.ndarray:
+        """Diagonal on the OTHER endpoint once `shard` collapses to bit."""
+        return self.d[bit, :] if shard is self.a else self.d[:, bit]
+
+    def flip(self, shard: "_Shard") -> None:
+        """Commute an anti-diagonal pending past this link (X conjugation
+        permutes that endpoint's index)."""
+        if shard is self.a:
+            self.d = self.d[::-1, :].copy()
+        else:
+            self.d = self.d[:, ::-1].copy()
+
+    def mul(self, shard_a: "_Shard", d: np.ndarray) -> None:
+        """Merge another diagonal payload, given in shard_a-major order."""
+        self.d = self.d * (d if shard_a is self.a else d.T)
+
+    def is_identity(self) -> bool:
+        return bool(np.allclose(self.d, 1.0, atol=_EPS))
+
+    def uniform_scalar(self) -> Optional[complex]:
+        c = self.d[0, 0]
+        if np.allclose(self.d, c, atol=_EPS):
+            return complex(c)
+        return None
+
+
 class _Shard:
-    __slots__ = ("unit", "mapped", "amp0", "amp1")
+    __slots__ = ("unit", "mapped", "amp0", "amp1", "pending", "links")
 
     def __init__(self, amp0=1.0 + 0j, amp1=0.0 + 0j):
         self.unit = None
         self.mapped = 0
         self.amp0 = complex(amp0)
         self.amp1 = complex(amp1)
+        # lazy gate-fusion buffers (see module docstring)
+        self.pending: Optional[np.ndarray] = None   # buffered 1q unitary
+        self.links: Dict["_Shard", _PhaseLink] = {}  # partner -> link
 
     @property
     def cached(self) -> bool:
         return self.unit is None
 
+    def base_z_value(self) -> Optional[int]:
+        """This shard's definite Z bit at the *base* level (below
+        buffers), or None."""
+        if not self.cached:
+            return None
+        nrm = abs(self.amp0) ** 2 + abs(self.amp1) ** 2
+        if nrm <= 0.0:
+            return None
+        p1 = (abs(self.amp1) ** 2) / nrm
+        if p1 <= FP_NORM_EPSILON:
+            return 0
+        if p1 >= 1.0 - FP_NORM_EPSILON:
+            return 1
+        return None
+
 
 class QUnit(QInterface):
     def __init__(self, qubit_count: int, init_state: int = 0,
                  unit_factory: Optional[Callable] = None,
-                 separability_threshold: Optional[float] = None, **kwargs):
+                 separability_threshold: Optional[float] = None,
+                 phase_fusion: Optional[bool] = None, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
         self._factory = unit_factory or _default_unit_factory
         self._unit_kwargs = {k: v for k, v in kwargs.items() if k != "rng"}
+        if phase_fusion is None:
+            import os
+
+            phase_fusion = os.environ.get("QRACK_QUNIT_PHASE_FUSION", "1") != "0"
+        self.phase_fusion = bool(phase_fusion)
+        self.dispatch_count = 0  # engine gate dispatches (test observability)
         # TrySeparate tolerance (reference: QRACK_QUNIT_SEPARABILITY_THRESHOLD)
         self.sep_threshold = (
             separability_threshold if separability_threshold is not None
@@ -155,31 +260,193 @@ class QUnit(QInterface):
         s.amp0, s.amp1 = complex(st[0]), complex(st[1])
 
     def _separate_bit(self, q: int, value: bool) -> None:
-        """Drop a just-measured qubit out of its unit and re-register it
-        as a cached eigenstate (reference: SeparateBit, src/qunit.cpp:1350)."""
+        """Drop a qubit whose *base* (below-buffer) state collapsed to
+        `value` out of its unit and re-register it as a cached shard
+        (reference: SeparateBit, src/qunit.cpp:1350).  The shard's links
+        reduce to 1q phases on their partners; its pending folds into
+        the cached amplitudes."""
+        vec = np.array([0j, 1 + 0j] if value else [1 + 0j, 0j])
+        self._detach_raw(q, value, vec)
+
+    # ------------------------------------------------------------------
+    # gate-fusion buffers: phase links + pending 2x2s
+    # (reference: PhaseShard algebra, include/qengineshard.hpp:32-100 and
+    #  src/qengineshard.cpp; basis tags src/qunit.cpp:2433-2487 — here
+    #  re-designed as a commuting diagonal-link bag under per-shard
+    #  pending unitaries, see module docstring)
+    # ------------------------------------------------------------------
+
+    def _apply_base_diag(self, s: _Shard, phases: np.ndarray) -> None:
+        """Apply diag(phases) at the *base* level of shard s (below its
+        pending, below remaining links — legal because diagonals commute
+        with every link)."""
+        if abs(phases[0] - 1) < _EPS and abs(phases[1] - 1) < _EPS:
+            return
+        if s.cached:
+            s.amp0 *= complex(phases[0])
+            s.amp1 *= complex(phases[1])
+        else:
+            s.unit.MCMtrxPerm((), np.diag(phases), s.mapped, 0)
+            self.dispatch_count += 1
+
+    def _reduce_links(self, s: _Shard, bit: int) -> None:
+        """Shard s's base collapsed to `bit`: every link reduces to a
+        1q diagonal on its partner (the buffered-CZ elision win)."""
+        for partner, link in list(s.links.items()):
+            self._apply_base_diag(partner, link.phases_for(s, bit))
+            del s.links[partner]
+            partner.links.pop(s, None)
+
+    def _qubit_of(self, s: _Shard) -> int:
+        return next(i for i, t in enumerate(self.shards) if t is s)
+
+    def _resolve_link(self, link: _PhaseLink) -> None:
+        """Push one link down into the base (engine), entangling its
+        endpoints if neither is base-definite."""
+        a, b = link.a, link.b
+        a.links.pop(b, None)
+        b.links.pop(a, None)
+        za, zb = a.base_z_value(), b.base_z_value()
+        if za is not None:
+            self._apply_base_diag(b, link.phases_for(a, za))
+            return
+        if zb is not None:
+            self._apply_base_diag(a, link.phases_for(b, zb))
+            return
+        qa, qb = self._qubit_of(a), self._qubit_of(b)
+        unit = self._merge((qa, qb))
+        d0, d1 = link.d[0], link.d[1]
+        if np.allclose(d0, 1.0, atol=_EPS):
+            if not np.allclose(d1, 1.0, atol=_EPS):
+                unit.MCMtrxPerm((a.mapped,), np.diag(d1), b.mapped, 1)
+                self.dispatch_count += 1
+        elif np.allclose(d1, 1.0, atol=_EPS):
+            unit.MCMtrxPerm((a.mapped,), np.diag(d0), b.mapped, 0)
+            self.dispatch_count += 1
+        else:
+            unit.MCMtrxPerm((), np.diag(d0), b.mapped, 0)
+            unit.MCMtrxPerm((a.mapped,), np.diag(d1 / d0), b.mapped, 1)
+            self.dispatch_count += 2
+
+    def _flush_links(self, q: int) -> None:
         s = self.shards[q]
-        unit = s.unit
-        if unit is None:
-            s.amp0, s.amp1 = ((0j, 1 + 0j) if value else (1 + 0j, 0j))
+        for link in list(s.links.values()):
+            self._resolve_link(link)
+
+    def _flush_pending(self, q: int) -> None:
+        s = self.shards[q]
+        if s.pending is None:
             return
-        mapped = s.mapped
-        if unit.qubit_count == 1:
-            s.unit = None
-            s.mapped = 0
-            s.amp0, s.amp1 = ((0j, 1 + 0j) if value else (1 + 0j, 0j))
+        k = _mat_kind(s.pending)
+        if s.links:
+            if k == "gen":
+                self._flush_links(q)
+            elif k == "anti":
+                for link in s.links.values():
+                    link.flip(s)
+        m = s.pending
+        s.pending = None
+        if s.cached:
+            a0 = m[0, 0] * s.amp0 + m[0, 1] * s.amp1
+            a1 = m[1, 0] * s.amp0 + m[1, 1] * s.amp1
+            s.amp0, s.amp1 = a0, a1
+        else:
+            s.unit.MCMtrxPerm((), m, s.mapped, 0)
+            self.dispatch_count += 1
+
+    def _flush(self, q: int) -> None:
+        """Clear all buffers above qubit q (links first, then pending)."""
+        self._flush_links(q)
+        self._flush_pending(q)
+
+    def _flush_all(self) -> None:
+        for q in range(self.qubit_count):
+            self._flush(q)
+
+    def _buffer_1q(self, q: int, m: np.ndarray) -> None:
+        """Apply a 1q unitary lazily at the top of qubit q's stack."""
+        s = self.shards[q]
+        if not self.phase_fusion and not s.cached:
+            s.unit.MCMtrxPerm((), m, s.mapped, 0)
+            self.dispatch_count += 1
             return
-        unit.Dispose(mapped, 1, 1 if value else 0)
-        for other in self.shards:
-            if other.unit is unit and other.mapped > mapped:
-                other.mapped -= 1
-        s.unit = None
-        s.mapped = 0
-        s.amp0, s.amp1 = ((0j, 1 + 0j) if value else (1 + 0j, 0j))
-        self._release_if_single(unit)
+        if s.cached and not s.links:
+            # free host math on the cached amplitudes (pending is only
+            # ever non-None on cached shards that carry links)
+            if s.pending is not None:
+                m = m @ s.pending
+                s.pending = None
+            a0 = m[0, 0] * s.amp0 + m[0, 1] * s.amp1
+            a1 = m[1, 0] * s.amp0 + m[1, 1] * s.amp1
+            s.amp0, s.amp1 = a0, a1
+            return
+        if s.cached and _mat_kind(m) == "diag" and s.pending is None:
+            # diagonals commute with every link: fold into the base amps
+            self._apply_base_diag(s, np.array([m[0, 0], m[1, 1]]))
+            return
+        nm = m if s.pending is None else m @ s.pending
+        s.pending = None if _mat_kind(nm) == "id" else nm
+
+    def _buffer_phase_link(self, c: int, t: int, m: np.ndarray,
+                           fire_on: int) -> None:
+        """Buffer a single-control diagonal gate as a phase link."""
+        sc, st = self.shards[c], self.shards[t]
+        # pendings must be monomial to commute the diagonal past them
+        for q, s in ((c, sc), (t, st)):
+            if _mat_kind(s.pending) == "gen":
+                self._flush(q)
+        d = np.ones((2, 2), dtype=np.complex128)
+        d[fire_on, 0] = m[0, 0]
+        d[fire_on, 1] = m[1, 1]
+        if _mat_kind(sc.pending) == "anti":
+            d = d[::-1, :]
+        if _mat_kind(st.pending) == "anti":
+            d = d[:, ::-1]
+        link = sc.links.get(st)
+        if link is None:
+            link = _PhaseLink(sc, st, d)
+            sc.links[st] = link
+            st.links[sc] = link
+        else:
+            link.mul(sc, d)
+        scalar = link.uniform_scalar()
+        if scalar is not None:
+            # pure (global-per-pair) phase: the gate pair cancelled
+            del sc.links[st]
+            del st.links[sc]
+            if abs(scalar - 1) > _EPS:
+                self._apply_base_diag(sc, np.array([scalar, scalar]))
 
     # ------------------------------------------------------------------
     # gate primitive with control trimming
     # ------------------------------------------------------------------
+
+    def _logical_z_value(self, s: _Shard) -> Optional[int]:
+        """Definite logical Z bit of a cached shard, seen through its
+        buffers, or None."""
+        if not s.cached:
+            return None
+        zb = s.base_z_value()
+        if zb is not None:
+            if s.pending is None:
+                return zb
+            vec = s.pending[:, zb]
+        elif not s.links:
+            vec = np.array([s.amp0, s.amp1], dtype=np.complex128)
+            if s.pending is not None:
+                vec = s.pending @ vec
+        else:
+            # indefinite base with pending entanglement: unknown
+            return None
+        nrm = abs(vec[0]) ** 2 + abs(vec[1]) ** 2
+        if nrm <= 0.0:
+            return None
+        p1 = (abs(vec[1]) ** 2) / nrm
+        if p1 <= FP_NORM_EPSILON:
+            return 0
+        if p1 >= 1.0 - FP_NORM_EPSILON:
+            return 1
+        return None
 
     def _trim_controls(self, controls, perm) -> Optional[Tuple[tuple, int]]:
         """Elide controls whose cached value is definite (reference:
@@ -189,19 +456,11 @@ class QUnit(QInterface):
         live_perm = 0
         for j, c in enumerate(controls):
             want = (perm >> j) & 1
-            s = self.shards[c]
-            if s.cached:
-                p1 = abs(s.amp1) ** 2
-                if p1 <= FP_NORM_EPSILON:
-                    have = 0
-                elif p1 >= 1.0 - FP_NORM_EPSILON:
-                    have = 1
-                else:
-                    have = None
-                if have is not None:
-                    if have != want:
-                        return None
-                    continue
+            have = self._logical_z_value(self.shards[c])
+            if have is not None:
+                if have != want:
+                    return None
+                continue
             if want:
                 live_perm |= 1 << len(live)
             live.append(c)
@@ -214,18 +473,19 @@ class QUnit(QInterface):
         if trimmed is None:
             return
         live, live_perm = trimmed
-        s = self.shards[target]
         if not live:
-            if s.cached:
-                a0 = m[0, 0] * s.amp0 + m[0, 1] * s.amp1
-                a1 = m[1, 0] * s.amp0 + m[1, 1] * s.amp1
-                s.amp0, s.amp1 = a0, a1
-            else:
-                s.unit.MCMtrxPerm((), m, s.mapped, 0)
+            self._buffer_1q(target, m)
             return
+        if (self.phase_fusion and len(live) == 1
+                and _mat_kind(m) == "diag" and live[0] != target):
+            self._buffer_phase_link(live[0], target, m, live_perm & 1)
+            return
+        for q in live + (target,):
+            self._flush(q)
         unit = self._merge(tuple(live) + (target,))
         mapped_ctrls = tuple(self.shards[c].mapped for c in live)
         unit.MCMtrxPerm(mapped_ctrls, m, self.shards[target].mapped, live_perm)
+        self.dispatch_count += 1
 
     def Swap(self, q1: int, q2: int) -> None:
         """Logical shard exchange — zero engine work (reference:
@@ -235,8 +495,11 @@ class QUnit(QInterface):
         self.shards[q1], self.shards[q2] = self.shards[q2], self.shards[q1]
 
     def Apply4x4(self, m: np.ndarray, q1: int, q2: int) -> None:
+        self._flush(q1)
+        self._flush(q2)
         unit = self._merge((q1, q2))
         if hasattr(unit, "Apply4x4"):
+            self.dispatch_count += 1
             unit.Apply4x4(m, self.shards[q1].mapped, self.shards[q2].mapped)
         else:
             from ..interface.synth import apply_small_unitary_via_primitive
@@ -250,15 +513,25 @@ class QUnit(QInterface):
     def Prob(self, q: int) -> float:
         self._check_qubit(q)
         s = self.shards[q]
+        k = _mat_kind(s.pending)
+        if k == "gen":
+            # a general pending mixes branches whose relative phases the
+            # links carry: push the stack down before measuring
+            self._flush(q)
+            k = "id"
         if s.cached:
             nrm = abs(s.amp0) ** 2 + abs(s.amp1) ** 2
-            return (abs(s.amp1) ** 2) / nrm if nrm > 0 else 0.0
-        return s.unit.Prob(s.mapped)
+            p1 = (abs(s.amp1) ** 2) / nrm if nrm > 0 else 0.0
+        else:
+            p1 = s.unit.Prob(s.mapped)
+        # diagonal pendings/links never change Z marginals; an
+        # anti-diagonal pending just relabels the outcome
+        return 1.0 - p1 if k == "anti" else p1
 
     def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
         self._check_qubit(q)
         s = self.shards[q]
-        p1 = self.Prob(q)
+        p1 = self.Prob(q)  # flushes a general pending if present
         if do_force:
             res = bool(result)
         elif p1 >= 1.0 - FP_NORM_EPSILON:
@@ -272,10 +545,11 @@ class QUnit(QInterface):
             raise RuntimeError("ForceM: forced result has zero probability")
         if not do_apply:
             return res
+        base_bit = res ^ (_mat_kind(s.pending) == "anti")
         unit = s.unit
         if not s.cached:
-            s.unit.ForceM(s.mapped, res, do_force=True)
-        self._separate_bit(q, res)
+            s.unit.ForceM(s.mapped, base_bit, do_force=True)
+        self._separate_bit(q, base_bit)
         if unit is not None and self.reactive_separate:
             # collapse often disentangles the rest (e.g. GHZ): peel off any
             # member that became a Z eigenstate (reference: reactive
@@ -295,13 +569,20 @@ class QUnit(QInterface):
 
     def MAll(self) -> int:
         """Per-unit measurement: cached qubits draw directly; each unit
-        measures once (reference: src/qunit.cpp:1534)."""
+        measures once (reference: src/qunit.cpp:1534).  Diagonal links
+        never change the joint Z distribution, so they are simply
+        dropped after the collapse; monomial pendings relabel outcomes
+        (general pendings are flushed first)."""
+        for q in range(self.qubit_count):
+            if _mat_kind(self.shards[q].pending) == "gen":
+                self._flush(q)
         result = 0
         done_units: Dict[int, int] = {}
         for q in range(self.qubit_count):
             s = self.shards[q]
+            flip = _mat_kind(s.pending) == "anti"
             if s.cached:
-                p1 = self.Prob(q)
+                p1 = self.Prob(q)  # logical prob (anti already folded in)
                 if p1 >= 1.0 - FP_NORM_EPSILON:
                     bit = True
                 elif p1 <= FP_NORM_EPSILON:
@@ -310,26 +591,31 @@ class QUnit(QInterface):
                     bit = self.Rand() <= p1
                 if bit:
                     result |= 1 << q
-                s.amp0, s.amp1 = ((0j, 1 + 0j) if bit else (1 + 0j, 0j))
             else:
                 uid = id(s.unit)
                 if uid not in done_units:
                     s.unit.rng = self.rng
                     done_units[uid] = s.unit.MAll()
-                if (done_units[uid] >> s.mapped) & 1:
+                if ((done_units[uid] >> s.mapped) & 1) ^ flip:
                     result |= 1 << q
-        # everything is separable now
+        # everything is separable now; buffers are consumed by collapse
         for q in range(self.qubit_count):
             s = self.shards[q]
-            if not s.cached:
-                bit = bool((result >> q) & 1)
-                s.unit = None
-                s.mapped = 0
-                s.amp0, s.amp1 = ((0j, 1 + 0j) if bit else (1 + 0j, 0j))
+            bit = bool((result >> q) & 1)
+            s.unit = None
+            s.mapped = 0
+            s.amp0, s.amp1 = ((0j, 1 + 0j) if bit else (1 + 0j, 0j))
+            s.pending = None
+            s.links.clear()
         return result
 
     def ProbParity(self, mask: int) -> float:
         bits = [q for q in range(self.qubit_count) if (mask >> q) & 1]
+        # parity is a Z-diagonal observable: links don't affect it and
+        # monomial pendings just flip contributions
+        for q in bits:
+            if _mat_kind(self.shards[q].pending) == "gen":
+                self._flush(q)
         # split by unit: parity distribution composes by XOR convolution
         groups: Dict[int, List[int]] = {}
         singles: List[int] = []
@@ -343,9 +629,13 @@ class QUnit(QInterface):
         for qs in groups.values():
             unit = self.shards[qs[0]].unit
             sub_mask = 0
+            flips = 0
             for q in qs:
                 sub_mask |= 1 << self.shards[q].mapped
-            odds.append(unit.ProbParity(sub_mask))
+                if _mat_kind(self.shards[q].pending) == "anti":
+                    flips ^= 1
+            o = unit.ProbParity(sub_mask)
+            odds.append(1.0 - o if flips else o)
         p = 0.0
         for o in odds:
             p = p * (1 - o) + (1 - p) * o
@@ -365,6 +655,9 @@ class QUnit(QInterface):
         return ok
 
     def _try_separate_1qb(self, q: int, tol: float) -> bool:
+        """Probe the *base* (engine) state of q for separability; the
+        shard's pending/links stay buffered above whatever it detaches
+        to (links reduce only when the detached base is Z-definite)."""
         s = self.shards[q]
         if s.cached:
             return True
@@ -390,16 +683,42 @@ class QUnit(QInterface):
             if p <= tol or p >= 1.0 - tol:
                 val = p >= 0.5
                 unit.ForceM(s.mapped, val, do_force=True)
-                self._separate_bit(q, val)
-                ns = self.shards[q]
-                vec = np.array([ns.amp0, ns.amp1], dtype=np.complex128)
+                vec = np.array([0.0 + 0j, 0.0 + 0j])
+                vec[1 if val else 0] = 1.0
                 for g in inv:
                     vec = np.asarray(g) @ vec
-                ns.amp0, ns.amp1 = complex(vec[0]), complex(vec[1])
+                self._detach_raw(q, val, vec)
                 return True
             for g in inv:
                 unit.MCMtrxPerm((), g, s.mapped, 0)
         return False
+
+    def _detach_raw(self, q: int, collapsed_val: bool, base_vec: np.ndarray) -> None:
+        """Remove q from its unit after a raw collapse to `collapsed_val`
+        and re-register it cached with base state `base_vec`; buffers
+        stay above it (links reduce only for a Z-definite base)."""
+        s = self.shards[q]
+        unit = s.unit
+        mapped = s.mapped
+        if unit is not None:
+            if unit.qubit_count > 1:
+                unit.Dispose(mapped, 1, 1 if collapsed_val else 0)
+                for other in self.shards:
+                    if other.unit is unit and other.mapped > mapped:
+                        other.mapped -= 1
+            s.unit = None
+            s.mapped = 0
+        s.amp0, s.amp1 = complex(base_vec[0]), complex(base_vec[1])
+        zb = s.base_z_value()
+        if zb is not None:
+            self._reduce_links(s, zb)
+            if s.pending is not None:
+                vec = s.pending[:, zb]
+                phase = complex(s.amp1 if zb else s.amp0)
+                s.amp0, s.amp1 = phase * complex(vec[0]), phase * complex(vec[1])
+                s.pending = None
+        if unit is not None:
+            self._release_if_single(unit)
 
     # speculative decompose with error check (reference: TryDecompose,
     # include/qinterface.hpp:452; engine TryDecompose + TRYDECOMPOSE_EPSILON)
@@ -444,6 +763,8 @@ class QUnit(QInterface):
         length = dest.qubit_count
         self._check_range(start, length)
         qubits = list(range(start, start + length))
+        for q in qubits:
+            self._flush(q)
         # if the span is exactly a set of whole units + cached shards,
         # hand them over without touching amplitudes
         clean = all(
@@ -482,8 +803,11 @@ class QUnit(QInterface):
                 self.ForceM(start + i, bool((disposed_perm >> i) & 1))
         else:
             for i in range(length):
-                if not self.shards[start + i].cached:
-                    # measure it out (separable disposal contract)
+                s = self.shards[start + i]
+                if not s.cached or s.links:
+                    # measure it out (separable disposal contract); a
+                    # cached shard with pending links is link-entangled,
+                    # and collapse reduces those links onto the partners
                     self.M(start + i)
         del self.shards[start:start + length]
         self.qubit_count -= length
@@ -508,6 +832,8 @@ class QUnit(QInterface):
         for (st, ln) in regs:
             qubits.extend(range(st, st + ln))
         qubits.extend(extra_bits)
+        for q in qubits:
+            self._flush(q)
         unit, base = self._order_contiguous(qubits)
         bases = []
         off = base
@@ -623,6 +949,7 @@ class QUnit(QInterface):
     # ------------------------------------------------------------------
 
     def GetQuantumState(self) -> np.ndarray:
+        self._flush_all()
         n = self.qubit_count
         # factor order: cached qubits and first-appearance units
         factors: List[Tuple[np.ndarray, List[int]]] = []
@@ -658,6 +985,9 @@ class QUnit(QInterface):
         state = np.asarray(state, dtype=np.complex128).reshape(-1)
         if state.shape[0] != (1 << self.qubit_count):
             raise ValueError("state length mismatch")
+        for s in self.shards:
+            s.pending = None
+            s.links.clear()
         unit = self._factory(self.qubit_count, rng=self.rng.spawn(), **self._unit_kwargs)
         unit.SetQuantumState(state)
         for q in range(self.qubit_count):
@@ -669,6 +999,7 @@ class QUnit(QInterface):
             self._try_separate_1qb(q, TRYDECOMPOSE_EPSILON)
 
     def GetAmplitude(self, perm: int) -> complex:
+        self._flush_all()
         amp = 1.0 + 0j
         seen = {}
         for q in range(self.qubit_count):
@@ -709,8 +1040,10 @@ class QUnit(QInterface):
 
     def Clone(self) -> "QUnit":
         c = QUnit(self.qubit_count, unit_factory=self._factory,
-                  rng=self.rng.spawn(), **self._unit_kwargs)
+                  rng=self.rng.spawn(), phase_fusion=self.phase_fusion,
+                  **self._unit_kwargs)
         cloned: Dict[int, object] = {}
+        shard_map: Dict[int, _Shard] = {}
         c.shards = []
         for s in self.shards:
             ns = _Shard(s.amp0, s.amp1)
@@ -720,7 +1053,21 @@ class QUnit(QInterface):
                     cloned[uid] = s.unit.Clone()
                 ns.unit = cloned[uid]
                 ns.mapped = s.mapped
+            if s.pending is not None:
+                ns.pending = s.pending.copy()
+            shard_map[id(s)] = ns
             c.shards.append(ns)
+        # re-create phase links between the cloned shards
+        seen_links = set()
+        for s in self.shards:
+            for link in s.links.values():
+                if id(link) in seen_links:
+                    continue
+                seen_links.add(id(link))
+                na, nb = shard_map[id(link.a)], shard_map[id(link.b)]
+                nl = _PhaseLink(na, nb, link.d.copy())
+                na.links[nb] = nl
+                nb.links[na] = nl
         return c
 
     def SumSqrDiff(self, other) -> float:

@@ -52,8 +52,8 @@ class QUnitMulti(QUnit):
         self.RedistributeQEngines()
         return unit
 
-    def _separate_bit(self, q: int, value: bool) -> None:
-        super()._separate_bit(q, value)
+    def _detach_raw(self, q: int, collapsed_val: bool, base_vec) -> None:
+        super()._detach_raw(q, collapsed_val, base_vec)
         self.RedistributeQEngines()
 
     def RedistributeQEngines(self) -> None:

@@ -35,8 +35,8 @@ def pin_host_cpu(n_devices: int = 8) -> None:
             raise RuntimeError(
                 "pin_host_cpu called after a JAX backend was initialized; "
                 "the cpu pin and host device count cannot take effect")
-    except ImportError:  # private API moved: fall through, best effort
-        pass
+    except (ImportError, AttributeError):
+        pass  # private API moved: fall through, best effort
 
     flags = os.environ.get("XLA_FLAGS", "")
     pat = re.compile(r"--xla_force_host_platform_device_count=(\d+)")

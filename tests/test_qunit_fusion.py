@@ -1,0 +1,226 @@
+"""QUnit gate-fusion buffers: phase links + pending 2x2s.
+
+Validates the re-design of the reference's PhaseShard/basis-tag
+machinery (reference: include/qengineshard.hpp:32-100, applied in
+src/qunit.cpp:2433-2487): oracle parity is maintained while engine
+dispatches drop materially, buffered CZ pairs cancel without ever
+entangling, and measurement reduces pending links to local phases."""
+
+import cmath
+import math
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.qunit import QUnit
+from qrack_tpu.utils.rng import QrackRandom
+
+
+def factory(n, **kw):
+    kw.setdefault("rand_global_phase", False)
+    return QEngineCPU(n, **kw)
+
+
+def make(n, seed=1, **kw):
+    return QUnit(n, unit_factory=factory, rng=QrackRandom(seed),
+                 rand_global_phase=False, **kw)
+
+
+def oracle(n, seed=1):
+    return QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+
+
+def fid(a, b):
+    return abs(np.vdot(a.GetQuantumState(), b.GetQuantumState())) ** 2
+
+
+def phase_heavy_circuit(q, rng, depth, n):
+    """Supremacy-style circuit: 1q rotations + CZ/CPhase entanglers —
+    the workload the reference's PhaseShard buffers accelerate."""
+    for _ in range(depth):
+        for i in range(n):
+            r = rng.randint(0, 6)
+            if r == 0:
+                q.H(i)
+            elif r == 1:
+                q.T(i)
+            elif r == 2:
+                q.X(i)
+            elif r == 3:
+                q.S(i)
+            elif r == 4:
+                q.RZ(rng.rand() * math.pi, i)
+            else:
+                q.Y(i)
+        for i in range(0, n - 1, 2):
+            c, t = i, i + 1
+            r = rng.randint(0, 3)
+            if r == 0:
+                q.CZ(c, t)
+            elif r == 1:
+                q.MCPhase((c,), 1.0,
+                          cmath.exp(1j * rng.rand() * math.pi), t)
+            else:
+                q.CNOT(c, t)
+        for i in range(1, n - 1, 2):
+            q.CZ(i, i + 1)
+
+
+def test_fusion_matches_oracle():
+    n = 5
+    for seed in (11, 12, 13):
+        q = make(n, seed)
+        o = oracle(n, seed)
+        phase_heavy_circuit(q, QrackRandom(500 + seed), 6, n)
+        phase_heavy_circuit(o, QrackRandom(500 + seed), 6, n)
+        assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_fusion_reduces_dispatches():
+    n = 6
+    counts = {}
+    for fusion in (True, False):
+        q = make(n, 7, phase_fusion=fusion)
+        phase_heavy_circuit(q, QrackRandom(900), 6, n)
+        q.GetQuantumState()  # force flush so both do the same total work
+        counts[fusion] = q.dispatch_count
+    assert counts[True] < counts[False], counts
+    # and the states agree with each other
+    q1 = make(n, 7, phase_fusion=True)
+    q2 = make(n, 7, phase_fusion=False)
+    phase_heavy_circuit(q1, QrackRandom(900), 6, n)
+    phase_heavy_circuit(q2, QrackRandom(900), 6, n)
+    assert fid(q1, q2) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_cz_pair_cancels_without_entangling():
+    q = make(2)
+    q.H(0)
+    q.H(1)
+    q.CZ(0, 1)
+    q.CZ(0, 1)
+    # the pair cancelled in the link bag: no unit was ever allocated
+    assert all(s.cached for s in q.shards)
+    assert q.dispatch_count == 0
+    st = q.GetQuantumState()
+    assert np.allclose(st, np.full(4, 0.5), atol=1e-12)
+
+
+def test_hh_cancels_on_entangled_shard():
+    q = make(2)
+    q.H(0)
+    q.CNOT(0, 1)
+    before = q.dispatch_count
+    q.H(0)
+    q.H(0)
+    q.T(0)
+    q.Z(0)
+    assert q.dispatch_count == before  # all buffered, zero engine work
+    o = oracle(2)
+    o.H(0)
+    o.CNOT(0, 1)
+    o.T(0)
+    o.Z(0)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-9)
+
+
+def test_measurement_reduces_link_without_entangling():
+    # CZ between two superposed but separable qubits stays buffered;
+    # measuring one endpoint reduces it to a local phase on the other —
+    # entanglement never happens (reference: buffered-CZ elision)
+    q = make(2, seed=5)
+    q.H(0)
+    q.H(1)
+    q.CZ(0, 1)
+    assert all(s.cached for s in q.shards)
+    res = q.M(0)
+    assert all(s.cached for s in q.shards)
+    assert q.dispatch_count == 0
+    # remaining qubit: |+> if res==0 else |->  (CZ phase applied)
+    expect = np.array([1, -1 if res else 1]) / math.sqrt(2)
+    st = q.GetQuantumState()
+    sub = st[[0 + (1 if res else 0), 2 + (1 if res else 0)]]
+    phase = sub[0] / expect[0]
+    assert np.allclose(sub, phase * expect, atol=1e-9)
+
+
+def test_link_through_anti_pending():
+    # X pending on an entangled shard flips the link payload orientation
+    for seed in (21, 22):
+        q = make(3, seed)
+        o = oracle(3, seed)
+        for eng in (q, o):
+            eng.H(0)
+            eng.CNOT(0, 1)   # entangle
+            eng.X(0)         # anti-diagonal pending on q's shard
+            eng.CZ(0, 2)     # buffered through the flip
+            eng.H(2)
+            eng.CZ(0, 2)
+            eng.H(0)         # general pending; forces flush on next probe
+        assert fid(q, o) == pytest.approx(1.0, abs=1e-9)
+
+
+def test_prob_through_buffers_is_free():
+    q = make(2)
+    q.H(0)
+    q.CNOT(0, 1)
+    base = q.dispatch_count
+    q.T(0)       # diag pending
+    q.X(0)       # composes to 'gen'? X @ T is anti-diagonal — still free
+    assert q.Prob(0) == pytest.approx(0.5, abs=1e-9)
+    assert q.dispatch_count == base
+
+
+def test_qft_parity_with_fusion():
+    n = 5
+    q = make(n, 3)
+    o = oracle(n, 3)
+    for eng in (q, o):
+        eng.X(0)
+        eng.X(2)
+        eng.QFT(0, n)
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_clone_copies_buffers():
+    q = make(3)
+    q.H(0)
+    q.H(1)
+    q.CZ(0, 1)
+    q.T(1)
+    c = q.Clone()
+    sq = q.GetQuantumState()   # flushes q's buffers
+    sc = c.GetQuantumState()   # clone must have its own copies
+    assert np.allclose(np.abs(np.vdot(sq, sc)) ** 2, 1.0, atol=1e-9)
+
+
+def test_dispose_shard_with_pending_link():
+    # disposing a link-entangled cached shard must reduce the link, not
+    # leave a dangling partner reference
+    q = make(2)
+    q.H(0)
+    q.H(1)
+    q.CZ(0, 1)
+    q.Dispose(1, 1)
+    q.T(0)
+    q.H(0)
+    assert 0.0 <= q.Prob(0) <= 1.0
+    assert q.qubit_count == 1
+    assert not q.shards[0].links
+
+
+def test_maall_with_buffers_distribution():
+    # GHZ-like with buffered phases: MAll outcomes must stay correlated
+    hits = set()
+    for trial in range(40):
+        q = make(3, seed=100 + trial)
+        q.H(0)
+        q.CNOT(0, 1)
+        q.CNOT(1, 2)
+        q.Z(0)       # diag pending
+        q.X(1)       # anti pending: flips outcome bit 1
+        r = q.MAll()
+        hits.add(r)
+    assert hits <= {0b010, 0b101}, hits
+    assert len(hits) == 2
