@@ -33,20 +33,57 @@ def apply_local_2x2(local, mp, L: int, target: int, lmask, lval, gmask, gval):
 
 
 def apply_global_2x2(local, mp, npg: int, gpos: int, lmask, lval, gmask, gval):
-    """Non-diagonal gate on a paged target: ppermute pair exchange."""
+    """Non-diagonal gate on a paged target: half-buffer pair exchange.
+
+    Reference discipline (ShuffleBuffers, src/qpager.cpp:400-447): never
+    ship a whole page.  Each page keeps one half (split on the top
+    in-page bit), sends the other half to its partner, computes BOTH
+    output amplitudes for the half of the local indices it now holds
+    complete pairs for, and returns the partner's outputs.  Each
+    ppermute payload is half a page and peak extra memory is half a
+    page (vs. a full mirror page for whole-page exchange)."""
+    if local.shape[-1] < 2:
+        # degenerate 1-amplitude page: whole-page exchange
+        perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
+        pid = page_id()
+        b = (pid >> gpos) & 1
+        other = jax.lax.ppermute(local, "pages", perm)
+        re, im = mp[0], mp[1]
+        dd_re = jnp.where(b == 0, re[0, 0], re[1, 1])
+        dd_im = jnp.where(b == 0, im[0, 0], im[1, 1])
+        od_re = jnp.where(b == 0, re[0, 1], re[1, 0])
+        od_im = jnp.where(b == 0, im[0, 1], im[1, 0])
+        out = gk.cmul(dd_re, dd_im, local) + gk.cmul(od_re, od_im, other)
+        ok = (pid & gmask) == gval
+        return jnp.where(ok, out, local)
     perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
     pid = page_id()
     b = (pid >> gpos) & 1
-    other = jax.lax.ppermute(local, "pages", perm)
+    half_n = local.shape[-1] // 2
+    halves = local.reshape(local.shape[0], 2, half_n)  # [planes, top bit, rest]
+    keep = jnp.where(b == 0, halves[:, 0], halves[:, 1])
+    away = jnp.where(b == 0, halves[:, 1], halves[:, 0])
+    got = jax.lax.ppermute(away, "pages", perm)       # half-page payload
+    # this page now holds complete (a, b) pairs for local indices with
+    # top bit == b: a = partner-0 amplitude, b = partner-1 amplitude
+    a_amp = jnp.where(b == 0, keep, got)
+    b_amp = jnp.where(b == 0, got, keep)
     re, im = mp[0], mp[1]
-    dd_re = jnp.where(b == 0, re[0, 0], re[1, 1])
-    dd_im = jnp.where(b == 0, im[0, 0], im[1, 1])
-    od_re = jnp.where(b == 0, re[0, 1], re[1, 0])
-    od_im = jnp.where(b == 0, im[0, 1], im[1, 0])
-    out = gk.cmul(dd_re, dd_im, local) + gk.cmul(od_re, od_im, other)
-    idx = gk.iota_for(local)
-    ok = ((idx & lmask) == lval) & ((pid & gmask) == gval)
-    return jnp.where(ok, out, local)
+    a_out = gk.cmul(re[0, 0], im[0, 0], a_amp) + gk.cmul(re[0, 1], im[0, 1], b_amp)
+    b_out = gk.cmul(re[1, 0], im[1, 0], a_amp) + gk.cmul(re[1, 1], im[1, 1], b_amp)
+    # control masks: same local index for both outputs, page id differs
+    idx = gk.iota_for(keep) + jnp.where(b == 0, 0, half_n)
+    p0 = pid & ~(1 << gpos)
+    p1 = pid | (1 << gpos)
+    lok = (idx & lmask) == lval
+    a_out = jnp.where(lok & ((p0 & gmask) == gval), a_out, a_amp)
+    b_out = jnp.where(lok & ((p1 & gmask) == gval), b_out, b_amp)
+    mine = jnp.where(b == 0, a_out, b_out)
+    theirs = jnp.where(b == 0, b_out, a_out)
+    back = jax.lax.ppermute(theirs, "pages", perm)    # half-page payload
+    lo = jnp.where(b == 0, mine, back)
+    hi = jnp.where(b == 0, back, mine)
+    return jnp.stack([lo, hi], axis=1).reshape(local.shape)
 
 
 def apply_diag(local, d0re, d0im, d1re, d1im, tlo, thi, clo, cvlo, chi, cvhi):

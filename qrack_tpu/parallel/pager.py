@@ -89,6 +89,8 @@ class QPager(QEngine):
         dev_list = list(devices)[:n_pages]
         self.n_pages = n_pages
         self.g_bits = log2(n_pages)
+        self._max_g = self.g_bits
+        self._all_devices = dev_list
         self._check_capacity(qubit_count)
         self.dtype = jnp.dtype(dtype)
         self.mesh = Mesh(np.array(dev_list), ("pages",))
@@ -455,72 +457,201 @@ class QPager(QEngine):
             b = jax.device_put(gk.to_planes(other.GetQuantumState(), self.dtype), self.sharding)
         return float(self._p_sum_sqr_diff()(self._state, b))
 
-    # -- structural ops: host-staged (reference: CombineEngines fallback) --
+    # -- structural ops: device-side sharded programs (reference rebalances
+    #    pages device-side, src/qpager.cpp:316-367; here XLA/GSPMD inserts
+    #    the collectives for the outer products / reductions).  Host
+    #    staging survives only as the fallback when the result is so
+    #    small the page mesh itself must shrink. --
+
+    def _desired_g(self, new_width: int) -> int:
+        """Page-count policy for a new width: re-grow to the construction
+        page count as soon as the ket is big enough again (reference:
+        SeparateEngines/CombineEngines, src/qpager.cpp:316-367)."""
+        return min(self._max_g, max(new_width, 0))
+
+    def _mesh_would_change(self, new_width: int) -> bool:
+        return self._desired_g(new_width) != self.g_bits
+
+    def _p_compose(self, n1, n2, start):
+        dtype = self.dtype
+        sh = self.sharding
+
+        def build():
+            hi, lo = 1 << (n1 - start), 1 << start
+
+            def f(a, b):
+                ar = a[0].reshape(hi, lo)
+                ai = a[1].reshape(hi, lo)
+                br, bi = b[0], b[1]
+                # out[h, j, l] = a[h, l] * b[j]  (other's qubits at `start`)
+                o_r = (jnp.einsum("hl,j->hjl", ar, br)
+                       - jnp.einsum("hl,j->hjl", ai, bi))
+                o_i = (jnp.einsum("hl,j->hjl", ar, bi)
+                       + jnp.einsum("hl,j->hjl", ai, br))
+                return jnp.stack([o_r.reshape(-1), o_i.reshape(-1)]).astype(dtype)
+
+            return jax.jit(f, out_shardings=sh)
+
+        return _program(self._key("compose", n1, n2, start), build)
 
     def _k_compose(self, other, start) -> None:
-        a = self.GetQuantumState()
-        b = np.asarray(other.GetQuantumState())
-        full = gk.compose(gk.to_planes(a, self.dtype), gk.to_planes(b, self.dtype),
-                          self.qubit_count, other.qubit_count, start)
-        self._state = jax.device_put(full, self._sharding_for(self.qubit_count + other.qubit_count))
+        n1, n2 = self.qubit_count, other.qubit_count
+        if self._mesh_would_change(n1 + n2):
+            # ket was below the page count (tiny): host-stage the regrow
+            a = (np.asarray(jax.device_get(self._state), dtype=np.float64))
+            a = a[0] + 1j * a[1]
+            b = np.asarray(other.GetQuantumState())
+            full = gk.compose(gk.to_planes(a, self.dtype),
+                              gk.to_planes(b, self.dtype), n1, n2, start)
+            self._state = jax.device_put(full, self._sharding_for(n1 + n2))
+            return
+        if (isinstance(other, QPager)
+                and list(other.mesh.devices.flat) == list(self.mesh.devices.flat)):
+            b = other._state  # device-to-device: same device set
+        else:
+            b = gk.to_planes(np.asarray(other.GetQuantumState()), self.dtype)
+        new_state = self._p_compose(n1, n2, start)(self._state, b)
+        self._sharding_for(n1 + n2)
+        self._state = new_state
 
-    def _k_decompose(self, start, length) -> np.ndarray:
-        planes = gk.to_planes(self.GetQuantumState(), self.dtype)
-        m = gk.split_matrix(planes, self.qubit_count, start, length)
-        m = np.asarray(m, dtype=np.float64)
-        row_norms = (m[0] ** 2 + m[1] ** 2).sum(axis=1)
-        r0 = int(np.argmax(row_norms))
-        dest = (m[0, r0] + 1j * m[1, r0]) / math.sqrt(row_norms[r0])
-        rem = (m[0] + 1j * m[1]) @ np.conj(dest)
+    def _p_decompose(self, n, start, length, with_dest: bool):
+        dtype = self.dtype
+        rem_sh = self.sharding
+
+        def build():
+            hi = 1 << (n - start - length)
+            mid = 1 << length
+            lo = 1 << start
+
+            def f(s):
+                # layout convention matches the host oracle (gatekernels.
+                # split_matrix): dominant REST branch fixes the span
+                # state's phase, rem is the exact projection so that
+                # rem (x) dest == state bit-for-bit on product states
+                a = s.reshape(2, hi, mid, lo)
+                at = a.transpose(0, 2, 1, 3).reshape(2, mid, hi * lo)
+                pm = jnp.sum(at[0] ** 2 + at[1] ** 2, axis=0)  # (rest,)
+                f0 = jnp.argmax(pm)
+                nrm = jnp.sqrt(jnp.maximum(pm[f0], jnp.asarray(1e-30, pm.dtype)))
+                dr = jnp.take(at[0], f0, axis=1) / nrm  # (mid,) span state
+                di = jnp.take(at[1], f0, axis=1) / nrm
+                # rem[r] = sum_m a[m, r] * conj(dest[m])
+                rr = jnp.einsum("mr,m->r", at[0], dr) + jnp.einsum("mr,m->r", at[1], di)
+                ri = jnp.einsum("mr,m->r", at[1], dr) - jnp.einsum("mr,m->r", at[0], di)
+                rem = jnp.stack([rr, ri]).astype(dtype)
+                if not with_dest:
+                    return rem
+                return rem, jnp.stack([dr, di])
+
+            outs = (rem_sh, NamedSharding(self.mesh, P())) if with_dest else rem_sh
+            return jax.jit(f, out_shardings=outs)
+
+        return _program(self._key("decompose", n, start, length, with_dest), build)
+
+    def _host_split(self, start, length, perm):
+        """Host-staged split fallback (mesh shrink / tiny results)."""
+        planes = np.asarray(jax.device_get(self._state), dtype=np.float64)
+        n = self.qubit_count
+        hi, mid, lo = 1 << (n - start - length), 1 << length, 1 << start
+        a = (planes[0] + 1j * planes[1]).reshape(hi, mid, lo)
+        if perm is not None:
+            rem = a[:, perm, :].reshape(-1)
+            dest = None
+        else:
+            # same convention as _p_decompose: dominant rest branch
+            at = a.transpose(1, 0, 2).reshape(mid, hi * lo)
+            pm = (np.abs(at) ** 2).sum(axis=0)
+            f0 = int(np.argmax(pm))
+            dest = at[:, f0] / math.sqrt(max(pm[f0], 1e-300))
+            rem = np.einsum("mr,m->r", at, np.conj(dest))
         nrm = np.linalg.norm(rem)
         if nrm > 0:
-            rem /= nrm
+            rem = rem / nrm
         self._state = jax.device_put(
-            gk.to_planes(rem, self.dtype), self._sharding_for(self.qubit_count - length)
-        )
+            gk.to_planes(rem, self.dtype), self._sharding_for(n - length))
         return dest
 
+    def _k_decompose(self, start, length) -> np.ndarray:
+        n = self.qubit_count
+        if self._mesh_would_change(n - length):
+            return self._host_split(start, length, None)
+        rem, dest = self._p_decompose(n, start, length, True)(self._state)
+        self._state = rem
+        d = np.asarray(jax.device_get(dest), dtype=np.float64)
+        vec = d[0] + 1j * d[1]
+        nrm = np.linalg.norm(vec)
+        return vec / nrm if nrm > 0 else vec
+
+    def _p_dispose_perm(self, n, start, length):
+        dtype = self.dtype
+        rem_sh = self.sharding
+
+        def build():
+            hi = 1 << (n - start - length)
+            mid = 1 << length
+            lo = 1 << start
+
+            def f(s, perm):
+                a = s.reshape(2, hi, mid, lo)
+                rem = jnp.take(a, perm, axis=2).reshape(2, -1)
+                nrm2 = jnp.sum(rem[0] ** 2 + rem[1] ** 2)
+                rem = rem / jnp.sqrt(jnp.maximum(nrm2, jnp.asarray(1e-30, nrm2.dtype)))
+                return rem.astype(dtype)
+
+            return jax.jit(f, out_shardings=rem_sh)
+
+        return _program(self._key("disposeperm", n, start, length), build)
+
     def _k_dispose(self, start, length, perm) -> None:
-        planes = gk.to_planes(self.GetQuantumState(), self.dtype)
-        m = gk.split_matrix(planes, self.qubit_count, start, length)
-        m = np.asarray(m, dtype=np.float64)
-        full = m[0] + 1j * m[1]
+        n = self.qubit_count
+        if self._mesh_would_change(n - length):
+            self._host_split(start, length, perm)
+            return
         if perm is not None:
-            rem = full[:, perm]
+            self._state = self._p_dispose_perm(n, start, length)(self._state, perm)
         else:
-            row_norms = (np.abs(full) ** 2).sum(axis=1)
-            r0 = int(np.argmax(row_norms))
-            dest = full[r0] / math.sqrt(row_norms[r0])
-            rem = full @ np.conj(dest)
-        nrm = np.linalg.norm(rem)
-        if nrm > 0:
-            rem /= nrm
-        self._state = jax.device_put(
-            gk.to_planes(rem, self.dtype), self._sharding_for(self.qubit_count - length)
-        )
+            self._state = self._p_decompose(n, start, length, False)(self._state)
+
+    def _p_allocate(self, n, start, length):
+        dtype = self.dtype
+        sh = self.sharding
+
+        def build():
+            hi, lo = 1 << (n - start), 1 << start
+
+            def f(s):
+                a = s.reshape(2, hi, lo)
+                out = jnp.zeros((2, hi, 1 << length, lo), dtype=dtype)
+                out = out.at[:, :, 0, :].set(a)
+                return out.reshape(2, -1)
+
+            return jax.jit(f, out_shardings=sh)
+
+        return _program(self._key("allocate", n, start, length), build)
 
     def _k_allocate(self, start, length) -> None:
-        st = self.GetQuantumState()
-        new = np.zeros(1 << (self.qubit_count + length), dtype=np.complex128)
-        from ..utils.bits import deposit_indices
-
-        pos = deposit_indices(self.qubit_count + length, list(range(start, start + length)))
-        new[pos] = st
-        self._state = jax.device_put(
-            gk.to_planes(new, self.dtype), self._sharding_for(self.qubit_count + length)
-        )
+        n = self.qubit_count
+        new_state = self._p_allocate(n, start, length)(self._state)
+        self._sharding_for(n + length)
+        self._state = new_state
 
     def _sharding_for(self, qubit_count):
-        """Sharding for a (possibly shrunken) width; drops pages when the
-        ket gets smaller than the page count (reference: SeparateEngines/
-        CombineEngines page-count rebalance, src/qpager.cpp:316-367)."""
-        new_g = min(self.g_bits, max(qubit_count, 0))
+        """Sharding for a new width: drops pages when the ket gets
+        smaller than the page count and re-grows back to the
+        construction page count when it recovers (reference:
+        SeparateEngines/CombineEngines page-count rebalance,
+        src/qpager.cpp:316-367)."""
+        new_g = self._desired_g(qubit_count)
         if new_g != self.g_bits:
-            devs = list(self.mesh.devices.flat)[: 1 << new_g]
+            devs = self._all_devices[: 1 << new_g]
             self.n_pages = 1 << new_g
             self.g_bits = new_g
             self.mesh = Mesh(np.array(devs), ("pages",))
             self.sharding = NamedSharding(self.mesh, P(None, "pages"))
+        if qubit_count - self.g_bits > 30:
+            raise MemoryError(
+                f"QPager page width {qubit_count - self.g_bits} exceeds a "
+                "single shard; add devices/pages or stack QUnit above")
         return self.sharding
 
     # ------------------------------------------------------------------

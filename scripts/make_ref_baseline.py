@@ -100,11 +100,17 @@ def main():
         for section, width, avg_s in parse_sections(res.stdout):
             if width < args.min_qubits:
                 continue
-            dense = section.startswith("QEngine")
-            key = wl if dense else f"{wl}_optimal"
-            src = ("reference-cpp QEngineCPU dense (cmake -DENABLE_OPENCL=OFF, "
-                   "Release, 1-core container)" if dense else
-                   "reference-cpp QUnit optimal stack (CPU-only build)")
+            # map only the two sections we can attribute; other layer
+            # stacks (QPager/QBdt/...) would collapse into one key
+            if section == "QEngine -> CPU":
+                key = wl
+                src = ("reference-cpp QEngineCPU dense (cmake "
+                       "-DENABLE_OPENCL=OFF, Release, 1-core container)")
+            elif section == "QUnit -> QEngine -> CPU":
+                key = f"{wl}_optimal"
+                src = "reference-cpp QUnit optimal stack (CPU-only build)"
+            else:
+                continue
             data.setdefault(key, {})[str(width)] = {
                 "seconds": avg_s,
                 "source": src,

@@ -205,3 +205,70 @@ def test_pager_dispose_below_page_count():
 def test_pager_rejects_more_pages_than_devices():
     with pytest.raises(ValueError):
         QPager(10, n_pages=16)
+
+
+def test_structural_ops_stay_on_device():
+    """Compose/Decompose/Dispose/Allocate must not stage the full ket
+    through the host when the page mesh survives (reference rebalances
+    pages device-side, src/qpager.cpp:316-367)."""
+    n = 7
+    o, p = make_pair(n, seed=9, n_pages=4)
+    for eng in (o, p):
+        random_circuit(eng, QrackRandom(321), 25, n)
+    # trip-wire: any full-ket host read during the structural ops fails
+    def boom():
+        raise AssertionError("full-ket host staging in structural op")
+    p.GetQuantumState = lambda: boom()
+    o2 = QEngineCPU(2, rng=QrackRandom(5), rand_global_phase=False)
+    p2 = QEngineCPU(2, rng=QrackRandom(5), rand_global_phase=False)
+    for eng in (o2, p2):
+        eng.H(0)
+        eng.T(0)
+        eng.CNOT(0, 1)
+    o.Compose(o2)
+    p.Compose(p2)
+    del p.__dict__["GetQuantumState"]
+    assert_match(o, p)
+    # dispose a definite qubit (allocate + dispose round trip)
+    for eng in (o, p):
+        eng.Allocate(3, 1)
+    p.GetQuantumState = lambda: boom()
+    for eng in (o, p):
+        eng.Dispose(3, 1, 0)
+    del p.__dict__["GetQuantumState"]
+    assert_match(o, p)
+
+
+def test_decompose_separable_span_device_side():
+    n = 8
+    o, p = make_pair(n, seed=11, n_pages=4)
+    for eng in (o, p):
+        # entangle {0,1,2} and {3,4} separately, leave the rest cached
+        eng.H(0); eng.CNOT(0, 1); eng.T(1); eng.CNOT(1, 2)
+        eng.H(3); eng.CNOT(3, 4); eng.S(4)
+    od = QEngineCPU(2, rng=QrackRandom(1), rand_global_phase=False)
+    pd = QEngineCPU(2, rng=QrackRandom(1), rand_global_phase=False)
+    p.GetQuantumState = (lambda: (_ for _ in ()).throw(AssertionError("host staging")))
+    o.Decompose(3, od)
+    p.Decompose(3, pd)
+    del p.__dict__["GetQuantumState"]
+    assert_match(o, p)
+    np.testing.assert_allclose(pd.GetQuantumState(), od.GetQuantumState(), atol=3e-5)
+
+
+def test_mesh_shrinks_and_regrows():
+    n = 5
+    o, p = make_pair(n, seed=13, n_pages=4)
+    for eng in (o, p):
+        random_circuit(eng, QrackRandom(77), 15, n)
+        eng.Dispose(1, 4)   # width 1 < page count: mesh shrinks
+    assert p.g_bits < 2
+    assert_match(o, p)
+    o2 = QEngineCPU(5, rng=QrackRandom(2), rand_global_phase=False)
+    p2 = QEngineCPU(5, rng=QrackRandom(2), rand_global_phase=False)
+    for eng in (o2, p2):
+        random_circuit(eng, QrackRandom(88), 10, 5)
+    o.Compose(o2)
+    p.Compose(p2)
+    assert p.g_bits == 2  # mesh re-grew to construction page count
+    assert_match(o, p)
