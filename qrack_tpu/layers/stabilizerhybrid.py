@@ -7,14 +7,28 @@ gate triage, :435-500 SwitchToEngine): Clifford ops run on the CHP
 tableau; non-Clifford single-qubit gates are buffered as per-qubit
 "MpsShards" (pending 2x2 matrices, reference: include/mpsshard.hpp) and
 folded back into the tableau whenever the accumulated shard becomes
-Clifford again; anything that can't stay on the tableau materializes
-the ket into a dense engine (CPU/TPU/pager via the supplied factory)
-and forwards from then on. The reference's reverse T-gadget ancilla
-path is a later-round extension.
+Clifford again.
+
+When a blocked non-Clifford *phase* shard would force materialization,
+the **reverse T-gadget** (reference: src/qstabilizerhybrid.cpp:206-239,
+after Pashayan et al., PRX Quantum 3, 020361 App. A) instead moves the
+magic onto a fresh tableau ancilla: CNOT(target -> ancilla), the phase
+shard re-attaches to the ancilla, then H composes into that shard.  The
+tableau stays Clifford with the non-Clifford content buffered on
+ancillae; materialization post-selects every ancilla to |0> (each
+outcome has probability exactly 1/2, so forcing is always legal) and
+disposes it.  The Clifford part of each phase angle is flushed into the
+tableau first (S/Z/IS sectors — reference FractionalRzAngleWithFlush,
+include/qstabilizerhybrid.hpp:228-259), and residual angles below
+QRACK_NONCLIFFORD_ROUNDING_THRESHOLD are rounded away with the fidelity
+loss tracked in log_fidelity (reference: README.md:112).
 """
 
 from __future__ import annotations
 
+import cmath
+import math
+import os
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -40,6 +54,32 @@ class QStabilizerHybrid(QInterface):
             qubit_count, init_state=init_state, rng=self.rng.spawn())
         self.engine = None
         self.shards: List[Optional[np.ndarray]] = [None] * qubit_count
+        # reverse T-gadget state: ancillae live at tableau positions
+        # [qubit_count, qubit_count + _anc)
+        self._anc = 0
+        self.use_t_gadget = os.environ.get("QRACK_DISABLE_T_INJECTION", "0") == "0"
+        self.max_ancilla = int(os.environ.get(
+            "QRACK_MAX_ANCILLA_QB", str(max(4, 28 - qubit_count))))
+        self.ncrp = self.config.nonclifford_rounding_threshold
+        self.log_fidelity = 0.0
+
+    def SetTInjection(self, flag: bool) -> None:
+        self.use_t_gadget = bool(flag)
+
+    def GetTInjection(self) -> bool:
+        return self.use_t_gadget
+
+    def SetNcrp(self, ncrp: float) -> None:
+        self.ncrp = float(ncrp)
+
+    def GetUnitaryFidelity(self) -> float:
+        base = math.exp(self.log_fidelity)
+        if self.engine is not None:
+            return base * self.engine.GetUnitaryFidelity()
+        return base
+
+    def ResetUnitaryFidelity(self) -> None:
+        self.log_fidelity = 0.0
 
     # ------------------------------------------------------------------
 
@@ -52,22 +92,40 @@ class QStabilizerHybrid(QInterface):
 
     def SwitchToEngine(self) -> None:
         """Materialize the tableau ket + pending shards into a dense
-        engine (reference: src/qstabilizerhybrid.cpp:435)."""
+        engine (reference: src/qstabilizerhybrid.cpp:435).  Gadget
+        ancillae are post-selected to |0> (probability exactly 1/2
+        each) and disposed, which applies their buffered magic to the
+        logical qubits."""
         if self.engine is not None:
             return
+        width = self.qubit_count + self._anc
         ket = self.stab.GetQuantumState()
-        self.engine = self._factory(self.qubit_count, rng=self.rng.spawn(),
+        self.engine = self._factory(width, rng=self.rng.spawn(),
                                     **self._eng_kwargs)
         self.engine.SetQuantumState(ket)
         for q, s in enumerate(self.shards):
             if s is not None:
                 self.engine.Mtrx(s, q)
+        while self._anc:
+            a = self.qubit_count + self._anc - 1
+            self.engine.ForceM(a, False, do_force=True)
+            self.engine.Dispose(a, 1, 0)
+            self._anc -= 1
         self.stab = None
         self.shards = [None] * self.qubit_count
 
+    def _invert_to_phase(self, q: int) -> None:
+        """Convert an anti-diagonal shard D.X into tableau X + phase
+        shard D (reference: InvertBuffer)."""
+        s = self.shards[q]
+        self.stab.X(q)
+        self.shards[q] = np.array([[s[0, 1], 0.0], [0.0, s[1, 0]]],
+                                  dtype=np.complex128)
+
     def _flush_shard(self, q: int) -> None:
-        """Fold a pending shard into the tableau if it turned Clifford,
-        else switch to the engine."""
+        """Fold a pending shard into the tableau if it turned Clifford;
+        move a non-Clifford phase (or invert) shard onto a gadget
+        ancilla; only a general (non-monomial) shard forces the engine."""
         s = self.shards[q]
         if s is None:
             return
@@ -75,8 +133,52 @@ class QStabilizerHybrid(QInterface):
         if seq is not None:
             self.stab._apply_seq(seq, q)
             self.shards[q] = None
+            return
+        if mat.is_invert(s):
+            self._invert_to_phase(q)
+            s = self.shards[q]
+        if mat.is_phase(s) and self.use_t_gadget and self._anc < self.max_ancilla:
+            self._t_gadget(q)
         else:
             self.SwitchToEngine()
+
+    def _t_gadget(self, q: int) -> None:
+        """Reverse T-injection (reference: src/qstabilizerhybrid.cpp:
+        206-239): flush the Clifford sector of the shard's phase angle
+        into the tableau, then defer the residual onto a fresh ancilla."""
+        s = self.shards[q]
+        self.shards[q] = None
+        angle = cmath.phase(s[1, 1] / s[0, 0]) % (2.0 * math.pi)
+        sector = round(angle / (math.pi / 2.0))
+        if sector % 4 == 1:
+            self.stab.S(q)
+        elif sector % 4 == 2:
+            self.stab.Z(q)
+        elif sector % 4 == 3:
+            self.stab.IS(q)
+        angle -= sector * (math.pi / 2.0)
+        half = angle / 2.0
+        # the applied ops are diag(1, i^sector) . diag(e^{-ih}, e^{ih});
+        # the shard's leftover global phase folds into the tableau's
+        # phase_offset so exact-amplitude parity survives the gadget
+        self.stab.phase_offset *= complex(s[0, 0]) * cmath.exp(1j * half)
+        if abs(half) <= 1e-12:
+            return
+        if abs(math.sin(half)) <= self.ncrp:
+            # near-Clifford rounding: drop the residual, track fidelity
+            # (reference: QRACK_NONCLIFFORD_ROUNDING_THRESHOLD)
+            self.log_fidelity += math.log(max(math.cos(half) ** 2, 1e-300))
+            self.stab.phase_offset *= cmath.exp(-1j * half)
+            return
+        a = self.stab.qubit_count
+        self.stab.Allocate(a, 1)
+        self._anc += 1
+        self.stab.CNOT(q, a)
+        gate = np.array([[cmath.exp(-1j * half), 0.0],
+                         [0.0, cmath.exp(1j * half)]], dtype=np.complex128)
+        # ancilla shard = H . P(residual): buffered magic, never blocked
+        # because ancillae receive no further gates
+        self.shards.append(np.asarray(mat.H2, dtype=np.complex128) @ gate)
 
     # ------------------------------------------------------------------
     # gate primitive
@@ -94,8 +196,24 @@ class QStabilizerHybrid(QInterface):
             if seq is not None:
                 self.stab._apply_seq(seq, target)
                 self.shards[target] = None
-            else:
+                return
+            if mat.is_phase(new) or mat.is_invert(new):
                 self.shards[target] = new
+                return
+            # composed shard went general: salvage the buffered monomial
+            # part before it poisons the qubit (reference gadgets the
+            # phase shard the moment a non-commuting gate arrives,
+            # src/qstabilizerhybrid.cpp:206-239)
+            if cur is not None and self.use_t_gadget and self._anc < self.max_ancilla:
+                # stored shards are never Clifford (they'd have folded at
+                # store time), so only the monomial salvage paths exist
+                if mat.is_invert(cur):
+                    self._invert_to_phase(target)
+                    cur = self.shards[target]
+                if mat.is_phase(cur):
+                    self._t_gadget(target)
+                    return self.MCMtrxPerm((), m, target, 0)
+            self.shards[target] = new
             return
         # controlled op: shards on participants must be resolved first
         if self.shards[target] is not None and mat.is_phase(m) and mat.is_phase(self.shards[target]):
@@ -132,6 +250,13 @@ class QStabilizerHybrid(QInterface):
                 return float(abs(amp[1]) ** 2)
             self.SwitchToEngine()
             return self.engine.Prob(q)
+        if self._anc and not self.stab.IsSeparable(q):
+            # entangled with buffered ancilla magic: the raw tableau
+            # marginal is wrong — materialize a clone to measure
+            # (reference: src/qstabilizerhybrid.cpp:1435-1443)
+            c = self.Clone()
+            c.SwitchToEngine()
+            return c.engine.Prob(q)
         return self.stab.Prob(q)
 
     def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
@@ -139,6 +264,11 @@ class QStabilizerHybrid(QInterface):
             return self.engine.ForceM(q, result, do_force, do_apply)
         s = self.shards[q]
         if s is not None and not mat.is_phase(s):
+            self.SwitchToEngine()
+            return self.engine.ForceM(q, result, do_force, do_apply)
+        if self._anc and not self.stab.IsSeparable(q):
+            # collapse must follow the true (ancilla-weighted)
+            # distribution (reference: src/qstabilizerhybrid.cpp:1560-1570)
             self.SwitchToEngine()
             return self.engine.ForceM(q, result, do_force, do_apply)
         if s is not None and do_apply:
@@ -159,15 +289,28 @@ class QStabilizerHybrid(QInterface):
             start = self.qubit_count
         inner = other
         if isinstance(other, QStabilizerHybrid):
-            if self.engine is None and other.engine is None:
+            self.log_fidelity += other.log_fidelity
+            if self.engine is None and other.engine is None and start == self.qubit_count:
+                n, a_cnt = self.qubit_count, self._anc
+                m = other.qubit_count
                 try:
-                    res = self.stab.Compose(other.stab, start)
-                    self.shards = (self.shards[:start] + list(other.shards)
-                                   + self.shards[start:])
-                    self.qubit_count += other.qubit_count
-                    return res
+                    # append at the tableau end, then relabel columns so
+                    # the layout stays [logical | ancillae]:
+                    # [n][A][m][B] -> [n][m][A][B]
+                    self.stab.Compose(other.stab, self.stab.qubit_count)
+                    perm = (list(range(n))
+                            + list(range(n + a_cnt, n + a_cnt + m))
+                            + list(range(n, n + a_cnt))
+                            + list(range(n + a_cnt + m, n + a_cnt + m + other._anc)))
+                    self.stab.PermuteQubits(perm)
+                    self.shards = (self.shards[:n] + list(other.shards[:m])
+                                   + self.shards[n:]
+                                   + list(other.shards[m:]))
+                    self._anc = a_cnt + other._anc
+                    self.qubit_count += m
+                    return start
                 except (NotImplementedError, CliffordError):
-                    pass  # mid-insertion etc.: fall through to the engine
+                    pass  # fall through to the engine
             self.SwitchToEngine()
             other_clone = other.Clone()
             other_clone.SwitchToEngine()
@@ -220,10 +363,16 @@ class QStabilizerHybrid(QInterface):
             if start != self.qubit_count:
                 self.SwitchToEngine()
             else:
-                res = self.stab.Allocate(start, length)
-                self.shards += [None] * length
+                n, a_cnt = self.qubit_count, self._anc
+                self.stab.Allocate(self.stab.qubit_count, length)
+                if a_cnt:
+                    perm = (list(range(n))
+                            + list(range(n + a_cnt, n + a_cnt + length))
+                            + list(range(n, n + a_cnt)))
+                    self.stab.PermuteQubits(perm)
+                self.shards[n:n] = [None] * length
                 self.qubit_count += length
-                return res
+                return start
         res = self.engine.Allocate(start, length)
         self.shards[start:start] = [None] * length
         self.qubit_count = self.engine.qubit_count
@@ -232,7 +381,7 @@ class QStabilizerHybrid(QInterface):
     def GetQuantumState(self) -> np.ndarray:
         if self.engine is not None:
             return self.engine.GetQuantumState()
-        if all(s is None for s in self.shards):
+        if self._anc == 0 and all(s is None for s in self.shards):
             return self.stab.GetQuantumState()
         c = self.Clone()
         c.SwitchToEngine()
@@ -241,6 +390,8 @@ class QStabilizerHybrid(QInterface):
     def SetQuantumState(self, state) -> None:
         state = np.asarray(state, dtype=np.complex128).reshape(-1)
         self.shards = [None] * self.qubit_count
+        self._anc = 0
+        self.log_fidelity = 0.0
         try:
             stab = QStabilizer(self.qubit_count, rng=self.rng.spawn())
             stab.SetQuantumState(state)
@@ -256,7 +407,7 @@ class QStabilizerHybrid(QInterface):
     def GetAmplitude(self, perm: int) -> complex:
         if self.engine is not None:
             return self.engine.GetAmplitude(perm)
-        if all(s is None for s in self.shards):
+        if self._anc == 0 and all(s is None for s in self.shards):
             return self.stab.GetAmplitude(perm)
         return complex(self.GetQuantumState()[perm])
 
@@ -269,6 +420,8 @@ class QStabilizerHybrid(QInterface):
         self.engine = None
         self.stab = QStabilizer(self.qubit_count, init_state=perm, rng=self.rng.spawn())
         self.shards = [None] * self.qubit_count
+        self._anc = 0
+        self.log_fidelity = 0.0
 
     def Clone(self) -> "QStabilizerHybrid":
         c = QStabilizerHybrid(self.qubit_count, engine_factory=self._factory,
@@ -279,6 +432,11 @@ class QStabilizerHybrid(QInterface):
         else:
             c.stab = self.stab.Clone()
         c.shards = [None if s is None else s.copy() for s in self.shards]
+        c._anc = self._anc
+        c.use_t_gadget = self.use_t_gadget
+        c.max_ancilla = self.max_ancilla
+        c.ncrp = self.ncrp
+        c.log_fidelity = self.log_fidelity
         return c
 
     def SumSqrDiff(self, other) -> float:
