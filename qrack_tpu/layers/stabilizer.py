@@ -280,6 +280,24 @@ class QStabilizer(QInterface):
         (reference: QStabilizer::IsSeparable)."""
         return self.IsSeparableZ(q) or self.IsSeparableX(q) or self.IsSeparableY(q)
 
+    def EntangledWith(self, q: int, lo: int, hi: int) -> bool:
+        """Conservative check: does qubit q share a generator-support
+        connected component with any qubit in [lo, hi)?  False means q
+        is provably uncorrelated with that range; True may
+        over-approximate (generator support can exceed entanglement)."""
+        n = self.qubit_count
+        sup = (self.x[n:2 * n] | self.z[n:2 * n]).astype(bool)  # (n gens, n qubits)
+        comp = np.zeros(n, dtype=bool)
+        comp[q] = True
+        while True:
+            rows = sup[:, comp].any(axis=1)
+            new = sup[rows].any(axis=0) | comp
+            if new[lo:hi].any():
+                return True
+            if (new == comp).all():
+                return False
+            comp = new
+
     # ------------------------------------------------------------------
     # measurement (reference: src/qstabilizer.cpp:1999 ForceM)
     # ------------------------------------------------------------------

@@ -58,8 +58,12 @@ class QStabilizerHybrid(QInterface):
         # [qubit_count, qubit_count + _anc)
         self._anc = 0
         self.use_t_gadget = os.environ.get("QRACK_DISABLE_T_INJECTION", "0") == "0"
+        # budget so that an eventual SwitchToEngine materialization
+        # (2^(n + ancillae)) stays within practical dense-engine size
+        # (reference ties maxAncillaCount to maxEngineQubitCount,
+        # src/qstabilizerhybrid.cpp:83-91)
         self.max_ancilla = int(os.environ.get(
-            "QRACK_MAX_ANCILLA_QB", str(max(4, 28 - qubit_count))))
+            "QRACK_MAX_ANCILLA_QB", str(max(4, 20 - qubit_count))))
         self.ncrp = self.config.nonclifford_rounding_threshold
         self.log_fidelity = 0.0
 
@@ -250,7 +254,7 @@ class QStabilizerHybrid(QInterface):
                 return float(abs(amp[1]) ** 2)
             self.SwitchToEngine()
             return self.engine.Prob(q)
-        if self._anc and not self.stab.IsSeparable(q):
+        if self._anc and self._touches_ancilla(q):
             # entangled with buffered ancilla magic: the raw tableau
             # marginal is wrong — materialize a clone to measure
             # (reference: src/qstabilizerhybrid.cpp:1435-1443)
@@ -259,6 +263,13 @@ class QStabilizerHybrid(QInterface):
             return c.engine.Prob(q)
         return self.stab.Prob(q)
 
+    def _touches_ancilla(self, q: int) -> bool:
+        """Is q (transitively) in the same generator-support component as
+        any gadget ancilla?  Unitaries on other qubits never change q's
+        marginal — only the ancillae's post-selected shards can."""
+        n = self.qubit_count
+        return self.stab.EntangledWith(q, n, n + self._anc)
+
     def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
         if self.engine is not None:
             return self.engine.ForceM(q, result, do_force, do_apply)
@@ -266,7 +277,7 @@ class QStabilizerHybrid(QInterface):
         if s is not None and not mat.is_phase(s):
             self.SwitchToEngine()
             return self.engine.ForceM(q, result, do_force, do_apply)
-        if self._anc and not self.stab.IsSeparable(q):
+        if self._anc and self._touches_ancilla(q):
             # collapse must follow the true (ancilla-weighted)
             # distribution (reference: src/qstabilizerhybrid.cpp:1560-1570)
             self.SwitchToEngine()

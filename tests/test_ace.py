@@ -1,0 +1,112 @@
+"""ACE (approximate circuit elision) + fidelity guard in QUnit.
+
+Validates the re-design of the reference's beyond-memory behavior
+(reference: include/qunit.hpp:107-146 CheckFidelity/ElideCz,
+src/qunit.cpp:455-477 entangle budget, :1823-1840 + :2715 shadows;
+README.md:118): over-cap entangling gates degrade gracefully with
+tracked fidelity < 1 when the guard is disabled, and raise an advisory
+error (not a raw MemoryError) when it is active."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.qunit import QUnit
+from qrack_tpu.utils.rng import QrackRandom
+
+
+def factory(n, **kw):
+    kw.setdefault("rand_global_phase", False)
+    return QEngineCPU(n, **kw)
+
+
+def make(n, seed=1, ace=False, cap=None, **kw):
+    q = QUnit(n, unit_factory=factory, rng=QrackRandom(seed),
+              rand_global_phase=False, **kw)
+    q.is_ace = ace
+    q.SetAceMaxQubits(cap)
+    return q
+
+
+def entangle_pairs(q, n):
+    for i in range(0, n - 1, 2):
+        q.H(i)
+        q.CNOT(i, i + 1)
+
+
+def test_guard_raises_advisory_not_memoryerror():
+    q = make(6, cap=4)
+    entangle_pairs(q, 6)          # 2q units: within cap
+    q.CNOT(1, 2)                  # merges to 4: still within cap
+    with pytest.raises(RuntimeError, match="ACE"):
+        q.CNOT(3, 4)              # 4 + 2 = 6 > 4
+
+
+def test_cnot_above_guard_fires_at_flush_time():
+    # buffered CZ links don't entangle; the guard fires when a
+    # non-diagonal op forces the merge
+    q = make(6, cap=3)
+    entangle_pairs(q, 6)
+    q.CZ(1, 2)                    # buffered: no entanglement, no error
+    assert q.GetUnitaryFidelity() == 1.0
+    with pytest.raises(RuntimeError, match="ACE"):
+        q.CNOT(1, 2)
+
+
+def test_ace_elides_cz_with_fidelity_cost():
+    q = make(6, ace=True, cap=3)
+    entangle_pairs(q, 6)
+    q.CZ(1, 2)                    # buffered
+    q.CNOT(1, 2)                  # forces link flush -> merge fails -> elide
+    assert q.GetUnitaryFidelity() < 1.0
+    # the state is still normalized and factored within the cap
+    sizes = [s.unit.qubit_count for s in q.shards if s.unit is not None]
+    assert max(sizes) <= 3
+    probs = q.GetProbs()
+    assert np.isclose(probs.sum(), 1.0, atol=1e-6)
+
+
+def test_ace_cnot_shadow_conditions_on_likely_control():
+    # control prepared near |1>: the shadow applies X to the target
+    q = make(4, ace=True, cap=1)
+    q.X(0)
+    q.H(1)                        # make it non-definite so trim can't elide
+    q.RY(0.2, 1)
+    q.CNOT(1, 2)
+    # cap=1 forbids ALL merges: the gate became a shadow
+    assert all(s.cached for s in q.shards)
+    assert q.GetUnitaryFidelity() < 1.0
+
+
+def test_max_alloc_mb_enforced(monkeypatch):
+    q = make(8)
+    monkeypatch.setattr(q.config, "max_alloc_mb", 1)  # 1 MB => <= 16 qubits... 2^16*16B
+    # 1 MB allows 2^16 amplitudes: merging 8 qubits is fine
+    entangle_pairs(q, 8)
+    q2 = make(30)
+    monkeypatch.setattr(q2.config, "max_alloc_mb", 1)
+    for i in range(0, 30, 2):
+        q2.H(i)
+        q2.CNOT(i, i + 1)
+    # merging 15 two-qubit units would need 2^30 * 16 B >> 1 MB
+    with pytest.raises(RuntimeError, match="ACE"):
+        for i in range(1, 29, 2):
+            q2.CNOT(i, i + 1)
+
+
+def test_ace_full_circuit_stays_bounded():
+    # a deep circuit over 12 qubits with a 4-qubit cap never exceeds the
+    # cap and keeps a sane normalized state
+    n = 12
+    q = make(n, ace=True, cap=4)
+    rng = QrackRandom(5)
+    for layer in range(6):
+        for i in range(n):
+            q.H(i) if rng.randint(0, 2) else q.T(i)
+        for i in range(layer % 2, n - 1, 2):
+            q.CNOT(i, i + 1)
+    sizes = [s.unit.qubit_count for s in q.shards if s.unit is not None]
+    assert not sizes or max(sizes) <= 4
+    assert 0.0 < q.GetUnitaryFidelity() <= 1.0
+    r = q.MAll()
+    assert 0 <= r < (1 << n)

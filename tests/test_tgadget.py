@@ -50,6 +50,7 @@ def test_gadget_fires_instead_of_materializing():
 def test_t_depth_chain_stays_on_tableau():
     n = 5
     q = make(n, 7)
+    q.max_ancilla = 24
     o = oracle(n, 7)
     for eng in (q, o):
         for layer in range(4):
@@ -211,3 +212,21 @@ def test_compose_propagates_rounding_fidelity():
     assert b.GetUnitaryFidelity() < 1.0
     a.Compose(b)
     assert a.GetUnitaryFidelity() == pytest.approx(b.GetUnitaryFidelity(), abs=1e-12)
+
+
+def test_clifford_pair_measures_on_tableau_despite_unrelated_ancilla():
+    # a Bell pair untouched by any magic must stay tableau-measurable
+    # even while an unrelated gadget ancilla exists elsewhere
+    n = 30
+    q = make(n, 9)
+    q.H(0)
+    q.CNOT(0, 1)          # pure Clifford Bell pair
+    q.H(5)
+    q.T(5)
+    q.CNOT(6, 5)          # gadget ancilla entangled with {5, 6} only
+    assert q._anc == 1
+    assert q.Prob(0) == pytest.approx(0.5, abs=1e-12)
+    b0 = q.M(0)
+    b1 = q.M(1)
+    assert b0 == b1       # Bell correlation preserved
+    assert q.engine is None  # never materialized (would be 2^31)
