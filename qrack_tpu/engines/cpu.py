@@ -95,7 +95,7 @@ class QEngineCPU(QEngine):
                     acc = acc + m[r_i, c_i] * amps[c_i]
             self._state[row] = acc
 
-    def _k_gather(self, src_fn) -> None:
+    def _k_gather(self, src_fn, split=None) -> None:
         self._state = self._state[src_fn(self._idx)]
 
     def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:

@@ -71,7 +71,12 @@ class QEngine(QInterface):
     def XMask(self, mask: int) -> None:
         if not mask:
             return
-        self._k_gather(lambda idx: idx ^ mask)
+        self._k_gather(
+            lambda idx: idx ^ mask,
+            split=(("xmask", mask),
+                   lambda xp, pid, lidx, L: alu.xor_split(
+                       xp, pid, lidx, L, mask & ((1 << L) - 1), mask >> L),
+                   ()))
 
     def ZMask(self, mask: int) -> None:
         if not mask:
@@ -210,7 +215,12 @@ class QEngine(QInterface):
         to_add &= (1 << length) - 1
         if not to_add:
             return
-        self._k_gather(lambda idx: alu.inc_src(self._xp, idx, to_add, start, length))
+        self._k_gather(
+            lambda idx: alu.inc_src(self._xp, idx, to_add, start, length),
+            split=(("inc", start, length),
+                   lambda xp, pid, lidx, L, ta: alu.inc_src_split(
+                       xp, pid, lidx, L, ta, start, length),
+                   (to_add,)))
 
     def CINC(self, to_add: int, start: int, length: int, controls) -> None:
         controls = tuple(controls)
@@ -223,8 +233,11 @@ class QEngine(QInterface):
             return
         perm = (1 << len(controls)) - 1
         self._k_gather(
-            lambda idx: alu.inc_src(self._xp, idx, to_add, start, length, controls, perm)
-        )
+            lambda idx: alu.inc_src(self._xp, idx, to_add, start, length, controls, perm),
+            split=(("cinc", start, length, controls),
+                   lambda xp, pid, lidx, L, ta: alu.inc_src_split(
+                       xp, pid, lidx, L, ta, start, length, controls, perm),
+                   (to_add,)))
 
     def INCDECC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
         if not length:
@@ -232,12 +245,22 @@ class QEngine(QInterface):
         to_add &= (1 << (length + 1)) - 1
         if not to_add:
             return
-        self._k_gather(lambda idx: alu.incdecc_src(self._xp, idx, to_add, start, length, carry_index))
+        self._k_gather(
+            lambda idx: alu.incdecc_src(self._xp, idx, to_add, start, length, carry_index),
+            split=(("incdecc", start, length, carry_index),
+                   lambda xp, pid, lidx, L, ta: alu.incdecc_src_split(
+                       xp, pid, lidx, L, ta, start, length, carry_index),
+                   (to_add,)))
 
     def INCS(self, to_add: int, start: int, length: int, overflow_index: int) -> None:
         if not length:
             return
-        self._k_gather(lambda idx: alu.incs_src(self._xp, idx, to_add, start, length, overflow_index))
+        self._k_gather(
+            lambda idx: alu.incs_src(self._xp, idx, to_add, start, length, overflow_index),
+            split=(("incs", start, length, overflow_index),
+                   lambda xp, pid, lidx, L, ta: alu.incs_src_split(
+                       xp, pid, lidx, L, ta, start, length, overflow_index),
+                   (to_add & ((1 << length) - 1),)))
 
     def INCDECSC(self, to_add: int, start: int, length: int, *flags) -> None:
         if not length:
@@ -249,13 +272,23 @@ class QEngine(QInterface):
         self._k_gather(
             lambda idx: alu.incdecsc_src(
                 self._xp, idx, to_add, start, length, carry_index, overflow_index
-            )
-        )
+            ),
+            split=(("incdecsc", start, length, carry_index, overflow_index),
+                   lambda xp, pid, lidx, L, ta: alu.incdecsc_src_split(
+                       xp, pid, lidx, L, ta, start, length, carry_index,
+                       overflow_index),
+                   (to_add & ((1 << (length + 1)) - 1),)))
 
     def ROL(self, shift: int, start: int, length: int) -> None:
         if length < 2 or not (shift % length):
             return
-        self._k_gather(lambda idx: alu.rol_src(self._xp, idx, shift % length, start, length))
+        sh = shift % length
+        self._k_gather(
+            lambda idx: alu.rol_src(self._xp, idx, sh, start, length),
+            split=(("rol", sh, start, length),
+                   lambda xp, pid, lidx, L: alu.rol_src_split(
+                       xp, pid, lidx, L, sh, start, length),
+                   ()))
 
     def ROR(self, shift: int, start: int, length: int) -> None:
         self.ROL(length - (shift % length) if length else 0, start, length)
@@ -300,11 +333,43 @@ class QEngine(QInterface):
         sel = (src & cmask) == cmask
         self._k_out_of_place(src[sel], dst[sel] | cmask, cmask)
 
+    # -- width-generic (split-index) modular out-of-place family --------
+    # (the pair/scatter path builds full-size host index arrays; past
+    #  int32 widths the gather form with an exact host-built residue
+    #  table runs device-side at any width)
+
+    def _modnout_wide(self, res_fn, in_start, length, out_start, ol,
+                      inverse, key, controls=()):
+        import numpy as _np
+
+        # exact Python-int arithmetic on the host; values < 2^ol fit int32
+        table = _np.asarray([res_fn(v) for v in range(1 << length)],
+                            dtype=_np.int32)
+        perm_all = (1 << len(controls)) - 1
+
+        def body(xp, pid, lidx, L, tbl):
+            sp, sl, keep = alu.modnout_gather_split(
+                xp, pid, lidx, L, tbl, in_start, length, out_start, ol,
+                inverse=inverse)
+            if controls:
+                ok = alu.split_ctrl_match(xp, pid, lidx, L, controls, perm_all)
+                sp = xp.where(ok, sp, pid)
+                sl = xp.where(ok, sl, lidx)
+                keep = keep | ~ok
+            return sp, sl, keep
+
+        self._k_gather(None, split=(key, body, (table,)))
+
     def _mod_out_len(self, mod_n: int) -> int:
         return log2(mod_n) if is_pow2(mod_n) else (log2(mod_n) + 1)
 
     def MULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
         ol = self._mod_out_len(mod_n)
+        if getattr(self, "_wide_alu", False):
+            return self._modnout_wide(
+                lambda v: (v * to_mul) % mod_n,
+                in_start, length, out_start, ol, False,
+                ("mulmod", in_start, length, out_start, ol))
         src, dst = alu.mulmodnout_pair(
             self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
         )
@@ -312,6 +377,11 @@ class QEngine(QInterface):
 
     def IMULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
         ol = self._mod_out_len(mod_n)
+        if getattr(self, "_wide_alu", False):
+            return self._modnout_wide(
+                lambda v: (v * to_mul) % mod_n,
+                in_start, length, out_start, ol, True,
+                ("imulmod", in_start, length, out_start, ol))
         src, dst = alu.mulmodnout_pair(
             self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
         )
@@ -322,6 +392,11 @@ class QEngine(QInterface):
         if not controls:
             return self.MULModNOut(to_mul, mod_n, in_start, out_start, length)
         ol = self._mod_out_len(mod_n)
+        if getattr(self, "_wide_alu", False):
+            return self._modnout_wide(
+                lambda v: (v * to_mul) % mod_n,
+                in_start, length, out_start, ol, False,
+                ("cmulmod", in_start, length, out_start, ol, controls), controls)
         src, dst = alu.mulmodnout_pair(
             self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
         )
@@ -332,6 +407,11 @@ class QEngine(QInterface):
         if not controls:
             return self.IMULModNOut(to_mul, mod_n, in_start, out_start, length)
         ol = self._mod_out_len(mod_n)
+        if getattr(self, "_wide_alu", False):
+            return self._modnout_wide(
+                lambda v: (v * to_mul) % mod_n,
+                in_start, length, out_start, ol, True,
+                ("cimulmod", in_start, length, out_start, ol, controls), controls)
         src, dst = alu.mulmodnout_pair(
             self._xp, self.qubit_count, to_mul, mod_n, in_start, out_start, length, ol
         )
@@ -339,6 +419,11 @@ class QEngine(QInterface):
 
     def POWModNOut(self, base: int, mod_n: int, in_start, out_start, length) -> None:
         ol = self._mod_out_len(mod_n)
+        if getattr(self, "_wide_alu", False):
+            return self._modnout_wide(
+                lambda v: pow(base, v, mod_n),
+                in_start, length, out_start, ol, False,
+                ("powmod", in_start, length, out_start, ol))
         src, dst = alu.powmodnout_pair(
             self._xp, self.qubit_count, base, mod_n, in_start, out_start, length, ol
         )
@@ -349,6 +434,11 @@ class QEngine(QInterface):
         if not controls:
             return self.POWModNOut(base, mod_n, in_start, out_start, length)
         ol = self._mod_out_len(mod_n)
+        if getattr(self, "_wide_alu", False):
+            return self._modnout_wide(
+                lambda v: pow(base, v, mod_n),
+                in_start, length, out_start, ol, False,
+                ("cpowmod", in_start, length, out_start, ol, controls), controls)
         src, dst = alu.powmodnout_pair(
             self._xp, self.qubit_count, base, mod_n, in_start, out_start, length, ol
         )
@@ -360,34 +450,49 @@ class QEngine(QInterface):
             # reference zeroes the value register before loading
             # (src/qengine/arithmetic.cpp IndexedLDA: SetReg(..., 0))
             self.SetReg(value_start, value_length, 0)
-        table = self._xp.asarray(np.asarray(values, dtype=np.int64))
+        tbl64 = np.asarray(values, dtype=np.int64)
         self._k_gather(
             lambda idx: alu.indexed_lda_src(
-                self._xp, idx, index_start, index_length, value_start, value_length, table
-            )
-        )
+                self._xp, idx, index_start, index_length, value_start,
+                value_length, self._xp.asarray(tbl64)
+            ),
+            split=(("ilda", index_start, index_length, value_start, value_length),
+                   lambda xp, pid, lidx, L, tbl: alu.indexed_lda_src_split(
+                       xp, pid, lidx, L, tbl, index_start, index_length,
+                       value_start, value_length),
+                   (tbl64.astype(np.int32),)))
         return int(round(self.ExpectationBitsAll(
             list(range(value_start, value_start + value_length)))))
 
     def IndexedADC(self, index_start, index_length, value_start, value_length, carry_index, values) -> int:
-        table = self._xp.asarray(np.asarray(values, dtype=np.int64))
+        tbl64 = np.asarray(values, dtype=np.int64)
         self._k_gather(
             lambda idx: alu.indexed_adc_src(
                 self._xp, idx, index_start, index_length, value_start, value_length,
-                carry_index, table, sign=1,
-            )
-        )
+                carry_index, self._xp.asarray(tbl64), sign=1,
+            ),
+            split=(("iadc", index_start, index_length, value_start, value_length,
+                    carry_index),
+                   lambda xp, pid, lidx, L, tbl: alu.indexed_adc_src_split(
+                       xp, pid, lidx, L, tbl, index_start, index_length,
+                       value_start, value_length, carry_index, sign=1),
+                   (tbl64.astype(np.int32),)))
         return int(round(self.ExpectationBitsAll(
             list(range(value_start, value_start + value_length)))))
 
     def IndexedSBC(self, index_start, index_length, value_start, value_length, carry_index, values) -> int:
-        table = self._xp.asarray(np.asarray(values, dtype=np.int64))
+        tbl64 = np.asarray(values, dtype=np.int64)
         self._k_gather(
             lambda idx: alu.indexed_adc_src(
                 self._xp, idx, index_start, index_length, value_start, value_length,
-                carry_index, table, sign=-1,
-            )
-        )
+                carry_index, self._xp.asarray(tbl64), sign=-1,
+            ),
+            split=(("isbc", index_start, index_length, value_start, value_length,
+                    carry_index),
+                   lambda xp, pid, lidx, L, tbl: alu.indexed_adc_src_split(
+                       xp, pid, lidx, L, tbl, index_start, index_length,
+                       value_start, value_length, carry_index, sign=-1),
+                   (tbl64.astype(np.int32),)))
         return int(round(self.ExpectationBitsAll(
             list(range(value_start, value_start + value_length)))))
 
@@ -396,7 +501,12 @@ class QEngine(QInterface):
         inv = np.empty_like(tbl)
         inv[tbl] = np.arange(tbl.shape[0], dtype=np.int64)
         inv_dev = self._xp.asarray(inv)
-        self._k_gather(lambda idx: alu.hash_src(self._xp, idx, start, length, inv_dev))
+        self._k_gather(
+            lambda idx: alu.hash_src(self._xp, idx, start, length, inv_dev),
+            split=(("hash", start, length),
+                   lambda xp, pid, lidx, L, tbl: alu.hash_src_split(
+                       xp, pid, lidx, L, tbl, start, length),
+                   (inv,)))
 
     def PhaseFlipIfLess(self, greater_perm: int, start: int, length: int) -> None:
         self._k_phase_fn(
@@ -507,7 +617,7 @@ class QEngine(QInterface):
     def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
         raise NotImplementedError
 
-    def _k_gather(self, src_fn) -> None:
+    def _k_gather(self, src_fn, split=None) -> None:
         raise NotImplementedError
 
     def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:

@@ -142,7 +142,7 @@ class QEngineTPU(QEngine):
         ])
         self._state = _j_uc_2x2(self._state, mps, self.qubit_count, target, tuple(controls))
 
-    def _k_gather(self, src_fn) -> None:
+    def _k_gather(self, src_fn, split=None) -> None:
         src = src_fn(gk.iota_for(self._state))
         self._state = _j_gather(self._state, src)
 

@@ -198,3 +198,182 @@ def phase_flip_less_factor(xp, idx, greater_perm, start, length, flag_index=None
     if flag_index is not None:
         cond = cond & (((idx >> flag_index) & 1) == 1)
     return xp.where(cond, -1.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# split-index variants: (page, local) index pairs, exact past 31 qubits
+#
+# The pager's global index i = (pid << L) | lidx never materializes: all
+# register/bit algebra runs on the two int32 halves (reference ALU
+# kernels are width-generic the same way via bitCapIntOcl lanes,
+# qheader_alu.cl:13-810). Register/field lengths stay <= 31 bits (the
+# register VALUE fits an int32 lane even when the ket index cannot);
+# carry/overflow-extended ops need one extra lane bit, so those cap at
+# length <= 30.
+# ---------------------------------------------------------------------------
+
+
+def split_ctrl_match(xp, pid, lidx, L, controls, perm):
+    cm_lo = cv_lo = cm_hi = cv_hi = 0
+    for j, c in enumerate(controls):
+        want = (perm >> j) & 1
+        if c < L:
+            cm_lo |= 1 << c
+            cv_lo |= want << c
+        else:
+            cm_hi |= 1 << (c - L)
+            cv_hi |= want << (c - L)
+    return ((lidx & cm_lo) == cv_lo) & ((pid & cm_hi) == cv_hi)
+
+
+def split_reg_get(xp, pid, lidx, L, start, length):
+    if length > 31:
+        raise ValueError("register length > 31 bits exceeds int32 lanes")
+    if start >= L:
+        return (pid >> (start - L)) & ((1 << length) - 1)
+    lo_len = min(length, L - start)
+    v = (lidx >> start) & ((1 << lo_len) - 1)
+    if lo_len < length:
+        v = v | ((pid & ((1 << (length - lo_len)) - 1)) << lo_len)
+    return v
+
+
+def split_reg_set(xp, pid, lidx, L, start, length, value):
+    if start >= L:
+        m = ((1 << length) - 1) << (start - L)
+        return (pid & ~m) | ((value << (start - L)) & m), lidx
+    lo_len = min(length, L - start)
+    m_lo = ((1 << lo_len) - 1) << start
+    nl = (lidx & ~m_lo) | ((value & ((1 << lo_len) - 1)) << start)
+    if lo_len < length:
+        m_hi = (1 << (length - lo_len)) - 1
+        return (pid & ~m_hi) | ((value >> lo_len) & m_hi), nl
+    return pid, nl
+
+
+def split_bit_get(xp, pid, lidx, L, b):
+    if b < L:
+        return (lidx >> b) & 1
+    return (pid >> (b - L)) & 1
+
+
+def split_bit_set(xp, pid, lidx, L, b, bit):
+    if b < L:
+        return pid, (lidx & ~(1 << b)) | (bit << b)
+    return (pid & ~(1 << (b - L))) | (bit << (b - L)), lidx
+
+
+def xor_split(xp, pid, lidx, L, mask_lo, mask_hi):
+    return pid ^ mask_hi, lidx ^ mask_lo
+
+
+def inc_src_split(xp, pid, lidx, L, to_add, start, length, controls=(), perm=0):
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    src_v = (v - to_add) & ((1 << length) - 1)
+    sp, sl = split_reg_set(xp, pid, lidx, L, start, length, src_v)
+    if controls:
+        ok = split_ctrl_match(xp, pid, lidx, L, controls, perm)
+        sp = xp.where(ok, sp, pid)
+        sl = xp.where(ok, sl, lidx)
+    return sp, sl
+
+
+def incdecc_src_split(xp, pid, lidx, L, to_add, start, length, carry_index):
+    if length > 30:
+        raise ValueError("carry-extended register length > 30 exceeds int32 lanes")
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    c = split_bit_get(xp, pid, lidx, L, carry_index)
+    ext = v | (c << length)
+    src_ext = (ext - to_add) & ((1 << (length + 1)) - 1)
+    sp, sl = split_reg_set(xp, pid, lidx, L, start, length,
+                           src_ext & ((1 << length) - 1))
+    return split_bit_set(xp, sp, sl, L, carry_index, src_ext >> length)
+
+
+def incs_src_split(xp, pid, lidx, L, to_add, start, length, overflow_index):
+    if length > 30:
+        raise ValueError("overflow-extended register length > 30 exceeds int32 lanes")
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    src_v = (v - to_add) & ((1 << length) - 1)
+    ovf = _signed_ovf(xp, src_v, to_add, length)
+    sp, sl = split_reg_set(xp, pid, lidx, L, start, length, src_v)
+    ob = split_bit_get(xp, sp, sl, L, overflow_index)
+    fp, fl = split_bit_set(xp, sp, sl, L, overflow_index, ob ^ 1)
+    return xp.where(ovf, fp, sp), xp.where(ovf, fl, sl)
+
+
+def _signed_ovf(xp, src_v, to_add, length):
+    """Branchless signed-overflow window (to_add may be a traced
+    scalar): below the sign bit s the window is [s-a, s); at or above it
+    is [s, 2^len + s - a).  All bounds fit int32 for length <= 30."""
+    s = 1 << (length - 1)
+    lo = xp.where(to_add < s, s - to_add, s)
+    hi = xp.where(to_add < s, s, (1 << length) + s - to_add)
+    return (to_add != 0) & (src_v >= lo) & (src_v < hi)
+
+
+def rol_src_split(xp, pid, lidx, L, shift, start, length):
+    shift %= length
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    src_v = ((v >> shift) | (v << (length - shift))) & ((1 << length) - 1)
+    return split_reg_set(xp, pid, lidx, L, start, length, src_v)
+
+
+def hash_src_split(xp, pid, lidx, L, inverse_table, start, length):
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    return split_reg_set(xp, pid, lidx, L, start, length, inverse_table[v])
+
+
+def modnout_gather_split(xp, pid, lidx, L, res_table, in_start, length,
+                         out_start, out_length, inverse=False):
+    """Gather form of (I)MULModNOut / POWModNOut: `res_table[x]` is the
+    modular image of each input-register value (built with exact Python
+    ints on the host).  Forward: dst[x, out=res] = src[x, out=0] and
+    everything else zeroes; inverse undoes it."""
+    x = split_reg_get(xp, pid, lidx, L, in_start, length)
+    res = res_table[x]
+    out = split_reg_get(xp, pid, lidx, L, out_start, out_length)
+    if inverse:
+        keep = out == 0
+        sp, sl = split_reg_set(xp, pid, lidx, L, out_start, out_length, res)
+    else:
+        keep = out == res
+        sp, sl = split_reg_set(xp, pid, lidx, L, out_start, out_length,
+                               xp.zeros_like(out))
+    return sp, sl, keep
+
+
+def indexed_lda_src_split(xp, pid, lidx, L, table, index_start, index_length,
+                          value_start, value_length):
+    key = split_reg_get(xp, pid, lidx, L, index_start, index_length)
+    v = split_reg_get(xp, pid, lidx, L, value_start, value_length)
+    return split_reg_set(xp, pid, lidx, L, value_start, value_length,
+                         v ^ table[key])
+
+
+def indexed_adc_src_split(xp, pid, lidx, L, table, index_start, index_length,
+                          value_start, value_length, carry_index, sign=1):
+    if value_length > 30:
+        raise ValueError("carry-extended register length > 30 exceeds int32 lanes")
+    key = split_reg_get(xp, pid, lidx, L, index_start, index_length)
+    delta = table[key]
+    v = split_reg_get(xp, pid, lidx, L, value_start, value_length)
+    c = split_bit_get(xp, pid, lidx, L, carry_index)
+    ext = v | (c << value_length)
+    src_ext = (ext - sign * delta) & ((1 << (value_length + 1)) - 1)
+    sp, sl = split_reg_set(xp, pid, lidx, L, value_start, value_length,
+                           src_ext & ((1 << value_length) - 1))
+    return split_bit_set(xp, sp, sl, L, carry_index, src_ext >> value_length)
+
+
+def incdecsc_src_split(xp, pid, lidx, L, to_add, start, length, carry_index,
+                       overflow_index=None):
+    sp, sl = incdecc_src_split(xp, pid, lidx, L, to_add, start, length, carry_index)
+    if overflow_index is None:
+        return sp, sl
+    to_add_l = to_add & ((1 << length) - 1)
+    src_v = split_reg_get(xp, sp, sl, L, start, length)
+    ovf = _signed_ovf(xp, src_v, to_add_l, length)
+    ob = split_bit_get(xp, sp, sl, L, overflow_index)
+    fp, fl = split_bit_set(xp, sp, sl, L, overflow_index, ob ^ 1)
+    return xp.where(ovf, fp, sp), xp.where(ovf, fl, sl)

@@ -99,6 +99,33 @@ def apply_diag(local, d0re, d0im, d1re, d1im, tlo, thi, clo, cvlo, chi, cvhi):
     return gk.cmul(fre, fim, local)
 
 
+def gather_ring(local, npg: int, L: int, split_body, targs, keep_default=None):
+    """Cross-page basis permutation past int32 widths: new[(pid, i)] =
+    old[(sp, sl)] with (sp, sl) int32 halves from `split_body` (see
+    alu_kernels split variants).  Every page's block rotates once around
+    the ring; each page copies out the elements whose source page is the
+    block currently in hand.  Traffic: npg-1 page-volumes per device —
+    device-side and exact at any width (reference ALU kernels are
+    width-generic the same way, qheader_alu.cl:13-810)."""
+    pid = page_id()
+    lidx = gk.iota_for(local)
+    res = split_body(jnp, pid, lidx, L, *targs)
+    sp, sl = res[0], res[1]
+    keep = res[2] if len(res) > 2 else keep_default
+    out = jnp.zeros_like(local)
+    buf = local
+    perm = [(j, (j - 1) % npg) for j in range(npg)]
+    for k in range(npg):
+        holder = (pid + k) % npg  # original page id of the block in hand
+        take = sp == holder
+        if keep is not None:
+            take = take & keep
+        out = jnp.where(take, buf[:, sl], out)
+        if k + 1 < npg:
+            buf = jax.lax.ppermute(buf, "pages", perm)
+    return out
+
+
 def split_masks(mask: int, val: int, local_bits: int):
     lmask = mask & ((1 << local_bits) - 1)
     lval = val & ((1 << local_bits) - 1)

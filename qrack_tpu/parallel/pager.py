@@ -380,15 +380,45 @@ class QPager(QEngine):
 
         return _program(self._key("gather"), build)
 
-    def _k_gather(self, src_fn) -> None:
-        if self.qubit_count > 31:
+    # test/driver hook: force the width-generic split path at any size
+    force_wide_alu = False
+
+    @property
+    def _wide_alu(self) -> bool:
+        return self.force_wide_alu or self.qubit_count > 31
+
+    def _k_gather(self, src_fn, split=None) -> None:
+        if not self._wide_alu:
+            src = src_fn(self._global_iota())
+            self._state = self._p_gather()(self._state, src)
+            return
+        if split is None:
             raise NotImplementedError(
-                "cross-page basis permutations above 31 qubits are a "
-                "combine-and-op fallback (reference: CombineAndOp) — "
-                "pending carry-aware sharded ALU kernels"
-            )
-        src = src_fn(self._global_iota())
-        self._state = self._p_gather()(self._state, src)
+                "this basis permutation lacks a split-index form for "
+                ">31-qubit pagers (see alu_kernels split variants)")
+        self._gather_wide(split)
+
+    def _gather_wide(self, split) -> None:
+        """Run a split-index permutation as a ring-gather program
+        (reference width-generic ALU kernels, qheader_alu.cl:13-810)."""
+        from ..ops import sharded as shb
+
+        key, body, targs = split
+        L, npg, mesh = self.local_bits, self.n_pages, self.mesh
+
+        def build():
+            def f(local, *ta):
+                return shb.gather_ring(local, npg, L, body, ta)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(P(None, "pages"),) + (P(),) * len(targs),
+                out_specs=P(None, "pages"),
+            ), donate_argnums=(0,))
+
+        prog = _program(self._key("gatherw") + tuple(key), build)
+        args = [jnp.asarray(t, dtype=gk.IDX_DTYPE) for t in targs]
+        self._state = prog(self._state, *args)
 
     def _p_out_of_place(self, with_passthrough: bool):
         sh = self.sharding

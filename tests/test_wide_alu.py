@@ -1,0 +1,142 @@
+"""Width-generic (split-index) sharded ALU: the >31-qubit code path.
+
+A 34-qubit ket cannot exist in this container's RAM, so these tests
+force the pager's wide path (`force_wide_alu`) at small widths: the
+exact ring-gather + split-index programs that would run past int32
+widths execute against the 8-device CPU mesh and must match the host
+oracle bit-for-bit.  The split algebra itself never builds an index
+wider than 31 bits by construction (reference: width-generic ALU
+kernels, src/common/qheader_alu.cl:13-810)."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.parallel.pager import QPager
+from qrack_tpu.utils.rng import QrackRandom
+
+
+def make_pair(n, seed=3, n_pages=4):
+    o = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+    p = QPager(n, rng=QrackRandom(seed), rand_global_phase=False, n_pages=n_pages)
+    p.force_wide_alu = True
+    return o, p
+
+
+def prep(eng, n, seed=9):
+    rng = QrackRandom(seed)
+    for i in range(n):
+        if rng.randint(0, 2):
+            eng.H(i)
+        if rng.randint(0, 2):
+            eng.X(i)
+
+
+def assert_match(o, p, atol=3e-5):
+    np.testing.assert_allclose(p.GetQuantumState(), o.GetQuantumState(), atol=atol)
+
+
+def test_inc_across_pages():
+    n = 7  # registers spanning the 5-local/2-page boundary (4 pages)
+    for start, length in ((0, 7), (3, 4), (4, 3), (5, 2)):
+        o, p = make_pair(n)
+        for eng in (o, p):
+            prep(eng, n)
+            eng.INC(5, start, length)
+            eng.INC((1 << length) - 2, start, length)
+        assert_match(o, p)
+
+
+def test_cinc_and_incdecc():
+    n = 7
+    o, p = make_pair(n)
+    for eng in (o, p):
+        prep(eng, n)
+        eng.CINC(3, 1, 4, (6,))     # paged control bit
+        eng.INCDECC(9, 0, 5, 6)     # carry on a paged bit
+        eng.INCDECC(1, 2, 3, 5)
+    assert_match(o, p)
+
+
+def test_incs_and_incdecsc():
+    n = 7
+    o, p = make_pair(n)
+    for eng in (o, p):
+        prep(eng, n)
+        eng.INCS(5, 0, 4, 6)        # overflow flag on a paged bit
+        eng.INCDECSC(3, 0, 4, 5, 6)
+    assert_match(o, p)
+
+
+def test_rol_xmask_hash():
+    n = 7
+    o, p = make_pair(n)
+    table = list(np.random.RandomState(5).permutation(1 << 4))
+    for eng in (o, p):
+        prep(eng, n)
+        eng.ROL(3, 1, 6)            # rotation across the page boundary
+        eng.XMask(0b1100101)
+        eng.Hash(2, 4, table)
+    assert_match(o, p)
+
+
+def test_mulmodnout_family_across_pages():
+    n = 8
+    o, p = make_pair(n, n_pages=4)
+    for eng in (o, p):
+        eng.H(0)
+        eng.H(1)
+        eng.H(2)
+        eng.MULModNOut(5, 13, 0, 4, 3)     # out register spans pages
+    assert_match(o, p)
+    for eng in (o, p):
+        eng.IMULModNOut(5, 13, 0, 4, 3)    # and undo
+    assert_match(o, p)
+
+
+def test_powmodnout_and_controlled():
+    n = 8
+    o, p = make_pair(n, n_pages=4)
+    for eng in (o, p):
+        eng.H(0)
+        eng.H(1)
+        eng.X(3)
+        eng.POWModNOut(7, 15, 0, 4, 3)
+    assert_match(o, p)
+    o2, p2 = make_pair(n, n_pages=4)
+    for eng in (o2, p2):
+        eng.H(0)
+        eng.H(1)
+        eng.H(3)
+        eng.CMULModNOut(4, 9, 0, 4, 2, (3,))
+    assert_match(o2, p2)
+
+
+def test_indexed_lda_adc():
+    n = 8
+    values = [3, 1, 2, 0]
+    o, p = make_pair(n, n_pages=4)
+    for eng in (o, p):
+        eng.H(0)
+        eng.H(1)
+        eng.IndexedLDA(0, 2, 4, 2, values)   # value register on paged bits
+    assert_match(o, p)
+    o2, p2 = make_pair(n, n_pages=4)
+    for eng in (o2, p2):
+        eng.H(0)
+        eng.X(4)
+        eng.IndexedADC(0, 2, 3, 2, 7, [1, 2, 3, 0])
+    assert_match(o2, p2)
+
+
+def test_shor_order_finding_slice_wide_path():
+    # the Shor-critical sequence (H ladder, POWModNOut, IQFT) through
+    # the forced wide path
+    n = 9
+    o, p = make_pair(n, n_pages=4)
+    for eng in (o, p):
+        for i in range(4):
+            eng.H(i)
+        eng.POWModNOut(2, 15, 0, 4, 4)
+        eng.IQFT(0, 4)
+    assert_match(o, p)
