@@ -50,19 +50,41 @@ def _remaining() -> float:
 
 
 def _workload_key() -> str:
-    return f"rcs_d{DEPTH}" if WORKLOAD == "rcs" else "qft"
+    if WORKLOAD == "qft":
+        return "qft"
+    return f"{WORKLOAD}_d{DEPTH}"
 
 
 def _make_fn(width: int):
     from qrack_tpu.models import qft as qftm
 
-    if WORKLOAD not in ("qft", "rcs"):
+    if WORKLOAD not in ("qft", "rcs", "xeb"):
         raise ValueError(f"unknown QRACK_BENCH workload {WORKLOAD!r}")
-    if WORKLOAD == "rcs":
+    if WORKLOAD in ("rcs", "xeb"):
         from qrack_tpu.models import rcs as rcsm
 
         return rcsm.make_rcs_fn(width, DEPTH, seed=7), qftm.basis_planes(width, 0)
     return qftm.make_qft_fn(width), qftm.basis_planes(width, 12345 & ((1 << width) - 1))
+
+
+def _xeb_from_planes(planes, width: int, shots: int = 2000) -> float:
+    """Linear XEB from the final fused-RCS state: sample bitstrings from
+    the ideal distribution on device and score them against it
+    (reference: test_universal_circuit_digital_cross_entropy,
+    test/benchmarks.cpp:4560 — ideal-sim sampling gives fidelity ~1)."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(pl):
+        p = pl[0] * pl[0] + pl[1] * pl[1]
+        p = p / jnp.sum(p)
+        cdf = jnp.cumsum(p)
+        key = jax.random.PRNGKey(7)
+        u = jax.random.uniform(key, (shots,))
+        idx = jnp.searchsorted(cdf, u)
+        return (jnp.mean(p[idx]) * (1 << width)) - 1.0
+
+    return float(jax.jit(body)(planes))
 
 
 def _stats(times):
@@ -103,7 +125,10 @@ def _measure(width: int, samples: int):
         planes = fn(planes)
         planes.block_until_ready()
         times.append(time.perf_counter() - t0)
-    return _stats(times)
+    st = _stats(times)
+    if WORKLOAD == "xeb":
+        st["xeb_fidelity"] = round(_xeb_from_planes(planes, width), 6)
+    return st
 
 
 def _load_baseline():
@@ -143,7 +168,7 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     vs = (round(base_s / stats["avg"], 3)
           if (base_s and stats["avg"] > 0) else None)
     line = {
-        "metric": f"{_workload_key()}{width}_fused_wall{label_suffix}",
+        "metric": f"{_workload_key()}_w{width}_fused_wall{label_suffix}",
         "value": round(stats["avg"], 6),
         "unit": "s",
         "vs_baseline": vs,
