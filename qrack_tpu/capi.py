@@ -399,3 +399,772 @@ def SBC(sid, c, qi, ql, vi, vl, values) -> int:
 
 def Hash(sid, start, length, values) -> None:
     _sim(sid).Hash(start, length, values)
+
+
+# ---------------------------------------------------------------------------
+# error registry (reference: simulatorErrors[], get_error
+# src/pinvoke_api.cpp) — exceptions still raise; callers that want the C
+# convention can poll get_error after a guarded call
+# ---------------------------------------------------------------------------
+
+_ERRORS: Dict[int, int] = {}
+
+
+def get_error(sid: int) -> int:
+    return _ERRORS.get(sid, 0)
+
+
+
+
+# ---------------------------------------------------------------------------
+# additional lifecycle / registry (reference: init_count_pager /
+# init_count_stabilizer / Dump / DumpIds / set_device / set_concurrency)
+# ---------------------------------------------------------------------------
+
+def init_count_pager(q: int) -> int:
+    return init_count_type(q, sd=False, sh=False, pg=True, hy=False)
+
+
+def init_count_stabilizer(q: int) -> int:
+    return init_count_type(q, sd=False, sh=True, pg=False, hy=False)
+
+
+def Dump(sid) -> np.ndarray:
+    """Reference streams amplitudes through a callback; here the ket is
+    returned directly."""
+    return OutKet(sid)
+
+
+def DumpIds(sid) -> List[int]:
+    return list(range(num_qubits(sid)))
+
+
+def set_concurrency(sid, threads: int) -> None:
+    pass  # XLA owns scheduling; accepted for parity
+
+
+def set_device(sid, did: int) -> None:
+    _sim(sid).SetDevice(did)
+
+
+def set_device_list(sid, dids: Sequence[int]) -> None:
+    _sim(sid).SetDeviceList(list(dids))
+
+
+def random_choice(sid, probs: Sequence[float]) -> int:
+    p = np.asarray(probs, dtype=np.float64)
+    return int(_sim(sid).rng.choice_from_probs(p, 1)[0])
+
+
+# ---------------------------------------------------------------------------
+# gate-surface completion (reference: include/pinvoke_api.hpp:66-220)
+# ---------------------------------------------------------------------------
+
+def SX(sid, q): _sim(sid).SqrtX(q)
+def SY(sid, q): _sim(sid).SqrtY(q)
+def AdjSX(sid, q): _sim(sid).ISqrtX(q)
+def AdjSY(sid, q): _sim(sid).ISqrtY(q)
+
+
+def MACX(sid, c, q): _sim(sid).MACInvert(tuple(c), 1.0, 1.0, q)
+def MACY(sid, c, q): _sim(sid).MACInvert(tuple(c), -1j, 1j, q)
+def MACZ(sid, c, q): _sim(sid).MACPhase(tuple(c), 1.0, -1.0, q)
+def MACH(sid, c, q):
+    from . import matrices as mat
+
+    _sim(sid).MACMtrx(tuple(c), mat.H2, q)
+def MACS(sid, c, q): _sim(sid).MACPhase(tuple(c), 1.0, 1j, q)
+def MACT(sid, c, q):
+    import cmath, math
+
+    _sim(sid).MACPhase(tuple(c), 1.0, cmath.exp(0.25j * math.pi), q)
+def MACU(sid, c, q, theta, phi, lambd): _sim(sid).AntiCU(tuple(c), q, theta, phi, lambd)
+def MCAdjS(sid, c, q): _sim(sid).MCPhase(tuple(c), 1.0, -1j, q)
+def MACAdjS(sid, c, q): _sim(sid).MACPhase(tuple(c), 1.0, -1j, q)
+def MCAdjT(sid, c, q):
+    import cmath, math
+
+    _sim(sid).MCPhase(tuple(c), 1.0, cmath.exp(-0.25j * math.pi), q)
+def MACAdjT(sid, c, q):
+    import cmath, math
+
+    _sim(sid).MACPhase(tuple(c), 1.0, cmath.exp(-0.25j * math.pi), q)
+
+
+def PhaseRootN(sid, p: int, qubits: Sequence[int]) -> None:
+    for q in qubits:
+        _sim(sid).PhaseRootN(p, q)
+
+
+def Multiplex1Mtrx(sid, c, q, mtrxs) -> None:
+    """Uniformly-controlled 1q gate: one 2x2 per control permutation
+    (reference: Multiplex1Mtrx, include/pinvoke_api.hpp:179)."""
+    ms = np.asarray(mtrxs, dtype=np.complex128).reshape(-1, 2, 2)
+    _sim(sid).UniformlyControlledSingleBit(tuple(c), q, ms)
+
+
+def UCMtrx(sid, c, m, q, perm: int) -> None:
+    _sim(sid).MCMtrxPerm(tuple(c),
+                         np.asarray(m, dtype=np.complex128).reshape(2, 2), q, perm)
+
+
+def MX(sid, qubits: Sequence[int]) -> None:
+    mask = 0
+    for q in qubits:
+        mask |= 1 << q
+    _sim(sid).XMask(mask)
+
+
+def MY(sid, qubits: Sequence[int]) -> None:
+    mask = 0
+    for q in qubits:
+        mask |= 1 << q
+    _sim(sid).YMask(mask)
+
+
+def MZ(sid, qubits: Sequence[int]) -> None:
+    mask = 0
+    for q in qubits:
+        mask |= 1 << q
+    _sim(sid).ZMask(mask)
+
+
+def PhaseParity(sid, lambd: float, qubits: Sequence[int]) -> None:
+    mask = 0
+    for q in qubits:
+        mask |= 1 << q
+    _sim(sid).PhaseParity(lambd, mask)
+
+
+def Exp(sid, bases: Sequence[int], phi: float, qubits: Sequence[int]) -> None:
+    """e^{i phi P} for a Pauli string P (reference: Exp + ExpHelper,
+    src/pinvoke_api.cpp)."""
+    import cmath
+
+    q = _sim(sid)
+    mask = _transform_pauli_basis(q, bases, qubits)
+    if mask == 0:
+        ph = cmath.exp(1j * phi)
+        q.Phase(ph, ph, qubits[0] if qubits else 0)
+    else:
+        # e^{i phi Z..Z} applies e^{i phi} on even parity, e^{-i phi} odd
+        q.UniformParityRZ(mask, -phi)
+    _revert_pauli_basis(q, bases, qubits)
+
+
+def MCExp(sid, bases: Sequence[int], phi: float, controls: Sequence[int],
+          qubits: Sequence[int]) -> None:
+    import cmath
+
+    q = _sim(sid)
+    mask = _transform_pauli_basis(q, bases, qubits)
+    if mask == 0:
+        ph = cmath.exp(1j * phi)
+        q.MCPhase(tuple(controls), ph, ph, qubits[0] if qubits else 0)
+    else:
+        q.CUniformParityRZ(tuple(controls), mask, -phi)
+    _revert_pauli_basis(q, bases, qubits)
+
+
+def Normalize(sid) -> None:
+    _sim(sid).NormalizeState()
+
+
+def TimeEvolve(sid, t: float, hamiltonian) -> None:
+    """Trotterized evolution under HamiltonianOp terms (reference:
+    TimeEvolve, include/pinvoke_api.hpp:309)."""
+    _sim(sid).TimeEvolve(hamiltonian, t)
+
+
+# boolean logic completion
+def NAND(sid, qi1, qi2, qo): _sim(sid).NAND(qi1, qi2, qo)
+def NOR(sid, qi1, qi2, qo): _sim(sid).NOR(qi1, qi2, qo)
+def XNOR(sid, qi1, qi2, qo): _sim(sid).XNOR(qi1, qi2, qo)
+def CLAND(sid, ci, qi, qo): _sim(sid).CLAND(ci, qi, qo)
+def CLOR(sid, ci, qi, qo): _sim(sid).CLOR(ci, qi, qo)
+def CLXOR(sid, ci, qi, qo): _sim(sid).CLXOR(ci, qi, qo)
+def CLNAND(sid, ci, qi, qo): _sim(sid).CLNAND(ci, qi, qo)
+def CLNOR(sid, ci, qi, qo): _sim(sid).CLNOR(ci, qi, qo)
+def CLXNOR(sid, ci, qi, qo): _sim(sid).CLXNOR(ci, qi, qo)
+
+
+def ACSWAP(sid, c, q1, q2): _sim(sid).AntiCSwap(tuple(c), q1, q2)
+
+
+def QFT(sid, qubits: Sequence[int]) -> None:
+    _sim(sid).QFTR(list(qubits))
+
+
+def IQFT(sid, qubits: Sequence[int]) -> None:
+    _sim(sid).IQFTR(list(qubits))
+
+
+# ---------------------------------------------------------------------------
+# arithmetic completion (reference ALU block)
+# ---------------------------------------------------------------------------
+
+def SUBS(sid, a, s_index, start, length) -> None:
+    _sim(sid).DECS(a, start, length, s_index)
+
+
+def DIVN(sid, a, mod_n, in_start, out_start, length) -> None:
+    _sim(sid).IMULModNOut(a, mod_n, in_start, out_start, length)
+
+
+def MCADD(sid, a, c, start, length) -> None:
+    _sim(sid).CINC(a, start, length, tuple(c))
+
+
+def MCSUB(sid, a, c, start, length) -> None:
+    _sim(sid).CDEC(a, start, length, tuple(c))
+
+
+def MCMUL(sid, a, c, start, carry_start, length) -> None:
+    _sim(sid).CMUL(a, start, carry_start, length, tuple(c))
+
+
+def MCDIV(sid, a, c, start, carry_start, length) -> None:
+    _sim(sid).CDIV(a, start, carry_start, length, tuple(c))
+
+
+def MCMULN(sid, a, c, mod_n, in_start, out_start, length) -> None:
+    _sim(sid).CMULModNOut(a, mod_n, in_start, out_start, length, tuple(c))
+
+
+def MCDIVN(sid, a, c, mod_n, in_start, out_start, length) -> None:
+    _sim(sid).CIMULModNOut(a, mod_n, in_start, out_start, length, tuple(c))
+
+
+def MCPOWN(sid, a, c, mod_n, in_start, out_start, length) -> None:
+    _sim(sid).CPOWModNOut(a, mod_n, in_start, out_start, length, tuple(c))
+
+
+# ---------------------------------------------------------------------------
+# measurement / expectation / variance completion
+# (reference: include/pinvoke_api.hpp:61-117)
+# ---------------------------------------------------------------------------
+
+def MAllLong(sid) -> int:
+    return MAll(sid)  # Python ints are unbounded; same entry point
+
+
+def HighestProbAll(sid) -> int:
+    return int(np.argmax(_sim(sid).GetProbs()))
+
+
+def HighestProbAllN(sid, n: int) -> int:
+    return HighestProbAll(sid)  # >64-bit perms are plain Python ints here
+
+
+def ProbAll(sid, perm: int) -> float:
+    return _sim(sid).ProbAll(perm)
+
+
+def ProbRdm(sid, q) -> float:
+    return _sim(sid).ProbRdm(q)
+
+
+def PermutationProbRdm(sid, qubits: Sequence[int], perm: int, round_rz: bool) -> float:
+    mask = 0
+    val = 0
+    for j, qi in enumerate(qubits):
+        mask |= 1 << qi
+        if (perm >> j) & 1:
+            val |= 1 << qi
+    return _sim(sid).ProbMaskRdm(round_rz, mask, val)
+
+
+def PermutationExpectationRdm(sid, qubits: Sequence[int], round_rz: bool) -> float:
+    return _sim(sid).ExpectationBitsAllRdm(round_rz, list(qubits))
+
+
+def VarianceRdm(sid, qubits: Sequence[int], round_rz: bool = True) -> float:
+    return _sim(sid).VarianceBitsAllRdm(round_rz, list(qubits))
+
+
+def FactorizedExpectation(sid, qubits: Sequence[int], values: Sequence[int]) -> float:
+    return _sim(sid).ExpectationBitsFactorized(list(qubits), list(values))
+
+
+def FactorizedExpectationRdm(sid, qubits, values, round_rz: bool = True) -> float:
+    return FactorizedExpectation(sid, qubits, values)
+
+
+def FactorizedExpectationFp(sid, qubits: Sequence[int], weights: Sequence[float]) -> float:
+    return _sim(sid).ExpectationFloatsFactorized(list(qubits), list(weights))
+
+
+def FactorizedExpectationFpRdm(sid, qubits, weights, round_rz: bool = True) -> float:
+    return FactorizedExpectationFp(sid, qubits, weights)
+
+
+def FactorizedVariance(sid, qubits: Sequence[int], values: Sequence[int]) -> float:
+    return _sim(sid).VarianceBitsFactorized(list(qubits), list(values))
+
+
+def FactorizedVarianceRdm(sid, qubits, values, round_rz: bool = True) -> float:
+    return FactorizedVariance(sid, qubits, values)
+
+
+def FactorizedVarianceFp(sid, qubits: Sequence[int], weights: Sequence[float]) -> float:
+    return _sim(sid).VarianceFloatsFactorized(list(qubits), list(weights))
+
+
+def FactorizedVarianceFpRdm(sid, qubits, weights, round_rz: bool = True) -> float:
+    return FactorizedVarianceFp(sid, qubits, weights)
+
+
+def PauliExpectation(sid, bases: Sequence[int], qubits: Sequence[int]) -> float:
+    """<P> for a Pauli string: +-1 eigenvalues weighted by parity."""
+    q = _sim(sid)
+    mask = _transform_pauli_basis(q, bases, qubits)
+    p_odd = q.ProbParity(mask) if mask else 0.0
+    _revert_pauli_basis(q, bases, qubits)
+    return 1.0 - 2.0 * p_odd
+
+
+def PauliVariance(sid, bases: Sequence[int], qubits: Sequence[int]) -> float:
+    e = PauliExpectation(sid, bases, qubits)
+    return max(0.0, 1.0 - e * e)  # P^2 == I for any Pauli string
+
+
+def _rotated_stat(sid, qubits, mtrxs, eigenvals, variance: bool):
+    """Expectation/variance of per-qubit observables diagonalized by the
+    given 2x2 unitaries (reference: UnitaryExpectation/MatrixExpectation
+    family, include/pinvoke_api.hpp:86-104). Rotation is applied by
+    conjugation and undone afterwards."""
+    q = _sim(sid)
+    ms = [np.asarray(m, dtype=np.complex128).reshape(2, 2) for m in mtrxs]
+    for qi, m in zip(qubits, ms):
+        q.Mtrx(np.conj(m.T), qi)
+    try:
+        # reference defaults each qubit's observable to +1/-1 eigenvalues
+        # (ExpVarUnitaryAll, src/qinterface/qinterface.cpp:478)
+        w = ([1.0, -1.0] * len(list(qubits)) if eigenvals is None
+             else [float(v) for v in eigenvals])
+        stat = (q.VarianceFloatsFactorized(list(qubits), w) if variance
+                else q.ExpectationFloatsFactorized(list(qubits), w))
+    finally:
+        for qi, m in zip(qubits, ms):
+            q.Mtrx(m, qi)
+    return float(stat)
+
+
+def _u3(theta, phi, lambd):
+    import cmath, math
+
+    c, s = math.cos(theta / 2), math.sin(theta / 2)
+    return np.array([[c, -cmath.exp(1j * lambd) * s],
+                     [cmath.exp(1j * phi) * s, cmath.exp(1j * (phi + lambd)) * c]],
+                    dtype=np.complex128)
+
+
+def UnitaryExpectation(sid, qubits, angle_triples) -> float:
+    ms = [_u3(*t) for t in np.asarray(angle_triples, dtype=np.float64).reshape(-1, 3)]
+    return _rotated_stat(sid, qubits, ms, None, False)
+
+
+def UnitaryVariance(sid, qubits, angle_triples) -> float:
+    ms = [_u3(*t) for t in np.asarray(angle_triples, dtype=np.float64).reshape(-1, 3)]
+    return _rotated_stat(sid, qubits, ms, None, True)
+
+
+def UnitaryExpectationEigenVal(sid, qubits, angle_triples, eigenvals) -> float:
+    ms = [_u3(*t) for t in np.asarray(angle_triples, dtype=np.float64).reshape(-1, 3)]
+    return _rotated_stat(sid, qubits, ms, eigenvals, False)
+
+
+def UnitaryVarianceEigenVal(sid, qubits, angle_triples, eigenvals) -> float:
+    ms = [_u3(*t) for t in np.asarray(angle_triples, dtype=np.float64).reshape(-1, 3)]
+    return _rotated_stat(sid, qubits, ms, eigenvals, True)
+
+
+def MatrixExpectation(sid, qubits, mtrxs) -> float:
+    return _rotated_stat(sid, qubits, mtrxs, None, False)
+
+
+def MatrixVariance(sid, qubits, mtrxs) -> float:
+    return _rotated_stat(sid, qubits, mtrxs, None, True)
+
+
+def MatrixExpectationEigenVal(sid, qubits, mtrxs, eigenvals) -> float:
+    return _rotated_stat(sid, qubits, mtrxs, eigenvals, False)
+
+
+def MatrixVarianceEigenVal(sid, qubits, mtrxs, eigenvals) -> float:
+    return _rotated_stat(sid, qubits, mtrxs, eigenvals, True)
+
+
+def OutReducedDensityMatrix(sid, qubits: Sequence[int]) -> np.ndarray:
+    return np.asarray(_sim(sid).GetReducedDensityMatrix(list(qubits)))
+
+
+# ---------------------------------------------------------------------------
+# separability / approximation / config completion
+# (reference: include/pinvoke_api.hpp:287-310)
+# ---------------------------------------------------------------------------
+
+def Separate(sid, qubits: Sequence[int]) -> None:
+    _sim(sid).TrySeparate(tuple(qubits))
+
+
+def TrySeparateTol(sid, qubits: Sequence[int], tol: float) -> bool:
+    return _sim(sid).TrySeparate(tuple(qubits), tol)
+
+
+def AreFactorized(sid, qubits: Sequence[int]) -> bool:
+    """Non-destructive separability check via a probing clone."""
+    c = _sim(sid).Clone()
+    return bool(c.TrySeparate(tuple(qubits)))
+
+
+def SetSdrp(sid, sdrp: float) -> None:
+    _sim(sid).SetSdrp(sdrp)
+
+
+def SetNcrp(sid, ncrp: float) -> None:
+    _sim(sid).SetNcrp(ncrp)
+
+
+def SetSprp(sid, sprp: float) -> None:
+    q = _sim(sid)
+    if hasattr(q, "sep_threshold"):
+        q.sep_threshold = float(sprp)
+
+
+def SetStochastic(sid, flag: bool) -> None:
+    q = _sim(sid)
+    if hasattr(q, "SetStochastic"):
+        q.SetStochastic(flag)
+
+
+def SetUseExactNearClifford(sid, flag: bool) -> None:
+    q = _sim(sid)
+    if hasattr(q, "SetNcrp") and not flag:
+        pass  # stochastic rounding toggle accepted for parity
+
+
+def SetTInjection(sid, flag: bool) -> None:
+    _sim(sid).SetTInjection(flag)
+
+
+def SetNoiseParameter(sid, lam: float) -> None:
+    _sim(sid).SetNoiseParameter(lam)
+
+
+def SetAceMaxQb(sid, qb: int) -> None:
+    q = _sim(sid)
+    if hasattr(q, "SetAceMaxQubits"):
+        q.SetAceMaxQubits(qb)
+
+
+def SetSparseAceMaxMb(sid, mb: int) -> None:
+    from .config import get_config
+
+    get_config().max_alloc_mb = int(mb)
+
+
+def ResetUnitaryFidelity(sid) -> None:
+    _sim(sid).ResetUnitaryFidelity()
+
+
+def SetMajorQuadrant(sid, flag: bool) -> None:
+    q = _sim(sid)
+    if hasattr(q, "SetMajorQuadrant"):
+        q.SetMajorQuadrant(flag)
+    else:
+        _ERRORS[sid] = 1
+
+
+def SetQuadrant(sid, t: int, b: bool) -> None:
+    q = _sim(sid)
+    if hasattr(q, "SetQuadrant"):
+        q.SetQuadrant(t, b)
+    else:
+        _ERRORS[sid] = 1
+
+
+def FlipQuadrant(sid, t: int) -> None:
+    q = _sim(sid)
+    if hasattr(q, "FlipQuadrant"):
+        q.FlipQuadrant(t)
+    else:
+        _ERRORS[sid] = 1
+
+
+# ---------------------------------------------------------------------------
+# stabilizer serialization (reference: qstabilizer_out_to_file /
+# in_from_file, include/pinvoke_api.hpp:55-56)
+# ---------------------------------------------------------------------------
+
+def _find_stabilizer(sim):
+    from .layers.stabilizer import QStabilizer
+    from .layers.stabilizerhybrid import QStabilizerHybrid
+
+    if isinstance(sim, QStabilizer):
+        return sim
+    if isinstance(sim, QStabilizerHybrid):
+        if sim.engine is not None or sim._anc or any(
+                s is not None for s in sim.shards):
+            raise ValueError("simulator is not in a pure Clifford state")
+        return sim.stab
+    if hasattr(sim, "shards") and hasattr(sim, "_order_contiguous"):
+        # QUnit-family: entangle everything into one contiguous unit
+        unit, base = sim._order_contiguous(list(range(sim.qubit_count)))
+        if base != 0:
+            raise ValueError("unexpected unit layout")
+        return _find_stabilizer(unit)
+    raise ValueError(f"no tableau beneath {type(sim).__name__}")
+
+
+def qstabilizer_out_to_file(sid, path: str) -> None:
+    _find_stabilizer(_sim(sid)).SaveToFile(path)
+
+
+def qstabilizer_in_from_file(sid, path: str) -> None:
+    from .layers.stabilizer import QStabilizer
+    from .layers.stabilizerhybrid import QStabilizerHybrid
+
+    st = QStabilizer.LoadFromFile(path, rng=QrackRandom())
+    hy = QStabilizerHybrid(st.qubit_count, rng=QrackRandom())
+    hy.stab = st
+    _REGISTRY[sid] = hy
+
+
+# ---------------------------------------------------------------------------
+# QNeuron registry (reference: include/pinvoke_api.hpp qneuron block)
+# ---------------------------------------------------------------------------
+
+_NEURONS: Dict[int, object] = {}
+_NEURON_NEXT = [0]
+
+
+def _neuron(nid):
+    n = _NEURONS.get(nid)
+    if n is None:
+        raise KeyError(f"no neuron with id {nid}")
+    return n
+
+
+def init_qneuron(sid, controls: Sequence[int], target: int, activation_fn: int = 0,
+                 alpha: float = 1.0, tolerance: float = 1e-6) -> int:
+    from .qneuron import ActivationFn, QNeuron
+
+    with _LOCK:
+        nid = _NEURON_NEXT[0]
+        _NEURON_NEXT[0] += 1
+    _NEURONS[nid] = QNeuron(_sim(sid), tuple(controls), target,
+                            activation_fn=ActivationFn(activation_fn),
+                            alpha=alpha, tolerance=tolerance)
+    return nid
+
+
+def clone_qneuron(nid) -> int:
+    import copy
+
+    src = _neuron(nid)
+    with _LOCK:
+        new = _NEURON_NEXT[0]
+        _NEURON_NEXT[0] += 1
+    c = copy.copy(src)
+    c.angles = src.angles.copy()
+    _NEURONS[new] = c
+    return new
+
+
+def destroy_qneuron(nid) -> None:
+    _NEURONS.pop(nid, None)
+
+
+def set_qneuron_sim(nid, sid) -> None:
+    _neuron(nid).qreg = _sim(sid)
+
+
+def set_qneuron_angles(nid, angles: Sequence[float]) -> None:
+    n = _neuron(nid)
+    n.angles = np.asarray(angles, dtype=np.float64).copy()
+
+
+def get_qneuron_angles(nid) -> np.ndarray:
+    return _neuron(nid).angles.copy()
+
+
+def qneuron_predict(nid, expected: bool = True, reset_init: bool = True) -> float:
+    return _neuron(nid).Predict(expected, reset_init)
+
+
+def qneuron_unpredict(nid, expected: bool = True) -> float:
+    return _neuron(nid).Unpredict(expected)
+
+
+def qneuron_learn_cycle(nid, expected: bool = True) -> float:
+    return _neuron(nid).LearnCycle(expected)
+
+
+def qneuron_learn(nid, eta: float, expected: bool = True, reset_init: bool = True) -> None:
+    _neuron(nid).Learn(eta, expected, reset_init)
+
+
+def qneuron_learn_permutation(nid, eta: float, expected: bool = True,
+                              reset_init: bool = True) -> None:
+    _neuron(nid).LearnPermutation(eta, expected, reset_init)
+
+
+# ---------------------------------------------------------------------------
+# QCircuit registry (reference: include/pinvoke_api.hpp qcircuit block)
+# ---------------------------------------------------------------------------
+
+_CIRCUITS: Dict[int, object] = {}
+_CIRCUIT_NEXT = [0]
+
+
+def _circuit(cid):
+    c = _CIRCUITS.get(cid)
+    if c is None:
+        raise KeyError(f"no circuit with id {cid}")
+    return c
+
+
+def _new_cid(circ) -> int:
+    with _LOCK:
+        cid = _CIRCUIT_NEXT[0]
+        _CIRCUIT_NEXT[0] += 1
+    _CIRCUITS[cid] = circ
+    return cid
+
+
+def init_qcircuit(collapse: bool = True, clifford: bool = False) -> int:
+    from .layers.qcircuit import QCircuit
+
+    circ = QCircuit(0)
+    # recorded for parity: this IR holds no measurement gates, so the
+    # reference's collapse toggle has no observable effect here
+    circ.collapse = bool(collapse)
+    circ.clifford = bool(clifford)
+    return _new_cid(circ)
+
+
+def init_qcircuit_clone(cid) -> int:
+    return _new_cid(_circuit(cid).clone())
+
+
+def destroy_qcircuit(cid) -> None:
+    _CIRCUITS.pop(cid, None)
+
+
+def get_qcircuit_qubit_count(cid) -> int:
+    return _circuit(cid).qubit_count
+
+
+def qcircuit_swap(cid, q1, q2) -> None:
+    from . import matrices as mat
+
+    c = _circuit(cid)
+    # swap = 3 CNOTs in the IR (reference: QCircuit::Swap)
+    c.append_ctrl((q1,), q2, mat.X2, 1)
+    c.append_ctrl((q2,), q1, mat.X2, 1)
+    c.append_ctrl((q1,), q2, mat.X2, 1)
+
+
+def qcircuit_append_1qb(cid, m, q) -> None:
+    _circuit(cid).append_1q(q, np.asarray(m, dtype=np.complex128).reshape(2, 2))
+
+
+def qcircuit_append_mc(cid, m, controls: Sequence[int], q, perm: int) -> None:
+    _circuit(cid).append_ctrl(tuple(controls), q,
+                              np.asarray(m, dtype=np.complex128).reshape(2, 2), perm)
+
+
+def qcircuit_run(cid, sid) -> None:
+    _circuit(cid).Run(_sim(sid))
+
+
+def qcircuit_inverse(cid) -> int:
+    return _new_cid(_circuit(cid).Inverse())
+
+
+def qcircuit_past_light_cone(cid, qubits: Sequence[int]) -> int:
+    return _new_cid(_circuit(cid).PastLightCone(list(qubits)))
+
+
+def qcircuit_out_to_string(cid) -> str:
+    """Text form: width, gate count, then per gate: target, controls,
+    payload map (perm + 8 floats per 2x2)."""
+    c = _circuit(cid)
+    lines = [str(c.qubit_count), str(len(c.gates))]
+    for g in c.gates:
+        lines.append(str(g.target))
+        lines.append(" ".join(str(x) for x in g.controls))
+        lines.append(str(len(g.payloads)))
+        for perm, m in sorted(g.payloads.items()):
+            flat = np.asarray(m, dtype=np.complex128).reshape(-1)
+            nums = " ".join(f"{float(v.real)!r} {float(v.imag)!r}" for v in flat)
+            lines.append(f"{perm} {nums}")
+    return "\n".join(lines) + "\n"
+
+
+def qcircuit_out_to_string_length(cid) -> int:
+    return len(qcircuit_out_to_string(cid))
+
+
+def qcircuit_out_to_file(cid, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(qcircuit_out_to_string(cid))
+
+
+def qcircuit_in_from_file(cid, path: str) -> None:
+    from .layers.qcircuit import QCircuit, QCircuitGate
+
+    with open(path) as f:
+        toks = f.read().split("\n")
+    it = iter(toks)
+    n = int(next(it))
+    count = int(next(it))
+    circ = QCircuit(n)
+    for _ in range(count):
+        target = int(next(it))
+        cline = next(it).split()
+        controls = tuple(int(x) for x in cline)
+        payloads = {}
+        for _ in range(int(next(it))):
+            parts = next(it).split()
+            perm = int(parts[0])
+            vals = [float(x) for x in parts[1:]]
+            m = np.array([complex(vals[2 * i], vals[2 * i + 1]) for i in range(4)],
+                         dtype=np.complex128).reshape(2, 2)
+            payloads[perm] = m
+        circ.AppendGate(QCircuitGate(target, payloads, controls))
+    _CIRCUITS[cid] = circ
+
+
+def _install_error_tracking() -> None:
+    """Record the C error convention (reference: simulatorErrors[],
+    src/pinvoke_api.cpp catch blocks): any exception from a sid-keyed
+    call marks get_error(sid) before re-raising, so C/ctypes consumers
+    that poll get_error see failures the shim swallowed."""
+    import functools
+    import sys
+
+    mod = sys.modules[__name__]
+    skip = {"get_error", "init", "init_count", "init_count_type",
+            "init_count_pager", "init_count_stabilizer"}
+    for name, fn in list(vars(mod).items()):
+        if (name.startswith("_") or name in skip or not callable(fn)
+                or getattr(fn, "__module__", None) != __name__):
+            continue
+
+        def make(f):
+            @functools.wraps(f)
+            def wrapper(*args, **kwargs):
+                try:
+                    return f(*args, **kwargs)
+                except Exception:
+                    if args and isinstance(args[0], int):
+                        _ERRORS[args[0]] = 1
+                    raise
+            return wrapper
+
+        setattr(mod, name, make(fn))
+
+
+_install_error_tracking()

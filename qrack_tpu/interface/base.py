@@ -398,6 +398,9 @@ class QInterfaceBase:
     def ExpectationBitsAllRdm(self, round_rz: bool, bits: Sequence[int], offset: int = 0) -> float:
         return self.ExpectationBitsAll(bits, offset)
 
+    def VarianceBitsAllRdm(self, round_rz: bool, bits: Sequence[int], offset: int = 0) -> float:
+        return self.VarianceBitsAll(bits, offset)
+
     def GetReducedDensityMatrix(self, bits: Sequence[int]) -> np.ndarray:
         """Dense RDM over `bits` by partial trace
         (reference: src/qinterface/qinterface.cpp:886)."""

@@ -539,6 +539,41 @@ class QStabilizer(QInterface):
         self.Compose(fresh)
         return start
 
+    # -- tableau serialization (reference: qstabilizer_out_to_file /
+    #    in_from_file, include/pinvoke_api.hpp:55-56) --------------------
+
+    def SaveToFile(self, path: str) -> None:
+        """Write the tableau as text: header, width, phase offset, then
+        the x/z bit matrices row-major and the r sign vector."""
+        n = self.qubit_count
+        with open(path, "w") as f:
+            f.write("qrack_tpu-stabilizer v1\n")
+            f.write(f"{n}\n")
+            f.write(f"{float(self.phase_offset.real)!r} {float(self.phase_offset.imag)!r}\n")
+            for mat_ in (self.x, self.z):
+                for row in mat_[:2 * n]:
+                    f.write("".join("1" if b else "0" for b in row) + "\n")
+            f.write("".join(str(int(v) & 3) for v in self.r[:2 * n]) + "\n")
+
+    @classmethod
+    def LoadFromFile(cls, path: str, rng=None) -> "QStabilizer":
+        with open(path) as f:
+            header = f.readline().strip()
+            if header != "qrack_tpu-stabilizer v1":
+                raise ValueError(f"not a qrack_tpu stabilizer file: {header!r}")
+            n = int(f.readline())
+            pre, pim = (float(t) for t in f.readline().split())
+            st = cls(n, rng=rng)
+            st.phase_offset = complex(pre, pim)
+            for mat_ in (st.x, st.z):
+                for i in range(2 * n):
+                    row = f.readline().strip()
+                    mat_[i, :] = [c == "1" for c in row]
+            rline = f.readline().strip()
+            for i in range(2 * n):
+                st.r[i] = int(rline[i])
+        return st
+
     def IsSeparableZ(self, q: int) -> bool:
         """Deterministic Z measurement <=> Z eigenstate (reference:
         IsSeparableZ, include/qstabilizer.hpp)."""

@@ -1,0 +1,311 @@
+/* libqrack_capi: a real C ABI over the qrack_tpu flat API.
+ *
+ * Re-design of the reference's pinvoke surface as a thin embedding shim
+ * (reference: include/pinvoke_api.hpp:42-349, src/pinvoke_api.cpp): the
+ * exported symbols keep the reference's names and sid-based calling
+ * convention; each forwards into the Python registry
+ * (qrack_tpu.capi) through the CPython C API.  Consumers bind with
+ * ctypes/dlopen exactly like PyQrack binds the reference's .so.
+ *
+ * Build: python scripts/build_capi_shim.py  (gcc -shared -fPIC against
+ * libpython; see that script for the exact line).
+ *
+ * Threading: every entry takes the GIL via PyGILState_Ensure, so the
+ * shim is callable from any thread once qrack_capi_init() ran.
+ */
+
+#include <Python.h>
+#include <stdint.h>
+
+typedef uint64_t uintq;
+
+static PyObject* g_capi = NULL;
+
+static int ensure_init(void) {
+    if (g_capi) {
+        return 0;
+    }
+    int initialized_here = 0;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        initialized_here = 1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    /* honor PYTHONPATH even when embedded into an already-running
+     * interpreter (the ctypes-consumer case) */
+    PyRun_SimpleString(
+        "import os, sys\n"
+        "for _p in os.environ.get('PYTHONPATH', '').split(os.pathsep):\n"
+        "    if _p and _p not in sys.path:\n"
+        "        sys.path.insert(0, _p)\n");
+    PyObject* mod = PyImport_ImportModule("qrack_tpu.capi");
+    if (!mod) {
+        PyErr_Print();
+        PyGILState_Release(st);
+        return -1;
+    }
+    g_capi = mod;
+    PyGILState_Release(st);
+    if (initialized_here) {
+        /* Py_InitializeEx leaves this thread holding the GIL; release it
+         * so other threads' PyGILState_Ensure calls can proceed */
+        PyEval_SaveThread();
+    }
+    return 0;
+}
+
+/* Call capi.<name>(fmt-args); returns new ref or NULL (error printed). */
+static PyObject* capi_call(const char* name, const char* fmt, ...) {
+    if (ensure_init()) {
+        return NULL;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* fn = PyObject_GetAttrString(g_capi, name);
+    PyObject* ret = NULL;
+    if (fn) {
+        va_list va;
+        va_start(va, fmt);
+        PyObject* args = Py_VaBuildValue(fmt, va);
+        va_end(va);
+        if (args) {
+            ret = PyObject_CallObject(fn, args);
+            Py_DECREF(args);
+        }
+        Py_DECREF(fn);
+    }
+    if (!ret) {
+        PyErr_Print();
+    }
+    PyGILState_Release(st);
+    return ret;
+}
+
+static long long as_ll(PyObject* o, long long dflt) {
+    if (!o) {
+        return dflt;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    long long v = PyLong_Check(o) ? PyLong_AsLongLong(o)
+                : (PyObject_IsTrue(o) ? 1 : 0);
+    Py_DECREF(o);
+    PyGILState_Release(st);
+    return v;
+}
+
+static double as_d(PyObject* o, double dflt) {
+    if (!o) {
+        return dflt;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    double v = PyFloat_AsDouble(o);
+    Py_DECREF(o);
+    PyGILState_Release(st);
+    return v;
+}
+
+static PyObject* qlist(uintq n, const uintq* q) {
+    PyObject* l = PyList_New((Py_ssize_t)n);
+    for (uintq i = 0; i < n; ++i) {
+        PyList_SetItem(l, (Py_ssize_t)i, PyLong_FromUnsignedLongLong(q[i]));
+    }
+    return l;
+}
+
+static PyObject* dlist(uintq n, const double* v) {
+    PyObject* l = PyList_New((Py_ssize_t)n);
+    for (uintq i = 0; i < n; ++i) {
+        PyList_SetItem(l, (Py_ssize_t)i, PyFloat_FromDouble(v[i]));
+    }
+    return l;
+}
+
+/* ---- lifecycle ------------------------------------------------------ */
+
+int qrack_capi_init(void) { return ensure_init(); }
+
+uintq init_count_type(uintq q, int tn, int md, int sd, int sh, int bdt,
+                      int pg, int nw, int hy, int oc, int hp) {
+    return (uintq)as_ll(capi_call("init_count_type", "(Kiiiiiiiiii)",
+                                  q, tn, md, sd, sh, bdt, pg, nw, hy, oc, hp), 0);
+}
+
+uintq init_count(uintq q) { return (uintq)as_ll(capi_call("init_count", "(K)", q), 0); }
+uintq init(void) { return (uintq)as_ll(capi_call("init", "()"), 0); }
+uintq init_clone(uintq sid) { return (uintq)as_ll(capi_call("init_clone", "(K)", sid), 0); }
+void destroy(uintq sid) { Py_XDECREF(capi_call("destroy", "(K)", sid)); }
+void seed(uintq sid, uintq s) { Py_XDECREF(capi_call("seed", "(KK)", sid, s)); }
+uintq num_qubits(uintq sid) { return (uintq)as_ll(capi_call("num_qubits", "(K)", sid), 0); }
+void allocateQubit(uintq sid, uintq qid) { Py_XDECREF(capi_call("allocateQubit", "(KK)", sid, qid)); }
+int release(uintq sid, uintq qid) { return (int)as_ll(capi_call("release", "(KK)", sid, qid), 0); }
+int get_error(uintq sid) { return (int)as_ll(capi_call("get_error", "(K)", sid), 0); }
+
+/* ---- single-qubit gates -------------------------------------------- */
+
+#define GATE1(NAME) \
+    void NAME(uintq sid, uintq q) { Py_XDECREF(capi_call(#NAME, "(KK)", sid, q)); }
+
+GATE1(X) GATE1(Y) GATE1(Z) GATE1(H) GATE1(S) GATE1(T)
+GATE1(AdjS) GATE1(AdjT) GATE1(SX) GATE1(SY) GATE1(AdjSX) GATE1(AdjSY)
+
+void U(uintq sid, uintq q, double theta, double phi, double lambda) {
+    Py_XDECREF(capi_call("U", "(KKddd)", sid, q, theta, phi, lambda));
+}
+
+void Mtrx(uintq sid, double* m, uintq q) {
+    /* m: 8 doubles, row-major re/im pairs (reference convention) */
+    if (ensure_init()) return;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* l = PyList_New(4);
+    for (int i = 0; i < 4; ++i) {
+        PyList_SetItem(l, i, PyComplex_FromDoubles(m[2 * i], m[2 * i + 1]));
+    }
+    Py_XDECREF(capi_call("Mtrx", "(KNK)", sid, l, q));
+    PyGILState_Release(st);
+}
+
+void R(uintq sid, uintq basis, double phi, uintq q) {
+    Py_XDECREF(capi_call("R", "(KKdK)", sid, basis, phi, q));
+}
+
+/* ---- controlled gates ---------------------------------------------- */
+
+#define GATEMC(NAME) \
+    void NAME(uintq sid, uintq n, uintq* c, uintq q) { \
+        if (ensure_init()) return; \
+        PyGILState_STATE st = PyGILState_Ensure(); \
+        Py_XDECREF(capi_call(#NAME, "(KNK)", sid, qlist(n, c), q)); \
+        PyGILState_Release(st); \
+    }
+
+GATEMC(MCX) GATEMC(MCY) GATEMC(MCZ) GATEMC(MCH) GATEMC(MCS) GATEMC(MCT)
+GATEMC(MCAdjS) GATEMC(MCAdjT)
+GATEMC(MACX) GATEMC(MACY) GATEMC(MACZ) GATEMC(MACH) GATEMC(MACS) GATEMC(MACT)
+GATEMC(MACAdjS) GATEMC(MACAdjT)
+
+void MCMtrx(uintq sid, uintq n, uintq* c, double* m, uintq q) {
+    if (ensure_init()) return;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* l = PyList_New(4);
+    for (int i = 0; i < 4; ++i) {
+        PyList_SetItem(l, i, PyComplex_FromDoubles(m[2 * i], m[2 * i + 1]));
+    }
+    Py_XDECREF(capi_call("MCMtrx", "(KNNK)", sid, qlist(n, c), l, q));
+    PyGILState_Release(st);
+}
+
+void MCR(uintq sid, uintq basis, double phi, uintq n, uintq* c, uintq q) {
+    if (ensure_init()) return;
+    PyGILState_STATE st = PyGILState_Ensure();
+    Py_XDECREF(capi_call("MCR", "(KKdNK)", sid, basis, phi, qlist(n, c), q));
+    PyGILState_Release(st);
+}
+
+void SWAP(uintq sid, uintq q1, uintq q2) { Py_XDECREF(capi_call("SWAP", "(KKK)", sid, q1, q2)); }
+void ISWAP(uintq sid, uintq q1, uintq q2) { Py_XDECREF(capi_call("ISWAP", "(KKK)", sid, q1, q2)); }
+void FSim(uintq sid, double theta, double phi, uintq q1, uintq q2) {
+    Py_XDECREF(capi_call("FSim", "(KddKK)", sid, theta, phi, q1, q2));
+}
+void CSWAP(uintq sid, uintq n, uintq* c, uintq q1, uintq q2) {
+    if (ensure_init()) return;
+    PyGILState_STATE st = PyGILState_Ensure();
+    Py_XDECREF(capi_call("CSWAP", "(KNKK)", sid, qlist(n, c), q1, q2));
+    PyGILState_Release(st);
+}
+
+/* ---- measurement / observables ------------------------------------- */
+
+int M(uintq sid, uintq q) { return (int)as_ll(capi_call("M", "(KK)", sid, q), 0); }
+int ForceM(uintq sid, uintq q, int r) { return (int)as_ll(capi_call("ForceM", "(KKi)", sid, q, r), 0); }
+uintq MAll(uintq sid) { return (uintq)as_ll(capi_call("MAll", "(K)", sid), 0); }
+double Prob(uintq sid, uintq q) { return as_d(capi_call("Prob", "(KK)", sid, q), 0.0); }
+double ProbAll(uintq sid, uintq perm) { return as_d(capi_call("ProbAll", "(KK)", sid, perm), 0.0); }
+
+double PermutationExpectation(uintq sid, uintq n, uintq* q) {
+    if (ensure_init()) return 0.0;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* l = qlist(n, q);
+    PyGILState_Release(st);
+    return as_d(capi_call("PermutationExpectation", "(KN)", sid, l), 0.0);
+}
+
+double Variance(uintq sid, uintq n, uintq* q) {
+    if (ensure_init()) return 0.0;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* l = qlist(n, q);
+    PyGILState_Release(st);
+    return as_d(capi_call("Variance", "(KN)", sid, l), 0.0);
+}
+
+double GetUnitaryFidelity(uintq sid) {
+    return as_d(capi_call("GetUnitaryFidelity", "(K)", sid), 1.0);
+}
+
+uintq HighestProbAll(uintq sid) {
+    return (uintq)as_ll(capi_call("HighestProbAll", "(K)", sid), 0);
+}
+
+size_t random_choice(uintq sid, size_t n, double* p) {
+    if (ensure_init()) return 0;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* l = dlist(n, p);
+    PyGILState_Release(st);
+    return (size_t)as_ll(capi_call("random_choice", "(KN)", sid, l), 0);
+}
+
+void OutProbs(uintq sid, double* out, uintq len) {
+    PyObject* arr = capi_call("OutProbs", "(K)", sid);
+    if (!arr) {
+        return;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* seq = PySequence_Fast(arr, "probs");
+    if (seq) {
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+        for (Py_ssize_t i = 0; i < n && (uintq)i < len; ++i) {
+            out[i] = PyFloat_AsDouble(PySequence_Fast_GET_ITEM(seq, i));
+        }
+        Py_DECREF(seq);
+    }
+    Py_DECREF(arr);
+    PyGILState_Release(st);
+}
+
+/* ---- structure / ALU ------------------------------------------------ */
+
+uintq Compose(uintq sid1, uintq sid2) {
+    return (uintq)as_ll(capi_call("Compose", "(KK)", sid1, sid2), 0);
+}
+uintq Decompose(uintq sid, uintq start, uintq len) {
+    return (uintq)as_ll(capi_call("Decompose", "(KKK)", sid, start, len), 0);
+}
+void Dispose(uintq sid, uintq start, uintq len) {
+    Py_XDECREF(capi_call("Dispose", "(KKK)", sid, start, len));
+}
+
+void ADD(uintq sid, uintq a, uintq start, uintq len) { Py_XDECREF(capi_call("ADD", "(KKKK)", sid, a, start, len)); }
+void SUB(uintq sid, uintq a, uintq start, uintq len) { Py_XDECREF(capi_call("SUB", "(KKKK)", sid, a, start, len)); }
+void MUL(uintq sid, uintq a, uintq start, uintq cstart, uintq len) {
+    Py_XDECREF(capi_call("MUL", "(KKKKK)", sid, a, start, cstart, len));
+}
+void DIV(uintq sid, uintq a, uintq start, uintq cstart, uintq len) {
+    Py_XDECREF(capi_call("DIV", "(KKKKK)", sid, a, start, cstart, len));
+}
+void MULN(uintq sid, uintq a, uintq m, uintq in_s, uintq out_s, uintq len) {
+    Py_XDECREF(capi_call("MULN", "(KKKKKK)", sid, a, m, in_s, out_s, len));
+}
+void POWN(uintq sid, uintq a, uintq m, uintq in_s, uintq out_s, uintq len) {
+    Py_XDECREF(capi_call("POWN", "(KKKKKK)", sid, a, m, in_s, out_s, len));
+}
+
+int TrySeparate1Qb(uintq sid, uintq q) { return (int)as_ll(capi_call("TrySeparate1Qb", "(KK)", sid, q), 0); }
+int TrySeparate2Qb(uintq sid, uintq q1, uintq q2) {
+    return (int)as_ll(capi_call("TrySeparate2Qb", "(KKK)", sid, q1, q2), 0);
+}
+
+void ResetAll(uintq sid) { Py_XDECREF(capi_call("ResetAll", "(K)", sid)); }
+void qstabilizer_out_to_file(uintq sid, const char* f) {
+    Py_XDECREF(capi_call("qstabilizer_out_to_file", "(Ks)", sid, f));
+}
+void qstabilizer_in_from_file(uintq sid, const char* f) {
+    Py_XDECREF(capi_call("qstabilizer_in_from_file", "(Ks)", sid, f));
+}

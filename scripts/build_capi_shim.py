@@ -1,0 +1,36 @@
+"""Build libqrack_capi.so — the C ABI shim over qrack_tpu.capi.
+
+Usage: python scripts/build_capi_shim.py [outdir]
+
+Produces libqrack_capi.so that exports the reference pinvoke symbol set
+(reference: include/pinvoke_api.hpp) bound through an embedded CPython;
+consumers load it with ctypes/dlopen exactly like PyQrack loads the
+reference library.  See scripts/pyqrack_consumer_demo.py.
+"""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(HERE, "qrack_tpu", "native", "capi_shim.c")
+
+
+def build(outdir: str) -> str:
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var("VERSION")
+    out = os.path.join(outdir, "libqrack_capi.so")
+    cmd = ["gcc", "-shared", "-fPIC", "-O2", SRC, f"-I{inc}",
+           f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm", "-o", out,
+           f"-Wl,-rpath,{libdir}"]
+    print(" ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    outdir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        HERE, "qrack_tpu", "native")
+    print(build(outdir))

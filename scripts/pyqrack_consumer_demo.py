@@ -1,0 +1,85 @@
+"""PyQrack-style consumer: binds libqrack_capi.so with ctypes only.
+
+This script intentionally knows nothing about qrack_tpu's Python API —
+it talks to the C ABI exactly the way PyQrack talks to the reference's
+shared library (reference: pyqrack bindings over
+include/pinvoke_api.hpp).  Run scripts/build_capi_shim.py first.
+"""
+
+import ctypes
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.environ.get("QRACK_CAPI_SO",
+                    os.path.join(HERE, "qrack_tpu", "native", "libqrack_capi.so"))
+
+
+def main() -> int:
+    # the shim embeds CPython: it must find qrack_tpu on its sys.path
+    existing = os.environ.get("PYTHONPATH", "")
+    if HERE not in existing.split(os.pathsep):
+        os.environ["PYTHONPATH"] = (HERE + os.pathsep + existing) if existing else HERE
+    lib = ctypes.CDLL(SO, mode=ctypes.RTLD_GLOBAL)
+    u64 = ctypes.c_uint64
+    lib.init_count.restype = u64
+    lib.Prob.restype = ctypes.c_double
+    lib.Prob.argtypes = [u64, u64]
+    lib.MAll.restype = u64
+    lib.M.restype = ctypes.c_int
+
+    assert lib.qrack_capi_init() == 0
+
+    # --- Bell pair ---
+    sid = lib.init_count(u64(2))
+    lib.seed(u64(sid), u64(42))
+    lib.H(u64(sid), u64(0))
+    c = (u64 * 1)(0)
+    lib.MCX(u64(sid), u64(1), c, u64(1))
+    p = lib.Prob(u64(sid), u64(1))
+    assert abs(p - 0.5) < 1e-9, p
+    m0 = lib.M(u64(sid), u64(0))
+    m1 = lib.M(u64(sid), u64(1))
+    assert m0 == m1, (m0, m1)
+    lib.destroy(u64(sid))
+    print("BELL_OK")
+
+    # --- teleportation ---
+    sid = lib.init_count(u64(3))
+    lib.seed(u64(sid), u64(7))
+    lib.U(u64(sid), u64(0), ctypes.c_double(0.7),
+          ctypes.c_double(0.0), ctypes.c_double(0.0))
+    payload = lib.Prob(u64(sid), u64(0))
+    lib.H(u64(sid), u64(1))
+    c[0] = 1
+    lib.MCX(u64(sid), u64(1), c, u64(2))
+    c[0] = 0
+    lib.MCX(u64(sid), u64(1), c, u64(1))
+    lib.H(u64(sid), u64(0))
+    m1 = lib.M(u64(sid), u64(1))
+    m0 = lib.M(u64(sid), u64(0))
+    if m1:
+        lib.X(u64(sid), u64(2))
+    if m0:
+        lib.Z(u64(sid), u64(2))
+    out = lib.Prob(u64(sid), u64(2))
+    assert abs(out - payload) < 1e-9, (payload, out)
+    lib.destroy(u64(sid))
+    print("TELEPORT_OK")
+
+    # --- modular arithmetic (Shor building block) ---
+    sid = lib.init_count(u64(8))
+    lib.seed(u64(sid), u64(1))
+    lib.ADD(u64(sid), u64(3), u64(0), u64(3))
+    lib.MULN(u64(sid), u64(5), u64(13), u64(0), u64(4), u64(3))
+    lib.HighestProbAll.restype = u64
+    hp = lib.HighestProbAll(u64(sid))
+    assert (hp >> 4) == (3 * 5) % 13, hp
+    lib.destroy(u64(sid))
+    print("MULN_OK")
+    print("CONSUMER_DEMO_PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

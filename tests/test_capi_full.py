@@ -1,0 +1,246 @@
+"""Full flat-API surface: every reference pinvoke function exists and the
+new round-2 additions behave (reference: include/pinvoke_api.hpp:42-349,
+202 functions)."""
+
+import math
+import os
+import re
+
+import numpy as np
+import pytest
+
+from qrack_tpu import capi
+
+
+REF_FNS = """ACSWAP ADC ADD ADDS AND AdjISWAP AdjS AdjSX AdjSY AdjT AreFactorized
+CLAND CLNAND CLNOR CLOR CLXNOR CLXOR CSWAP Compose DIV DIVN Decompose Dispose
+Dump DumpIds Exp FSim FactorizedExpectation FactorizedExpectationFp
+FactorizedExpectationFpRdm FactorizedExpectationRdm FactorizedVariance
+FactorizedVarianceFp FactorizedVarianceFpRdm FactorizedVarianceRdm FlipQuadrant
+ForceM GetUnitaryFidelity H Hash HighestProbAll HighestProbAllN IQFT ISWAP
+InKet JointEnsembleProbability LDA M MAll MAllLong MACAdjS MACAdjT MACH MACS
+MACT MACU MACX MACY MACZ MCADD MCAdjS MCAdjT MCDIV MCDIVN MCExp MCH MCMUL
+MCMULN MCMtrx MCPOWN MCR MCS MCSUB MCT MCU MCX MCY MCZ MACMtrx MUL MULN MX MY
+MZ MatrixExpectation MatrixExpectationEigenVal MatrixVariance
+MatrixVarianceEigenVal Measure MeasureShots Mtrx Multiplex1Mtrx NAND NOR
+Normalize OR OutKet OutProbs OutReducedDensityMatrix POWN PauliExpectation
+PauliVariance PermutationExpectation PermutationExpectationRdm PermutationProb
+PermutationProbRdm PhaseParity PhaseRootN Prob ProbAll ProbRdm QFT R
+ResetAll ResetUnitaryFidelity S SBC SUB SUBS SWAP SX SY Separate SetAceMaxQb
+SetMajorQuadrant SetNcrp SetNoiseParameter SetQuadrant SetReactiveSeparate
+SetSdrp SetSparseAceMaxMb SetSprp SetStochastic SetTInjection
+SetUseExactNearClifford T TimeEvolve TrySeparate1Qb TrySeparate2Qb
+TrySeparateTol U UCMtrx UnitaryExpectation UnitaryExpectationEigenVal
+UnitaryVariance UnitaryVarianceEigenVal Variance VarianceRdm X XNOR XOR Y Z
+clone_qneuron destroy destroy_qcircuit destroy_qneuron get_error
+get_qcircuit_qubit_count get_qneuron_angles init init_clone init_count
+init_count_pager init_count_stabilizer init_count_type init_qcircuit
+init_qcircuit_clone init_qneuron qcircuit_append_1qb qcircuit_append_mc
+qcircuit_in_from_file qcircuit_inverse qcircuit_out_to_file
+qcircuit_out_to_string qcircuit_out_to_string_length qcircuit_past_light_cone
+qcircuit_run qcircuit_swap qneuron_learn qneuron_learn_cycle
+qneuron_learn_permutation qneuron_predict qneuron_unpredict
+qstabilizer_in_from_file qstabilizer_out_to_file random_choice seed
+set_concurrency set_device set_device_list set_qneuron_angles set_qneuron_sim
+release allocateQubit num_qubits""".split()
+
+
+def test_reference_surface_complete():
+    missing = [f for f in REF_FNS if not hasattr(capi, f)]
+    assert not missing, missing
+
+
+def test_gate_surface_additions():
+    sid = capi.init_count(4)
+    capi.seed(sid, 7)
+    capi.SX(sid, 0)
+    capi.AdjSX(sid, 0)
+    capi.SY(sid, 1)
+    capi.AdjSY(sid, 1)
+    capi.MACX(sid, [2], 3)     # anti-control on |0> fires
+    assert capi.Prob(sid, 3) == pytest.approx(1.0)
+    capi.MACX(sid, [2], 3)
+    capi.H(sid, 0)
+    capi.MCAdjS(sid, [0], 1)
+    capi.MACAdjT(sid, [0], 1)
+    capi.PhaseRootN(sid, 3, [0, 1])
+    capi.UCMtrx(sid, [0], [0, 1, 1, 0], 1, 0)   # anti-controlled X
+    capi.Multiplex1Mtrx(sid, [0], 1, [1, 0, 0, 1, 0, 1, 1, 0])
+    capi.MX(sid, [0, 1])
+    capi.MY(sid, [0])
+    capi.MZ(sid, [1])
+    capi.Normalize(sid)
+    p = capi.OutProbs(sid)
+    assert np.isclose(p.sum(), 1.0, atol=1e-6)
+    capi.destroy(sid)
+
+
+def test_exp_pauli_string():
+    from qrack_tpu.pauli import Pauli
+
+    sid = capi.init_count(2)
+    capi.seed(sid, 3)
+    capi.H(sid, 0)
+    st0 = capi.OutKet(sid)
+    capi.Exp(sid, [Pauli.PauliZ, Pauli.PauliZ], 0.3, [0, 1])
+    got = capi.OutKet(sid)
+    ZZ = np.diag([1.0, -1.0, -1.0, 1.0])
+    import numpy.linalg as la
+    w, v = la.eigh(ZZ)
+    U = (v * np.exp(1j * 0.3 * w)) @ v.conj().T
+    want = U @ st0
+    f = abs(np.vdot(want, got)) ** 2
+    assert f == pytest.approx(1.0, abs=1e-9)
+    capi.destroy(sid)
+
+
+def test_pauli_expectation_and_variance():
+    from qrack_tpu.pauli import Pauli
+
+    sid = capi.init_count(2)
+    capi.seed(sid, 5)
+    capi.H(sid, 0)
+    # <X> on |+> is 1
+    assert capi.PauliExpectation(sid, [Pauli.PauliX], [0]) == pytest.approx(1.0, abs=1e-9)
+    assert capi.PauliVariance(sid, [Pauli.PauliX], [0]) == pytest.approx(0.0, abs=1e-9)
+    # <Z> on |+> is 0
+    assert capi.PauliExpectation(sid, [Pauli.PauliZ], [0]) == pytest.approx(0.0, abs=1e-9)
+    capi.destroy(sid)
+
+
+def test_factorized_and_rotated_stats():
+    sid = capi.init_count(2)
+    capi.seed(sid, 5)
+    capi.X(sid, 0)
+    assert capi.FactorizedExpectation(sid, [0, 1], [3, 5, 7, 11]) == pytest.approx(5 + 7, abs=1e-9)
+    assert capi.FactorizedExpectationFp(sid, [0, 1], [0.5, 1.5, 2.0, 3.0]) == pytest.approx(1.5 + 2.0, abs=1e-9)
+    v = capi.FactorizedVariance(sid, [0, 1], [3, 5, 7, 11])
+    assert v == pytest.approx(0.0, abs=1e-9)
+    # MatrixExpectation in the X basis of |1>: +1/-1 eigenvalues with
+    # P(+)=P(-)=0.5 average to 0 (reference default eigenvalues)
+    H2 = np.array([1, 1, 1, -1], dtype=np.complex128) / math.sqrt(2)
+    e = capi.MatrixExpectation(sid, [0], [H2])
+    assert e == pytest.approx(0.0, abs=1e-9)
+    e2 = capi.MatrixExpectationEigenVal(sid, [0], [H2], [1.0, -1.0])
+    assert e2 == pytest.approx(0.0, abs=1e-9)
+    capi.destroy(sid)
+
+
+def test_arithmetic_additions():
+    sid = capi.init_count(6)
+    capi.seed(sid, 1)
+    capi.ADD(sid, 3, 0, 4)
+    capi.SUBS(sid, 1, 5, 0, 4)
+    assert capi.HighestProbAll(sid) == 2
+    capi.X(sid, 4)
+    capi.MCADD(sid, 5, [4], 0, 4)
+    assert (capi.HighestProbAll(sid) & 0xF) == 7
+    capi.MCSUB(sid, 5, [4], 0, 4)
+    capi.destroy(sid)
+
+
+def test_mulmodn_roundtrip_via_capi():
+    sid = capi.init_count(8)
+    capi.seed(sid, 1)
+    capi.ADD(sid, 3, 0, 3)
+    capi.MULN(sid, 5, 13, 0, 4, 3)      # out = 15 mod 13 = 2
+    capi.DIVN(sid, 5, 13, 0, 4, 3)      # inverse
+    assert capi.HighestProbAll(sid) == 3
+    capi.destroy(sid)
+
+
+def test_qneuron_via_capi():
+    sid = capi.init_count(2)
+    capi.seed(sid, 2)
+    nid = capi.init_qneuron(sid, [0], 1)
+    capi.set_qneuron_angles(nid, [0.3, 0.7])
+    assert np.allclose(capi.get_qneuron_angles(nid), [0.3, 0.7])
+    p = capi.qneuron_predict(nid, True, True)
+    assert 0.0 <= p <= 1.0
+    n2 = capi.clone_qneuron(nid)
+    assert np.allclose(capi.get_qneuron_angles(n2), [0.3, 0.7])
+    capi.destroy_qneuron(n2)
+    capi.destroy_qneuron(nid)
+    capi.destroy(sid)
+
+
+def test_qcircuit_via_capi(tmp_path):
+    from qrack_tpu import matrices as mat
+
+    cid = capi.init_qcircuit()
+    capi.qcircuit_append_1qb(cid, np.asarray(mat.H2).ravel(), 0)
+    capi.qcircuit_append_mc(cid, [0, 1, 1, 0], [0], 1, 1)
+    assert capi.get_qcircuit_qubit_count(cid) == 2
+    sid = capi.init_count(2)
+    capi.seed(sid, 3)
+    capi.qcircuit_run(cid, sid)
+    # Bell state
+    probs = capi.OutProbs(sid)
+    assert probs[0] == pytest.approx(0.5, abs=1e-9)
+    assert probs[3] == pytest.approx(0.5, abs=1e-9)
+    # inverse round-trips
+    inv = capi.qcircuit_inverse(cid)
+    capi.qcircuit_run(inv, sid)
+    assert capi.Prob(sid, 0) == pytest.approx(0.0, abs=1e-9)
+    # file round-trip
+    path = str(tmp_path / "circ.qc")
+    capi.qcircuit_out_to_file(cid, path)
+    c2 = capi.init_qcircuit()
+    capi.qcircuit_in_from_file(c2, path)
+    assert capi.get_qcircuit_qubit_count(c2) == 2
+    sid2 = capi.init_count(2)
+    capi.seed(sid2, 3)
+    capi.qcircuit_run(c2, sid2)
+    assert capi.OutProbs(sid2)[3] == pytest.approx(0.5, abs=1e-9)
+    s = capi.qcircuit_out_to_string(cid)
+    assert capi.qcircuit_out_to_string_length(cid) == len(s)
+    for i in (cid, inv, c2):
+        capi.destroy_qcircuit(i)
+    capi.destroy(sid)
+    capi.destroy(sid2)
+
+
+def test_stabilizer_serialization_roundtrip(tmp_path):
+    sid = capi.init_count_stabilizer(3)
+    capi.seed(sid, 4)
+    capi.H(sid, 0)
+    capi.MCX(sid, [0], 1)
+    capi.S(sid, 1)
+    ket = capi.OutKet(sid)
+    path = str(tmp_path / "tab.qstab")
+    capi.qstabilizer_out_to_file(sid, path)
+    sid2 = capi.init_count(3)
+    capi.qstabilizer_in_from_file(sid2, path)
+    ket2 = capi.OutKet(sid2)
+    f = abs(np.vdot(ket, ket2)) ** 2
+    assert f == pytest.approx(1.0, abs=1e-9)
+    capi.destroy(sid)
+    capi.destroy(sid2)
+
+
+def test_misc_config_and_registry():
+    sid = capi.init_count(3)
+    capi.seed(sid, 6)
+    capi.set_concurrency(sid, 4)
+    capi.SetSdrp(sid, 0.01)
+    capi.SetNcrp(sid, 0.01)
+    capi.SetSprp(sid, 0.01)
+    capi.SetTInjection(sid, True)
+    capi.SetAceMaxQb(sid, 20)
+    capi.ResetUnitaryFidelity(sid)
+    capi.SetReactiveSeparate(sid, True)
+    capi.H(sid, 0)
+    capi.MCX(sid, [0], 1)
+    assert capi.AreFactorized(sid, [2])
+    capi.Separate(sid, [2])
+    assert capi.TrySeparateTol(sid, [2], 1e-6)
+    assert capi.get_error(sid) == 0
+    capi.SetQuadrant(sid, 0, True)   # unsupported on this stack: sets error
+    assert capi.get_error(sid) == 1
+    r = capi.random_choice(sid, [0.5, 0.5])
+    assert r in (0, 1)
+    ids = capi.DumpIds(sid)
+    assert ids == [0, 1, 2]
+    assert capi.Dump(sid).shape[0] == 8
+    assert capi.MAllLong(sid) >= 0
+    capi.destroy(sid)

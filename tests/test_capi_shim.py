@@ -1,0 +1,29 @@
+"""C ABI shim: build libqrack_capi.so and run the PyQrack-style
+ctypes consumer against it (reference: pinvoke .so consumed by
+PyQrack)."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_build_and_consume_shim(tmp_path):
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "build_capi_shim.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-1000:]
+    so = out.stdout.strip().splitlines()[-1]
+    env = dict(os.environ, QRACK_CAPI_SO=so)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "pyqrack_consumer_demo.py")],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert "CONSUMER_DEMO_PASSED" in res.stdout
