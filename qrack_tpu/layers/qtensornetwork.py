@@ -4,10 +4,15 @@ Re-design of the reference layer (reference:
 include/qtensornetwork.hpp:30 — buffers gates into a QCircuit; on any
 observable query materializes only the past light cone of the measured
 qubits into the stack below; RunAsAmplitudes :73-83, MakeLayerStack
-src/qtensornetwork.cpp:115). Round-1 simplification: the first
-collapsing measurement materializes the full light cone and the layer
-stays materialized (the reference's measurement-layer re-buffering is a
-later-round extension)."""
+src/qtensornetwork.cpp:115).
+
+Measurement re-buffering (reference: the measurement-layer circuit
+history, include/qtensornetwork.hpp:73-83): a collapsing measurement
+runs the pending circuit into a *base* stack, collapses there, and then
+buffering resumes — the collapsed stack becomes the initial state for
+the next circuit segment, so gate streams interleaved with mid-circuit
+measurements keep the light-cone elision instead of permanently
+materializing."""
 
 from __future__ import annotations
 
@@ -41,28 +46,34 @@ class QTensorNetwork(QInterface):
     # ------------------------------------------------------------------
 
     def _buffering(self) -> bool:
-        return self.sim is None
+        return bool(self.circuit.gates) or self.sim is None
 
-    def _materialize(self, qubits=None) -> None:
-        """Build the lower stack and run the (light-cone) circuit
-        (reference: MakeLayerStack)."""
-        if self.sim is not None:
-            return
-        circ = (self.circuit if qubits is None
-                else self.circuit.PastLightCone(qubits))
-        self.sim = self._factory(self.qubit_count, init_state=self._init_state,
-                                 rng=self._stack_rng.spawn(), **self._kw)
-        circ.RunFused(self.sim)
+    def _materialize(self) -> None:
+        """Run the pending circuit into the base stack (reference:
+        MakeLayerStack); buffering resumes afterwards with the base as
+        the new segment's initial state."""
+        if self.sim is None:
+            self.sim = self._factory(self.qubit_count,
+                                     init_state=self._init_state,
+                                     rng=self._stack_rng.spawn(), **self._kw)
+        if self.circuit.gates:
+            self.circuit.RunFused(self.sim)
         self.circuit = QCircuit(self.qubit_count)
 
     def _light_cone_query(self, qubits, fn):
         """Query an observable through a temporary light-cone stack
         without materializing (reference: RunAsAmplitudes)."""
-        if self.sim is not None:
+        if not self.circuit.gates:
+            if self.sim is not None:
+                return fn(self.sim)
+            self._materialize()
             return fn(self.sim)
         circ = self.circuit.PastLightCone(qubits)
-        tmp = self._factory(self.qubit_count, init_state=self._init_state,
-                            rng=self._stack_rng.spawn(), **self._kw)
+        if self.sim is not None:
+            tmp = self.sim.Clone()
+        else:
+            tmp = self._factory(self.qubit_count, init_state=self._init_state,
+                                rng=self._stack_rng.spawn(), **self._kw)
         # per-gate path here: light-cone circuits are fresh objects per
         # query, so a fused compile could never be cache-hit — the
         # module-level per-gate kernels are already compiled process-wide.
@@ -71,12 +82,11 @@ class QTensorNetwork(QInterface):
         return fn(tmp)
 
     # ------------------------------------------------------------------
-    # gate primitive: buffer
+    # gate primitive: buffer (always — measurement re-buffering keeps
+    # post-collapse gates in the IR too)
     # ------------------------------------------------------------------
 
     def MCMtrxPerm(self, controls, mtrx, target, perm) -> None:
-        if self.sim is not None:
-            return self.sim.MCMtrxPerm(controls, mtrx, target, perm)
         m = np.asarray(mtrx, dtype=np.complex128).reshape(2, 2)
         self.circuit.append_ctrl(tuple(controls), target, m, perm)
 
@@ -102,8 +112,15 @@ class QTensorNetwork(QInterface):
     def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
         if do_apply:
             self._materialize()
+            # draw the collapse from OUR measurement stream, then restore
+            # the base's own stream so later query-path clones never
+            # consume from (and desync) the measurement stream
+            saved = self.sim.rng
             self.sim.rng = self.rng
-            return self.sim.ForceM(q, result, do_force, do_apply)
+            try:
+                return self.sim.ForceM(q, result, do_force, do_apply)
+            finally:
+                self.sim.rng = saved
         return self._light_cone_query([q], lambda s: s.ForceM(q, result, do_force, False))
 
     def MultiShotMeasureMask(self, q_powers, shots: int) -> dict:
@@ -126,6 +143,10 @@ class QTensorNetwork(QInterface):
         self.sim = None
         self._init_state = perm
 
+    def _sync_from_sim(self) -> None:
+        self.qubit_count = self.sim.qubit_count
+        self.circuit = QCircuit(self.qubit_count)
+
     def SetQuantumState(self, state) -> None:
         self._materialize()
         self.sim.SetQuantumState(state)
@@ -138,8 +159,7 @@ class QTensorNetwork(QInterface):
             oc._materialize()
             inner = oc.sim
         res = self.sim.Compose(inner, start)
-        self.qubit_count = self.sim.qubit_count
-        self.circuit.qubit_count = self.qubit_count
+        self._sync_from_sim()
         return res
 
     def Decompose(self, start: int, dest) -> None:
@@ -147,31 +167,32 @@ class QTensorNetwork(QInterface):
         if isinstance(dest, QTensorNetwork):
             dest._materialize()
             self.sim.Decompose(start, dest.sim)
-            dest.qubit_count = dest.sim.qubit_count
+            dest._sync_from_sim()
         else:
             self.sim.Decompose(start, dest)
-        self.qubit_count = self.sim.qubit_count
+        self._sync_from_sim()
 
     def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
         self._materialize()
         self.sim.Dispose(start, length, disposed_perm)
-        self.qubit_count = self.sim.qubit_count
+        self._sync_from_sim()
 
     def Allocate(self, start: int, length: int = 1) -> int:
-        if self.sim is not None:
-            res = self.sim.Allocate(start, length)
-            self.qubit_count = self.sim.qubit_count
-            return res
-        # buffered: just widen the register (new qubits start |0>)
-        if (any(max(g.qubits()) >= start for g in self.circuit.gates)
-                or (self._init_state >> start)):
-            # shifting buffered gate/init-state indices is a later-round
-            # refinement; materialize and let the stack insert
-            self._materialize()
-            return self.Allocate(start, length)
-        self.qubit_count += length
-        self.circuit.qubit_count = self.qubit_count
-        return start
+        if start == self.qubit_count:
+            # append never shifts existing indices: widen the register
+            # (new qubits start |0>; init-state bits above the old width
+            # are zero by invariant), pending gates stay buffered
+            if self.sim is not None:
+                self.sim.Allocate(start, length)
+            self.qubit_count += length
+            self.circuit.qubit_count = self.qubit_count
+            return start
+        # mid-insertion or pending gates: flush the segment first so
+        # buffered gate indices never need shifting
+        self._materialize()
+        res = self.sim.Allocate(start, length)
+        self._sync_from_sim()
+        return res
 
     def Clone(self) -> "QTensorNetwork":
         c = QTensorNetwork(self.qubit_count, init_state=self._init_state,
@@ -196,4 +217,4 @@ class QTensorNetwork(QInterface):
             self.sim.Finish()
 
     def isBuffering(self) -> bool:
-        return self.sim is None
+        return self._buffering()

@@ -315,3 +315,38 @@ def test_runfused_validates_and_caches():
     assert c2._fused_cache[3] is first
     c2.append_1q(1, mat.H2)
     assert 3 not in c2._fused_cache
+
+
+def test_tensornetwork_rebuffers_after_measurement():
+    """Reference behavior (qtensornetwork.hpp:73-83): a collapse runs the
+    pending segment into the base stack, then buffering RESUMES — gates
+    after a mid-circuit measurement stay in the IR."""
+    n = 6
+    q = QTensorNetwork(n, stack_factory=cpu_factory, rng=QrackRandom(8),
+                       rand_global_phase=False)
+    o = cpu_factory(n, rng=QrackRandom(8))
+    for eng in (q, o):
+        eng.H(0)
+        eng.CNOT(0, 1)
+    q.rng.seed(4)
+    o.rng.seed(4)
+    assert q.M(0) == o.M(0)
+    assert not q.circuit.gates          # segment flushed by the collapse
+    for eng in (q, o):
+        eng.H(2)
+        eng.CNOT(2, 3)
+        eng.T(3)
+    assert q.isBuffering()              # post-measurement gates buffered
+    assert len(q.circuit.gates) > 0
+    # light-cone queries work across the base + pending segment split
+    assert q.Prob(3) == pytest.approx(o.Prob(3), abs=1e-9)
+    assert q.isBuffering()
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+    # second measurement: NO reseed — the interleaved queries above must
+    # not have consumed from the measurement stream (regression guard
+    # for query-path clones advancing the main rng)
+    assert q.M(2) == o.M(2)
+    for eng in (q, o):
+        eng.H(4)
+    assert q.isBuffering()
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
