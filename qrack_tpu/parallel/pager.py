@@ -685,6 +685,64 @@ class QPager(QEngine):
         return self.sharding
 
     # ------------------------------------------------------------------
+    # structure-aware lossy checkpoints (reference: per-page streams +
+    # device ids, src/qpager_turboquant.cpp:24-45) — pages stage through
+    # the host one at a time, so peak host memory is one page, not the
+    # whole ket
+    # ------------------------------------------------------------------
+
+    def LossySaveStateVector(self, path: str, bits: int = 8, block_pow: int = 12) -> None:
+        import json
+
+        from ..storage.turboquant import quantize_blocks
+
+        L = self.local_bits
+        arrays = {}
+        for p in range(self.n_pages):
+            page = self.GetAmplitudePage(p << L, 1 << L)
+            scales, codes, n = quantize_blocks(page, bits=bits, block_pow=block_pow)
+            arrays[f"scales_{p}"] = scales
+            arrays[f"codes_{p}"] = codes
+        arrays["meta"] = np.frombuffer(json.dumps({
+            "format": "qpager-turboquant-v1", "bits": bits,
+            "qubit_count": self.qubit_count, "n_pages": self.n_pages,
+            "page_len": 1 << L, "device_ids": self.GetDeviceList(),
+        }).encode(), dtype=np.uint8)
+        np.savez_compressed(path, **arrays)
+
+    def LossyLoadStateVector(self, path: str) -> None:
+        import json
+
+        from ..storage.turboquant import dequantize_blocks, lossy_load
+
+        p = path if str(path).endswith(".npz") else str(path) + ".npz"
+        with np.load(p) as z:
+            if "meta" not in z:
+                self.SetQuantumState(lossy_load(path))  # whole-ket fallback
+                return
+            meta = json.loads(bytes(z["meta"]).decode())
+            if meta.get("format") != "qpager-turboquant-v1":
+                self.SetQuantumState(lossy_load(path))
+                return
+            if meta["qubit_count"] != self.qubit_count:
+                raise ValueError("checkpoint width mismatch")
+            plen = meta["page_len"]
+            if meta["n_pages"] * plen != (1 << self.qubit_count):
+                raise ValueError("checkpoint page layout inconsistent")
+            total = 0.0
+            for i in range(meta["n_pages"]):
+                # keep raw magnitudes: the stored scales carry each
+                # page's weight, so only ONE global renormalization runs.
+                # Offsets are checkpoint-relative (i * plen), so a pager
+                # with a different page count loads the same ket.
+                page = dequantize_blocks(z[f"scales_{i}"], z[f"codes_{i}"],
+                                         plen, meta["bits"], normalize=False)
+                total += float(np.sum(np.abs(page) ** 2))
+                self.SetAmplitudePage(page, i * plen)
+            if total > 0:
+                self._k_normalize(total)
+
+    # ------------------------------------------------------------------
     # state access
     # ------------------------------------------------------------------
 

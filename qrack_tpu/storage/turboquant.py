@@ -31,15 +31,17 @@ def quantize_blocks(state: np.ndarray, bits: int = 8, block_pow: int = 12):
     return scales.squeeze(-1).astype(np.float32), codes, n
 
 
-def dequantize_blocks(scales: np.ndarray, codes: np.ndarray, n: int, bits: int = 8) -> np.ndarray:
+def dequantize_blocks(scales: np.ndarray, codes: np.ndarray, n: int, bits: int = 8,
+                      normalize: bool = True) -> np.ndarray:
     qmax = (1 << (bits - 1)) - 1
     planes = codes.astype(np.float32) * (scales[..., None] / qmax)
     flat = planes.reshape(2, -1)
     out = (flat[0] + 1j * flat[1]).astype(np.complex128)[:n]
-    # renormalize: quantization shrinks the norm slightly
-    nrm = np.linalg.norm(out)
-    if nrm > 0:
-        out = out / nrm
+    if normalize:
+        # renormalize: quantization shrinks the norm slightly
+        nrm = np.linalg.norm(out)
+        if nrm > 0:
+            out = out / nrm
     return out
 
 
