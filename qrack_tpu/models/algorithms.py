@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import math
 from fractions import Fraction
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -135,3 +135,150 @@ def xeb_fidelity(probs_ideal: np.ndarray, samples) -> float:
     d = probs_ideal.shape[0]
     mean_p = float(np.mean([probs_ideal[int(s)] for s in samples]))
     return d * mean_p - 1.0
+
+
+def grover_lookup_search(qsim, values: Sequence[int], target_value: int,
+                         index_length: int, value_length: int) -> int:
+    """Grover search over a loaded lookup table (reference:
+    examples/grovers_lookup.cpp): superpose the index register, load
+    values with the XOR-load oracle, flip the phase of entries equal to
+    target_value, unload, amplify."""
+    import math
+
+    n_items = 1 << index_length
+    iters = max(1, int(round(math.pi / 4 * math.sqrt(n_items))))
+    for q in range(index_length):
+        qsim.H(q)
+    for _ in range(iters):
+        # oracle: load value, phase-flip where value == target, unload
+        qsim.IndexedLDA(0, index_length, index_length, value_length, values,
+                        reset_value=False)
+        qsim.PhaseFlipIfLess(target_value + 1, index_length, value_length)
+        qsim.PhaseFlipIfLess(target_value, index_length, value_length)
+        qsim.IndexedLDA(0, index_length, index_length, value_length, values,
+                        reset_value=False)  # XOR-load is self-inverse
+        # diffusion on the index register
+        for q in range(index_length):
+            qsim.H(q)
+        qsim.PhaseFlipIfLess(1, 0, index_length)
+        for q in range(index_length):
+            qsim.H(q)
+    return qsim.MReg(0, index_length)
+
+
+def ordered_list_search(qsim, values: Sequence[int], key_value: int,
+                        index_length: int, value_length: int) -> int:
+    """Quadrant-narrowing search of an ORDERED list (reference:
+    examples/ordered_list_search.cpp): each round superposes the two
+    candidate halves' selector qubit, loads the quantum table, and
+    compares against the key to decide the half — log2(N) rounds."""
+    lo, hi = 0, (1 << index_length) - 1
+    for bit in range(index_length - 1, -1, -1):
+        mid = lo + (1 << bit)
+        if mid > hi:
+            continue
+        # classical controller queries the quantum-loaded value at `mid`
+        qsim.SetReg(0, index_length + value_length, 0)
+        qsim.SetReg(0, index_length, mid)
+        qsim.IndexedLDA(0, index_length, index_length, value_length, values)
+        v = int(round(qsim.ExpectationBitsAll(
+            list(range(index_length, index_length + value_length)))))
+        if v <= key_value:
+            lo = mid
+    qsim.SetReg(0, index_length + value_length, 0)
+    qsim.SetReg(0, index_length, lo)
+    qsim.IndexedLDA(0, index_length, index_length, value_length, values)
+    return lo
+
+
+def pearson_hash_demo(qsim, perm_table: Sequence[int], key_length: int) -> dict:
+    """Superposed Pearson-style hashing (reference: examples/pearson32.cpp):
+    every possible key is hashed at once through the unitary Hash op;
+    sampling the register yields (key-bijective) hash outputs."""
+    for q in range(key_length):
+        qsim.H(q)
+    qsim.Hash(0, key_length, perm_table)
+    shots = qsim.MultiShotMeasureMask([1 << q for q in range(key_length)], 64)
+    return shots
+
+
+def quantum_perceptron(qsim, input_qubit: int, output_qubit: int,
+                       eta: float = 0.5, epochs: int = 4) -> float:
+    """Train a QNeuron to learn NOT(input) (reference:
+    examples/quantum_perceptron.cpp); returns the post-training
+    prediction accuracy."""
+    from ..qneuron import QNeuron
+
+    neuron = QNeuron(qsim, (input_qubit,), output_qubit)
+    for _ in range(epochs):
+        for x in (0, 1):
+            qsim.SetPermutation(x << input_qubit)
+            neuron.Learn(eta, expected=(x == 0))
+    correct = 0
+    for x in (0, 1):
+        qsim.SetPermutation(x << input_qubit)
+        p = neuron.Predict()
+        guess = p >= 0.5
+        correct += int(guess == (x == 0))
+    return correct / 2.0
+
+
+def quantum_associative_memory(qsim, patterns: Sequence[Tuple[int, bool]],
+                               input_length: int, output_qubit: int,
+                               eta: float = 0.5) -> float:
+    """Store input->bit associations in QNeuron angles and recall them
+    (reference: examples/quantum_associative_memory.cpp); returns the
+    recall accuracy over the stored patterns."""
+    from ..qneuron import QNeuron
+
+    neuron = QNeuron(qsim, tuple(range(input_length)), output_qubit)
+    for key, bit in patterns:
+        qsim.SetPermutation(key)
+        neuron.LearnPermutation(eta, expected=bit)
+    hits = 0
+    for key, bit in patterns:
+        qsim.SetPermutation(key)
+        p = neuron.Predict()
+        hits += int((p >= 0.5) == bit)
+    return hits / len(patterns)
+
+
+def cosmology_inflation(qsim_factory, steps: int, rng) -> List[int]:
+    """Toy 'inflating universe' (reference: examples/cosmology.cpp): each
+    step composes a randomly-prepared qubit onto the register and
+    entangles it with a random neighbor; returns the register width per
+    step (the reference watches how structure grows under composition)."""
+    import math
+
+    reg = qsim_factory(1)
+    reg.U(0, 2 * math.pi * rng.rand(), 2 * math.pi * rng.rand(),
+          2 * math.pi * rng.rand())
+    widths = [reg.qubit_count]
+    for _ in range(steps):
+        nbit = qsim_factory(1)
+        nbit.U(0, 2 * math.pi * rng.rand(), 2 * math.pi * rng.rand(),
+               2 * math.pi * rng.rand())
+        reg.Compose(nbit)
+        partner = rng.randint(0, reg.qubit_count - 1)
+        reg.CNOT(partner, reg.qubit_count - 1)
+        widths.append(reg.qubit_count)
+    return widths
+
+
+def separability_demo(qsim) -> dict:
+    """Entangle, then watch Schmidt separation recover the product
+    structure (reference: examples/qunit_separability.cpp /
+    separability.cpp)."""
+    out = {}
+    n = qsim.qubit_count
+    qsim.H(0)
+    for i in range(n - 1):
+        qsim.CNOT(i, i + 1)
+    out["entangled_units"] = getattr(qsim, "GetUnitCount", lambda: 1)()
+    # un-compute: the state returns to a product and TrySeparate confirms
+    for i in range(n - 2, -1, -1):
+        qsim.CNOT(i, i + 1)
+    qsim.H(0)
+    out["separable"] = all(qsim.TrySeparate(q) for q in range(n))
+    out["final_units"] = getattr(qsim, "GetUnitCount", lambda: n)()
+    return out

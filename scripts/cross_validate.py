@@ -1,0 +1,177 @@
+"""Cross-validation against an INDEPENDENT simulator (torch).
+
+Role parity with the reference's external-oracle scripts (reference:
+scripts/rcs_nn_qiskit_validation.py, scripts/fc_mps_qrack_validation.py
+— validate RCS output distributions against Qiskit/MPS).  No Qiskit
+exists in this image, so the independent oracle is a torch-based dense
+statevector simulator written with its own layout and index conventions
+(per-axis tensor reshapes — NOT this framework's index algebra), so a
+shared systematic error is implausible.
+
+Usage: python scripts/cross_validate.py [width] [depth]
+Prints one JSON line per validated stack with the L2 distance and
+fidelity vs the torch oracle.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import torch
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+class TorchSim:
+    """Dense statevector simulator on torch: the state is an n-axis
+    complex tensor; 1q gates are einsums over one axis, controlled gates
+    use boolean index masks.  Qubit k = tensor axis (n-1-k) so qubit 0
+    is the least-significant bit of the flattened index."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.state = torch.zeros((2,) * n, dtype=torch.complex128)
+        self.state.reshape(-1)[0] = 1.0
+
+    def _axis(self, q: int) -> int:
+        return self.n - 1 - q
+
+    def apply_1q(self, m, q: int) -> None:
+        mt = torch.as_tensor(np.asarray(m, dtype=np.complex128))
+        ax = self._axis(q)
+        st = torch.movedim(self.state, ax, 0)
+        st = torch.einsum("ab,b...->a...", mt, st)
+        self.state = torch.movedim(st, 0, ax)
+
+    def apply_ctrl(self, controls, perm: int, m, target: int) -> None:
+        flat = self.state.reshape(-1)
+        idx = torch.arange(flat.shape[0])
+        ok = torch.ones_like(idx, dtype=torch.bool)
+        for j, c in enumerate(controls):
+            want = (perm >> j) & 1
+            ok &= ((idx >> c) & 1) == want
+        t0 = ok & (((idx >> target) & 1) == 0)
+        mt = torch.as_tensor(np.asarray(m, dtype=np.complex128))
+        i0 = idx[t0]
+        i1 = i0 | (1 << target)
+        a0, a1 = flat[i0].clone(), flat[i1].clone()
+        flat[i0] = mt[0, 0] * a0 + mt[0, 1] * a1
+        flat[i1] = mt[1, 0] * a0 + mt[1, 1] * a1
+        self.state = flat.reshape((2,) * self.n)
+
+    def vector(self) -> np.ndarray:
+        return self.state.reshape(-1).numpy()
+
+
+def random_circuit_spec(n: int, depth: int, seed: int):
+    """Engine-agnostic circuit description: (kind, params) tuples."""
+    rs = np.random.RandomState(seed)
+    ops = []
+    for _ in range(depth):
+        for q in range(n):
+            kind = rs.randint(4)
+            if kind == 0:
+                ops.append(("h", q))
+            elif kind == 1:
+                ops.append(("t", q))
+            elif kind == 2:
+                ops.append(("ry", q, float(rs.uniform(0, math.pi))))
+            else:
+                ops.append(("rz", q, float(rs.uniform(0, math.pi))))
+        for q in range(rs.randint(2), n - 1, 2):
+            ops.append(("cnot", q, q + 1) if rs.randint(2) else ("cz", q, q + 1))
+    return ops
+
+
+H2 = np.array([[1, 1], [1, -1]], dtype=np.complex128) / math.sqrt(2)
+X2 = np.array([[0, 1], [1, 0]], dtype=np.complex128)
+Z2 = np.diag([1.0, -1.0]).astype(np.complex128)
+T2 = np.diag([1.0, np.exp(0.25j * math.pi)])
+
+
+def run_spec_torch(sim: TorchSim, ops) -> None:
+    for op in ops:
+        kind = op[0]
+        if kind == "h":
+            sim.apply_1q(H2, op[1])
+        elif kind == "t":
+            sim.apply_1q(T2, op[1])
+        elif kind == "ry":
+            th = op[2]
+            m = np.array([[math.cos(th / 2), -math.sin(th / 2)],
+                          [math.sin(th / 2), math.cos(th / 2)]],
+                         dtype=np.complex128)
+            sim.apply_1q(m, op[1])
+        elif kind == "rz":
+            th = op[2]
+            sim.apply_1q(np.diag([np.exp(-0.5j * th), np.exp(0.5j * th)]), op[1])
+        elif kind == "cnot":
+            sim.apply_ctrl((op[1],), 1, X2, op[2])
+        elif kind == "cz":
+            sim.apply_ctrl((op[1],), 1, Z2, op[2])
+
+
+def run_spec_qrack(q, ops) -> None:
+    for op in ops:
+        kind = op[0]
+        if kind == "h":
+            q.H(op[1])
+        elif kind == "t":
+            q.T(op[1])
+        elif kind == "ry":
+            q.RY(op[2], op[1])
+        elif kind == "rz":
+            q.RZ(op[2], op[1])
+        elif kind == "cnot":
+            q.CNOT(op[1], op[2])
+        elif kind == "cz":
+            q.CZ(op[1], op[2])
+
+
+def validate(width: int, depth: int, seed: int = 7):
+    from qrack_tpu import QEngineCPU
+    from qrack_tpu.layers.qunit import QUnit
+    from qrack_tpu.layers.qtensornetwork import QTensorNetwork
+    from qrack_tpu.utils.rng import QrackRandom
+
+    ops = random_circuit_spec(width, depth, seed)
+    oracle = TorchSim(width)
+    run_spec_torch(oracle, ops)
+    want = oracle.vector()
+
+    def cpu_factory(n, **kw):
+        kw.setdefault("rand_global_phase", False)
+        return QEngineCPU(n, **kw)
+
+    stacks = {
+        "qengine_cpu": lambda: cpu_factory(width, rng=QrackRandom(1)),
+        "qunit": lambda: QUnit(width, unit_factory=cpu_factory,
+                               rng=QrackRandom(1), rand_global_phase=False),
+        "qunit_optimal": lambda: QUnit(width, rng=QrackRandom(1),
+                                       rand_global_phase=False),
+        "qtensornetwork": lambda: QTensorNetwork(
+            width, rng=QrackRandom(1), rand_global_phase=False),
+    }
+    results = []
+    for name, mk in stacks.items():
+        q = mk()
+        run_spec_qrack(q, ops)
+        got = np.asarray(q.GetQuantumState(), dtype=np.complex128)
+        fid = abs(np.vdot(want, got)) ** 2
+        l2 = float(np.linalg.norm(np.abs(got) - np.abs(want)))
+        results.append({"stack": name, "width": width, "depth": depth,
+                        "fidelity": float(fid), "abs_l2": l2,
+                        "oracle": "torch-independent-dense"})
+    return results
+
+
+if __name__ == "__main__":
+    w = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    for r in validate(w, d):
+        print(json.dumps(r))

@@ -1,0 +1,19 @@
+"""Stacks validated against a NON-SELF oracle (torch independent dense
+sim — role parity with the reference's Qiskit/MPS validation scripts,
+scripts/rcs_nn_qiskit_validation.py)."""
+
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from cross_validate import validate  # noqa: E402
+
+
+@pytest.mark.parametrize("seed", [7, 21])
+def test_stacks_match_torch_oracle(seed):
+    for r in validate(6, 6, seed):
+        assert r["fidelity"] == pytest.approx(1.0, abs=1e-7), r

@@ -1,0 +1,73 @@
+"""Examples parity: the reference's teaching programs re-expressed
+through this framework (reference: examples/*.cpp — ordered_list_search,
+grovers_lookup, pearson32, quantum_perceptron,
+quantum_associative_memory, cosmology, separability demos)."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu.layers.qunit import QUnit
+from qrack_tpu.models import algorithms as alg
+from qrack_tpu.utils.rng import QrackRandom
+
+
+def cpu_factory(n, **kw):
+    kw.setdefault("rand_global_phase", False)
+    kw.setdefault("rng", QrackRandom(7))
+    return QEngineCPU(n, **kw)
+
+
+def test_grover_lookup_search():
+    idx_len, val_len = 4, 3
+    values = [2] * (1 << idx_len)
+    values[11] = 6
+    q = cpu_factory(idx_len + val_len)
+    got = alg.grover_lookup_search(q, values, 6, idx_len, val_len)
+    assert got == 11
+
+
+def test_ordered_list_search():
+    idx_len, val_len = 5, 4
+    target_key, target_value = 13, 6
+    values = ([2] * target_key + [target_value]
+              + [9] * ((1 << idx_len) - target_key - 1))
+    q = cpu_factory(idx_len + val_len)
+    got = alg.ordered_list_search(q, values, target_value, idx_len, val_len)
+    assert got == target_key
+
+
+def test_pearson_hash_demo():
+    key_len = 4
+    table = list(np.random.RandomState(3).permutation(1 << key_len))
+    q = cpu_factory(key_len)
+    shots = alg.pearson_hash_demo(q, table, key_len)
+    # unitary hash of a uniform superposition stays uniform over outputs
+    assert sum(shots.values()) == 64
+    assert set(shots) <= set(range(1 << key_len))
+
+
+def test_quantum_perceptron_learns_not():
+    q = cpu_factory(2)
+    acc = alg.quantum_perceptron(q, 0, 1)
+    assert acc == 1.0
+
+
+def test_quantum_associative_memory_recalls():
+    q = cpu_factory(3)
+    patterns = [(0b00, False), (0b01, True), (0b10, True), (0b11, False)]
+    acc = alg.quantum_associative_memory(q, patterns, 2, 2)
+    assert acc == 1.0
+
+
+def test_cosmology_inflation_grows():
+    widths = alg.cosmology_inflation(cpu_factory, 6, QrackRandom(5))
+    assert widths == list(range(1, 8))
+
+
+def test_separability_demo_on_qunit():
+    q = QUnit(4, unit_factory=cpu_factory, rng=QrackRandom(2),
+              rand_global_phase=False)
+    out = alg.separability_demo(q)
+    assert out["separable"]
+    assert out["final_units"] == 4
