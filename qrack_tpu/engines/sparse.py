@@ -266,6 +266,139 @@ class QEngineSparse(QInterface):
         v = (self._idx >> start) & ((1 << length) - 1)
         self._amp = np.where(v < greater_perm, -self._amp, self._amp)
 
+    # -- out-of-place arithmetic as forward maps over the nonzero list
+    #    (reference kernels mul/div/*modnout, qheader_alu.cl; amplitudes
+    #    outside the contract subspace are dropped, per reference) -----
+
+    def _ctrl_keep(self, controls):
+        if not controls:
+            return np.ones_like(self._idx, dtype=bool)
+        cmask = 0
+        for c in controls:
+            cmask |= 1 << c
+        return (self._idx & cmask) == cmask
+
+    def _apply_oop(self, fire, keep, new_idx) -> None:
+        """Entries where fire&keep map to new_idx; fire&~keep drop;
+        ~fire pass through."""
+        ok = ~fire | keep
+        idx = np.where(fire, new_idx, self._idx)[ok]
+        amp = self._amp[ok]
+        self._idx, self._amp = idx, amp
+        self._sort()
+
+    def MUL(self, to_mul: int, in_out_start: int, carry_start: int, length: int) -> None:
+        self.CMUL(to_mul, in_out_start, carry_start, length, ())
+
+    def CMUL(self, to_mul, in_out_start, carry_start, length, controls) -> None:
+        if to_mul == 1 or not length:
+            return
+        lm = (1 << length) - 1
+        fire = self._ctrl_keep(tuple(controls))
+        x = (self._idx >> in_out_start) & lm
+        c = (self._idx >> carry_start) & lm
+        prod = x * int(to_mul)
+        ni = alu._reg_set(np, self._idx, in_out_start, length, prod & lm)
+        ni = alu._reg_set(np, ni, carry_start, length, (prod >> length) & lm)
+        self._apply_oop(fire, c == 0, ni)
+
+    def DIV(self, to_div: int, in_out_start: int, carry_start: int, length: int) -> None:
+        self.CDIV(to_div, in_out_start, carry_start, length, ())
+
+    def CDIV(self, to_div, in_out_start, carry_start, length, controls) -> None:
+        if to_div == 1 or not length:
+            return
+        lm = (1 << length) - 1
+        fire = self._ctrl_keep(tuple(controls))
+        x = (self._idx >> in_out_start) & lm
+        c = (self._idx >> carry_start) & lm
+        combined = (c << length) | x
+        keep = (combined % int(to_div)) == 0
+        q = combined // int(to_div)
+        keep &= q <= lm
+        ni = alu._reg_set(np, self._idx, in_out_start, length, q & lm)
+        ni = alu._reg_set(np, ni, carry_start, length, np.zeros_like(q))
+        self._apply_oop(fire, keep, ni)
+
+    def _mod_res(self, x, fn):
+        ux, inv = np.unique(x, return_inverse=True)
+        return np.asarray([fn(int(v)) for v in ux], dtype=np.int64)[inv]
+
+    def _modnout(self, res_fn, mod_n, in_start, out_start, length, controls,
+                 inverse: bool) -> None:
+        ol = self._mod_out_length(mod_n)
+        lm = (1 << length) - 1
+        om = (1 << ol) - 1
+        fire = self._ctrl_keep(tuple(controls))
+        x = (self._idx >> in_start) & lm
+        out = (self._idx >> out_start) & om
+        res = self._mod_res(x, res_fn)
+        if inverse:
+            keep = out == res
+            ni = alu._reg_set(np, self._idx, out_start, ol, np.zeros_like(res))
+        else:
+            keep = out == 0
+            ni = alu._reg_set(np, self._idx, out_start, ol, res)
+        self._apply_oop(fire, keep, ni)
+
+    def MULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
+        self._modnout(lambda v: (v * to_mul) % mod_n, mod_n,
+                      in_start, out_start, length, (), False)
+
+    def IMULModNOut(self, to_mul, mod_n, in_start, out_start, length) -> None:
+        self._modnout(lambda v: (v * to_mul) % mod_n, mod_n,
+                      in_start, out_start, length, (), True)
+
+    def CMULModNOut(self, to_mul, mod_n, in_start, out_start, length, controls) -> None:
+        self._modnout(lambda v: (v * to_mul) % mod_n, mod_n,
+                      in_start, out_start, length, tuple(controls), False)
+
+    def CIMULModNOut(self, to_mul, mod_n, in_start, out_start, length, controls) -> None:
+        self._modnout(lambda v: (v * to_mul) % mod_n, mod_n,
+                      in_start, out_start, length, tuple(controls), True)
+
+    def POWModNOut(self, base, mod_n, in_start, out_start, length) -> None:
+        self._modnout(lambda v: pow(base, v, mod_n), mod_n,
+                      in_start, out_start, length, (), False)
+
+    def CPOWModNOut(self, base, mod_n, in_start, out_start, length, controls) -> None:
+        self._modnout(lambda v: pow(base, v, mod_n), mod_n,
+                      in_start, out_start, length, tuple(controls), False)
+
+    def IndexedLDA(self, index_start, index_length, value_start, value_length,
+                   values, reset_value: bool = True) -> int:
+        if reset_value:
+            self.SetReg(value_start, value_length, 0)
+        tbl = np.asarray(values, dtype=np.int64)
+        # XOR-load is self-inverse, so the gather source map IS the
+        # forward map
+        self._idx = alu.indexed_lda_src(
+            np, self._idx, index_start, index_length, value_start,
+            value_length, tbl)
+        self._sort()
+        return int(round(self.ExpectationBitsAll(
+            list(range(value_start, value_start + value_length)))))
+
+    def IndexedADC(self, index_start, index_length, value_start, value_length,
+                   carry_index, values) -> int:
+        tbl = np.asarray(values, dtype=np.int64)
+        self._idx = alu.indexed_adc_src(
+            np, self._idx, index_start, index_length, value_start,
+            value_length, carry_index, tbl, sign=-1)
+        self._sort()
+        return int(round(self.ExpectationBitsAll(
+            list(range(value_start, value_start + value_length)))))
+
+    def IndexedSBC(self, index_start, index_length, value_start, value_length,
+                   carry_index, values) -> int:
+        tbl = np.asarray(values, dtype=np.int64)
+        self._idx = alu.indexed_adc_src(
+            np, self._idx, index_start, index_length, value_start,
+            value_length, carry_index, tbl, sign=1)
+        self._sort()
+        return int(round(self.ExpectationBitsAll(
+            list(range(value_start, value_start + value_length)))))
+
     # ------------------------------------------------------------------
     # structure / state
     # ------------------------------------------------------------------

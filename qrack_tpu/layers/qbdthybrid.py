@@ -144,3 +144,20 @@ class QBdtHybrid(QInterface):
     def Finish(self) -> None:
         if self.engine is not None:
             self.engine.Finish()
+
+
+# heavy ALU / indexed ops: the tree gains nothing from them — hand the
+# ket to the dense engine's vectorized kernels (reference: QBdtHybrid
+# forwards through its engine half, include/qbdthybrid.hpp)
+for _name in ("IndexedLDA", "IndexedADC", "IndexedSBC", "Hash",
+              "MUL", "DIV", "CMUL", "CDIV", "MULModNOut", "IMULModNOut",
+              "CMULModNOut", "CIMULModNOut", "POWModNOut", "CPOWModNOut"):
+    def _mk_engine_fwd(n):
+        def fwd(self, *args, **kw):
+            self.SwitchToEngine()
+            return getattr(self.engine, n)(*args, **kw)
+
+        fwd.__name__ = n
+        return fwd
+
+    setattr(QBdtHybrid, _name, _mk_engine_fwd(_name))

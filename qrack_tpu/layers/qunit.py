@@ -770,10 +770,104 @@ class QUnit(QInterface):
         if isinstance(qubits, (int, np.integer)):
             qubits = (int(qubits),)
         tol = error_tol if error_tol is not None else self.sep_threshold
+        if len(qubits) == 2:
+            return self._try_separate_2qb(qubits[0], qubits[1], tol)
         ok = True
         for q in qubits:
             ok &= self._try_separate_1qb(q, tol)
         return ok
+
+    def _try_separate_2qb(self, q1: int, q2: int, tol: float) -> bool:
+        """Two-qubit separation by controlled inverse state preparation
+        (reference: src/qunit.cpp:781-856): estimate qubit2's Bloch
+        vector conditioned on each value of qubit1, conditionally rotate
+        both branches to the pole, attempt 1-qubit separations, then
+        restore the state by re-applying the preparations at the logical
+        level (where a successful separation makes them cheap buffered/
+        trimmed gates).  Non-destructive when separation fails."""
+        self._check_qubit(q1)
+        self._check_qubit(q2)
+        s1, s2 = self.shards[q1], self.shards[q2]
+        if s1.cached or s2.cached or s1.unit is not s2.unit:
+            ok1 = self._try_separate_1qb(q1, tol)
+            ok2 = self._try_separate_1qb(q2, tol)
+            return ok1 and ok2
+        self._flush(q1)
+        self._flush(q2)
+        s1, s2 = self.shards[q1], self.shards[q2]
+        if s1.cached or s2.cached or s1.unit is not s2.unit:
+            ok1 = self._try_separate_1qb(q1, tol)
+            ok2 = self._try_separate_1qb(q2, tol)
+            return ok1 and ok2
+        unit, m1, m2 = s1.unit, s1.mapped, s2.mapped
+        # "controlled inverse state preparation": estimate qubit2's
+        # conditional Bloch vector (Z; X via H frame; Y via H.S^dag
+        # frame, each conjugation undone) and rotate each branch to |0>.
+        # The reference's probe sequence (src/qunit.cpp:825-833) layers
+        # CH then CS without undoing, which re-measures <X> — here the
+        # frames conjugate correctly so <Y> is really <Y>.
+        cm, tm = 1 << m1, 1 << m2
+        angles = []
+        for anti in (False, True):
+            ch = unit.AntiCH if anti else unit.CH
+            cphase = unit.MACPhase if anti else unit.MCPhase
+            cval = 0 if anti else cm
+            # the control marginal is invariant under target rotations:
+            # one denominator per branch, ProbMask kernel reductions only
+            denom = unit.ProbMask(cm, cval)
+
+            def cprob_t1():
+                if denom <= FP_NORM_EPSILON:
+                    return 0.5
+                return min(1.0, unit.ProbMask(cm | tm, cval | tm) / denom)
+
+            z = 1.0 - 2.0 * cprob_t1()
+            ch(m1, m2)
+            x = 1.0 - 2.0 * cprob_t1()
+            ch(m1, m2)
+            cphase((m1,), 1.0, -1j, m2)   # (anti)controlled S^dag
+            ch(m1, m2)
+            y = 1.0 - 2.0 * cprob_t1()
+            ch(m1, m2)
+            cphase((m1,), 1.0, 1j, m2)    # undo
+            inclination = math.atan2(math.hypot(x, y), z)
+            azimuth = math.atan2(y, x)
+            (unit.AntiCIAI if anti else unit.CIAI)(m1, m2, azimuth, inclination)
+            angles.append((azimuth, inclination))
+        # q2's conditional branches were both rotated to |0>, so probe it
+        # first: its separation shrinks the unit and releases q1's (pure
+        # but possibly off-axis) state into the cached shard
+        ok2 = self._try_separate_1qb(q2, tol)
+        ok1 = self._try_separate_1qb(q1, tol)
+        if ok1 and ok2:
+            # separation proved the state is a product, so both branch
+            # rotations prepare the SAME q2 state (or only one branch is
+            # live): restore with one unconditional rotation — no merge
+            def bloch(azim, incl):
+                return (math.sin(incl) * math.cos(azim),
+                        math.sin(incl) * math.sin(azim), math.cos(incl))
+
+            z1 = self._logical_z_value(self.shards[q1])
+            if z1 == 1:
+                self.AI(q2, *angles[0])
+            elif z1 == 0:
+                self.AI(q2, *angles[1])
+            else:
+                v0, v1 = bloch(*angles[0]), bloch(*angles[1])
+                if max(abs(a - b) for a, b in zip(v0, v1)) < 1e-6:
+                    self.AI(q2, *angles[0])
+                else:
+                    # branches genuinely differ (e.g. a Bell pair whose
+                    # conditionals are pure): the exact restore below
+                    # re-entangles, so the pair did NOT end separated
+                    self.AntiCAI(q1, q2, *angles[1])
+                    self.CAI(q1, q2, *angles[0])
+                    return False
+            return True
+        # failure: exactly undo the unit-level derotations
+        self.AntiCAI(q1, q2, *angles[1])
+        self.CAI(q1, q2, *angles[0])
+        return False
 
     def _try_separate_1qb(self, q: int, tol: float) -> bool:
         """Probe the *base* (engine) state of q for separability; the

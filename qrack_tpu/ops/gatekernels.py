@@ -151,20 +151,26 @@ def apply_4x4(planes, mp4, n: int, q1: int, q2: int):
 def uc_2x2(planes, mps, n: int, target: int, controls):
     """Uniformly-controlled gate: per-control-permutation payloads
     (reference kernel uniformlycontrolled, qengine.cl:409).
-    mps: (2, 2^k, 2, 2) matrix planes."""
-    idx = iota_for(planes)
-    key = jnp.zeros_like(idx)
-    for j, c in enumerate(controls):
-        key = key | (((idx >> c) & 1) << j)
-    bit = (idx >> target) & 1
-    partner = idx ^ (1 << target)
-    ps = planes[:, partner]
+    mps: (2, 2^k, 2, 2) matrix planes.
+
+    Expressed as a batched 2x2 matmul over the control-key axis
+    (reshape/transpose bit->axis form) — no per-element gathers, so XLA
+    keeps it on the MXU instead of scatter/gather units."""
+    k = len(controls)
+    t = planes.reshape((2,) + (2,) * n)
+    # qubit q lives on tensor axis 1 + (n - 1 - q)
+    caxes = [1 + n - 1 - c for c in list(controls)[::-1]]
+    tax = 1 + n - 1 - target
+    rest = [a for a in range(1, n + 1) if a not in caxes and a != tax]
+    perm = [0] + caxes + [tax] + rest
+    v = jnp.transpose(t, perm).reshape(2, 1 << k, 2, -1)
     re, im = mps[0], mps[1]  # [2^k, 2, 2]
-    d_re = jnp.where(bit == 0, re[key, 0, 0], re[key, 1, 1])
-    d_im = jnp.where(bit == 0, im[key, 0, 0], im[key, 1, 1])
-    o_re = jnp.where(bit == 0, re[key, 0, 1], re[key, 1, 0])
-    o_im = jnp.where(bit == 0, im[key, 0, 1], im[key, 1, 0])
-    return cmul(d_re, d_im, planes) + cmul(o_re, o_im, ps)
+    vr, vi = v[0], v[1]
+    outr = jnp.einsum("kab,kbr->kar", re, vr) - jnp.einsum("kab,kbr->kar", im, vi)
+    outi = jnp.einsum("kab,kbr->kar", re, vi) + jnp.einsum("kab,kbr->kar", im, vr)
+    out = jnp.stack([outr, outi]).reshape((2,) + (2,) * n)
+    inv = np.argsort(np.asarray(perm))
+    return jnp.transpose(out, list(inv)).reshape(2, -1)
 
 
 def phase_factor_apply(planes, fre, fim):

@@ -63,6 +63,18 @@ def _full_stack(n, **kw):
     return QUnit(n, unit_factory=sh_factory, **kw)
 
 
+def _sparse(n, **kw):
+    from qrack_tpu.engines.sparse import QEngineSparse
+
+    return QEngineSparse(n, **kw)
+
+
+def _bdt_hybrid(n, **kw):
+    from qrack_tpu.layers.qbdthybrid import QBdtHybrid
+
+    return QBdtHybrid(n, **kw)
+
+
 ENGINE_FACTORIES = {
     "tpu": lambda n, **kw: QEngineTPU(n, **kw),
     "pager": _pager,
@@ -70,7 +82,75 @@ ENGINE_FACTORIES = {
     "stabhybrid": _stabhybrid,
     "qunit": _qunit,
     "full_stack": _full_stack,
+    "sparse": _sparse,
+    "bdt_hybrid": _bdt_hybrid,
 }
+
+
+def _stabilizer(n, **kw):
+    from qrack_tpu.layers.stabilizer import QStabilizer
+
+    kw.pop("rand_global_phase", None)
+    return QStabilizer(n, **kw)
+
+
+def _unit_clifford(n, **kw):
+    from qrack_tpu.layers.qunitclifford import QUnitClifford
+
+    return QUnitClifford(n, **kw)
+
+
+# Clifford-restricted battery x Clifford-capable matrix: QUnitClifford
+# (and the bare tableau) reject non-Clifford payloads, so they get their
+# own shared battery (reference: --proc-stabilizer layer flags run the
+# same suite restricted to what the stack supports, test/test_main.cpp)
+CLIFFORD_FACTORIES = {
+    "stabilizer": _stabilizer,
+    "unit_clifford": _unit_clifford,
+    "stabhybrid": _stabhybrid,
+    "qunit_over_stabhybrid": _full_stack,
+}
+
+
+def random_clifford_circuit(q, rng, gates, n):
+    for _ in range(gates):
+        kind = rng.randint(0, 7)
+        t = rng.randint(0, n)
+        if kind == 0:
+            q.H(t)
+        elif kind == 1:
+            q.S(t)
+        elif kind == 2:
+            q.X(t)
+        elif kind == 3:
+            q.Z(t)
+        elif kind == 4:
+            q.Y(t)
+        else:
+            c = rng.randint(0, n)
+            if c != t:
+                q.CNOT(c, t) if kind == 5 else q.CZ(c, t)
+
+
+@pytest.mark.parametrize("name", list(CLIFFORD_FACTORIES))
+def test_clifford_battery_matches_oracle(name):
+    n = 6
+    for seed in (31, 32):
+        o = oracle(n, rng=QrackRandom(seed), rand_global_phase=False)
+        q = CLIFFORD_FACTORIES[name](n, rng=QrackRandom(seed),
+                                     rand_global_phase=False)
+        random_clifford_circuit(o, QrackRandom(700 + seed), 40, n)
+        random_clifford_circuit(q, QrackRandom(700 + seed), 40, n)
+        got = align_phase(np.asarray(q.GetQuantumState(), dtype=np.complex128),
+                          np.asarray(o.GetQuantumState(), dtype=np.complex128))
+        np.testing.assert_allclose(got, o.GetQuantumState(), atol=2e-5)
+        # measurement parity on the shared stream
+        q2 = CLIFFORD_FACTORIES[name](n, rng=QrackRandom(seed),
+                                      rand_global_phase=False)
+        o2 = oracle(n, rng=QrackRandom(seed), rand_global_phase=False)
+        random_clifford_circuit(o2, QrackRandom(800 + seed), 30, n)
+        random_clifford_circuit(q2, QrackRandom(800 + seed), 30, n)
+        assert abs(q2.Prob(2) - o2.Prob(2)) < 2e-5
 
 
 def oracle(n, **kw):

@@ -218,3 +218,32 @@ def test_wide_sparse_circuit():
     q.rng.seed(1)
     r = q.MAll()
     assert isinstance(r, int)
+
+
+def test_two_qubit_cnot_probe_separation():
+    """Reference: 2-qubit TrySeparate via controlled inverse state prep
+    (src/qunit.cpp:781) — separates product pairs whose factors are NOT
+    X/Y/Z eigenstates (the 1-qubit probes cannot)."""
+    q = make(3, 11)
+    o = oracle(3, 11)
+    for eng in (q, o):
+        eng.RY(0.3, 0)
+        eng.RY(0.7, 1)
+        eng.CNOT(0, 1)
+        eng.CNOT(0, 1)   # net identity, but the unit stays merged
+    assert any(not s.cached for s in q.shards[:2])
+    assert not q._try_separate_1qb(0, 1e-8)  # 1q probes fail off-axis
+    assert q.TrySeparate((0, 1))
+    assert q.shards[0].cached and q.shards[1].cached
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_two_qubit_probe_nondestructive_on_entangled():
+    q = make(2, 13)
+    o = oracle(2, 13)
+    for eng in (q, o):
+        eng.H(0)
+        eng.CNOT(0, 1)
+        eng.RY(0.4, 1)
+    assert not q.TrySeparate((0, 1))
+    assert fid(q, o) == pytest.approx(1.0, abs=1e-7)
