@@ -39,6 +39,7 @@ WIDTH = int(os.environ.get("QRACK_BENCH_QB", "26"))
 FIRST_WIDTH = int(os.environ.get("QRACK_BENCH_QB_FIRST", "20"))
 DEPTH = int(os.environ.get("QRACK_BENCH_DEPTH", "8"))
 SAMPLES = int(os.environ.get("QRACK_BENCH_SAMPLES", "5"))
+DTYPE = os.environ.get("QRACK_BENCH_DTYPE", "float32")  # float32 | bfloat16
 BUDGET = float(os.environ.get("QRACK_BENCH_BUDGET", "480"))
 BASELINE_FILE = os.path.join(HERE, "bench_baseline.json")
 
@@ -55,16 +56,25 @@ def _workload_key() -> str:
     return f"{WORKLOAD}_d{DEPTH}"
 
 
+def _bench_dtype():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if DTYPE == "bfloat16" else jnp.float32
+
+
 def _make_fn(width: int):
     from qrack_tpu.models import qft as qftm
 
     if WORKLOAD not in ("qft", "rcs", "xeb"):
         raise ValueError(f"unknown QRACK_BENCH workload {WORKLOAD!r}")
+    dt = _bench_dtype()
     if WORKLOAD in ("rcs", "xeb"):
         from qrack_tpu.models import rcs as rcsm
 
-        return rcsm.make_rcs_fn(width, DEPTH, seed=7), qftm.basis_planes(width, 0)
-    return qftm.make_qft_fn(width), qftm.basis_planes(width, 12345 & ((1 << width) - 1))
+        return (rcsm.make_rcs_fn(width, DEPTH, seed=7),
+                qftm.basis_planes(width, 0, dtype=dt))
+    return (qftm.make_qft_fn(width),
+            qftm.basis_planes(width, 12345 & ((1 << width) - 1), dtype=dt))
 
 
 def _xeb_from_planes(planes, width: int, shots: int = 2000) -> float:
@@ -76,6 +86,7 @@ def _xeb_from_planes(planes, width: int, shots: int = 2000) -> float:
     import jax.numpy as jnp
 
     def body(pl):
+        pl = pl.astype(jnp.float32)  # bf16 CDFs lose too much precision
         p = pl[0] * pl[0] + pl[1] * pl[1]
         p = p / jnp.sum(p)
         cdf = jnp.cumsum(p)
@@ -168,7 +179,9 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     vs = (round(base_s / stats["avg"], 3)
           if (base_s and stats["avg"] > 0) else None)
     line = {
-        "metric": f"{_workload_key()}_w{width}_fused_wall{label_suffix}",
+        "metric": (f"{_workload_key()}_w{width}_fused_wall"
+                   + ("_bf16" if DTYPE == "bfloat16" else "")
+                   + label_suffix),
         "value": round(stats["avg"], 6),
         "unit": "s",
         "vs_baseline": vs,

@@ -124,28 +124,49 @@ class QCircuit:
             for perm, m in g.payloads.items():
                 qsim.MCMtrxPerm(g.controls, m, g.target, perm)
 
+    def _check_fused_range(self, n: int) -> None:
+        # the per-gate path validates through _check_qubit; the fused
+        # paths must reject out-of-range qubits just as loudly
+        for g in self.gates:
+            for q in g.qubits():
+                if q < 0 or q >= n:
+                    raise ValueError(f"qubit index {q} out of range (n={n})")
+
     def RunFused(self, qsim) -> None:
         """Execute, preferring one fused XLA program when the target is a
-        plane-backed dense engine (single-chip TPU) — per-gate dispatch
-        otherwise. The TPU-native analogue of the reference's queued
-        kernel chain collapsing into one submission."""
+        plane-backed dense engine: single-chip TPU kets lower through
+        `compile_fn`, paged kets through `compile_sharded_fn` (the whole
+        circuit as one shard_map executable over the 'pages' mesh) —
+        per-gate dispatch otherwise. The TPU-native analogue of the
+        reference's queued kernel chain collapsing into one submission."""
+        from ..engines.hybrid import QHybrid
         from ..engines.tpu import QEngineTPU
+        from ..parallel.pager import QPager
 
+        if isinstance(qsim, QHybrid):
+            # fuse onto whatever engine the width switch currently holds
+            inner = qsim._engine
+            if isinstance(inner, (QEngineTPU, QPager)):
+                return self.RunFused(inner)
         if isinstance(qsim, QEngineTPU) and self.gates:
             import jax
 
             n = qsim.qubit_count
-            # the per-gate path validates through _check_qubit; the fused
-            # path must reject out-of-range qubits just as loudly
-            for g in self.gates:
-                for q in g.qubits():
-                    if q < 0 or q >= n:
-                        raise ValueError(
-                            f"qubit index {q} out of range (n={n})")
+            self._check_fused_range(n)
             fn = self._fused_cache.get(n)
             if fn is None:
                 fn = jax.jit(self.compile_fn(n), donate_argnums=(0,))
                 self._fused_cache[n] = fn
+            qsim._state = fn(qsim._state)
+            return
+        if isinstance(qsim, QPager) and self.gates:
+            n = qsim.qubit_count
+            self._check_fused_range(n)
+            key = (n, id(qsim.mesh))
+            fn = self._fused_cache.get(key)
+            if fn is None:
+                fn, _ = self.compile_sharded_fn(qsim.mesh, n)
+                self._fused_cache[key] = fn
             qsim._state = fn(qsim._state)
             return
         self.Run(qsim)

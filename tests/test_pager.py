@@ -272,3 +272,51 @@ def test_mesh_shrinks_and_regrows():
     p.Compose(p2)
     assert p.g_bits == 2  # mesh re-grew to construction page count
     assert_match(o, p)
+
+
+def test_runfused_lowers_onto_pager_mesh():
+    """Buffered circuits materialize through ONE sharded executable when
+    the stack bottoms out on a paged ket (ROADMAP: compile_sharded_fn
+    wired into RunFused)."""
+    from qrack_tpu.layers.qcircuit import QCircuit
+    from qrack_tpu import matrices as mat_
+
+    n = 7
+    o, p = make_pair(n, seed=21, n_pages=4)
+    c = QCircuit(n)
+    c.append_1q(0, mat_.H2)
+    c.append_ctrl((0,), n - 1, mat_.X2, 1)   # local ctrl -> paged target
+    c.append_ctrl((n - 1,), 2, mat_.X2, 1)   # paged ctrl -> local target
+    c.append_1q(n - 1, mat_.T2)
+    # trip-wire: the fused path must not fall back to per-gate dispatch
+    calls = []
+    orig = type(p)._k_apply_2x2
+    type(p)._k_apply_2x2 = lambda self, *a, **k: calls.append(1) or orig(self, *a, **k)
+    try:
+        c.RunFused(p)
+    finally:
+        type(p)._k_apply_2x2 = orig
+    assert not calls, "pager RunFused fell back to per-gate dispatch"
+    c.Run(o)
+    assert_match(o, p)
+
+
+def test_tensornetwork_over_pager_materializes_fused():
+    from qrack_tpu.layers.qtensornetwork import QTensorNetwork
+
+    n = 6
+    o = QEngineCPU(n, rng=QrackRandom(3), rand_global_phase=False)
+    t = QTensorNetwork(
+        n, stack_factory=lambda m, **kw: QPager(m, n_pages=4, **kw),
+        rng=QrackRandom(3), rand_global_phase=False)
+    for eng in (o, t):
+        eng.H(0)
+        eng.CNOT(0, n - 1)
+        eng.T(n - 1)
+        eng.CNOT(n - 1, 1)
+    # measurement materializes the buffered segment through RunFused
+    t.rng.seed(5)
+    o.rng.seed(5)
+    assert t.M(1) == o.M(1)
+    np.testing.assert_allclose(t.GetQuantumState(), o.GetQuantumState(),
+                               atol=3e-5)
