@@ -59,6 +59,9 @@ def _workload_key() -> str:
 def _bench_dtype():
     import jax.numpy as jnp
 
+    if DTYPE not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown QRACK_BENCH_DTYPE {DTYPE!r} "
+                         "(use float32 or bfloat16)")
     return jnp.bfloat16 if DTYPE == "bfloat16" else jnp.float32
 
 

@@ -153,10 +153,22 @@ class QCircuit:
 
             n = qsim.qubit_count
             self._check_fused_range(n)
-            fn = self._fused_cache.get(n)
+            import os
+
+            use_pallas = os.environ.get("QRACK_USE_PALLAS") == "1"
+            key = (n, use_pallas)
+            fn = self._fused_cache.get(key)
             if fn is None:
-                fn = jax.jit(self.compile_fn(n), donate_argnums=(0,))
-                self._fused_cache[n] = fn
+                if use_pallas:
+                    # pallas lowers natively on TPU; elsewhere (tests,
+                    # CPU installs) run the same kernel interpreted
+                    body = self.compile_fn_pallas(
+                        n,
+                        interpret=jax.default_backend() not in ("tpu", "axon"))
+                else:
+                    body = self.compile_fn(n)
+                fn = jax.jit(body, donate_argnums=(0,))
+                self._fused_cache[key] = fn
             qsim._state = fn(qsim._state)
             return
         if isinstance(qsim, QPager) and self.gates:
@@ -251,6 +263,68 @@ class QCircuit:
             donate_argnums=(0,),
         )
         return fn, sharding
+
+    def compile_fn_pallas(self, n: int, block_pow: int = 16,
+                          interpret: bool = False):
+        """fn(planes) applying the circuit as fused Pallas gate-segment
+        sweeps (one HBM read+write per segment) with XLA-kernel bridges
+        for ops a tile cannot hold (non-diagonal high targets).  Opt-in:
+        see ops/pallas_kernels.py."""
+        from ..ops import gatekernels as gk
+        from ..ops import pallas_kernels as pk
+        from ..utils.bits import control_offset
+
+        bp = min(block_pow, n)
+        plan: List[Tuple] = []  # ("seg", ops) | ("xla", target, cmask, cval, m)
+        seg: List[Tuple] = []
+        for g in self.gates:
+            for perm, m in g.payloads.items():
+                cmask = 0
+                for c in g.controls:
+                    cmask |= 1 << c
+                cval = control_offset(g.controls, perm)
+                kind = "diag" if mat.is_phase(m) else "gen"
+                if pk.segment_compatible(kind, g.target, bp):
+                    seg.append((kind, g.target, cmask, cval, m))
+                else:
+                    if seg:
+                        plan.append(("seg", seg))
+                        seg = []
+                    plan.append(("xla", g.target, cmask, cval, m))
+        if seg:
+            plan.append(("seg", seg))
+
+        stages = []
+        for item in plan:
+            if item[0] == "seg":
+                stages.append(pk.make_segment_fn(item[1], n, block_pow=bp,
+                                                 interpret=interpret))
+            else:
+                _, target, cmask, cval, m = item
+                if mat.is_invert(m):
+                    tr, bl = complex(m[0, 1]), complex(m[1, 0])
+
+                    def xla_stage(planes, target=target, cmask=cmask,
+                                  cval=cval, tr=tr, bl=bl):
+                        return gk.apply_invert(planes, tr.real, tr.imag,
+                                               bl.real, bl.imag,
+                                               n, target, cmask, cval)
+                else:
+                    mp = gk.mtrx_planes(m)
+
+                    def xla_stage(planes, target=target, cmask=cmask,
+                                  cval=cval, mp=mp):
+                        return gk.apply_2x2(planes, mp.astype(planes.dtype),
+                                            n, target, cmask, cval)
+
+                stages.append(xla_stage)
+
+        def fn(planes):
+            for stage in stages:
+                planes = stage(planes)
+            return planes
+
+        return fn
 
     def compile_fn(self, n: int):
         """Return a pure jittable fn(planes) applying the whole circuit

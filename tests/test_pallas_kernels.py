@@ -1,0 +1,64 @@
+"""Pallas fused gate-segment sweep (interpret mode on CPU): parity with
+the XLA compile_fn path on random circuits."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu.layers.qcircuit import QCircuit
+from qrack_tpu.models import qft as qftm
+from qrack_tpu import matrices as mat
+from qrack_tpu.utils.rng import QrackRandom
+
+
+def build_circuit(n, seed, gates=30):
+    rng = QrackRandom(seed)
+    c = QCircuit(n)
+    for _ in range(gates):
+        kind = rng.randint(0, 5)
+        t = rng.randint(0, n)
+        if kind == 0:
+            c.append_1q(t, mat.H2)
+        elif kind == 1:
+            c.append_1q(t, mat.T2)
+        elif kind == 2:
+            c.append_1q(t, np.asarray(mat.X2))
+        elif kind == 3:
+            ctl = rng.randint(0, n)
+            if ctl != t:
+                c.append_ctrl((ctl,), t, np.diag([1.0, -1.0 + 0j]), 1)  # CZ
+        else:
+            ctl = rng.randint(0, n)
+            if ctl != t:
+                c.append_ctrl((ctl,), t, np.asarray(mat.X2), 1)  # CNOT
+    return c
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_pallas_segments_match_xla(seed):
+    import jax
+
+    n = 8
+    c = build_circuit(n, seed)
+    planes = qftm.basis_planes(n, 5)
+    want = np.asarray(jax.jit(c.compile_fn(n))(planes))
+    # tiny tiles force multi-block grids AND high-target bridges
+    for bp in (4, 6, n):
+        got = np.asarray(c.compile_fn_pallas(n, block_pow=bp,
+                                             interpret=True)(planes))
+        np.testing.assert_allclose(got, want, atol=3e-5, err_msg=f"bp={bp}")
+
+
+def test_pallas_high_diag_and_controls():
+    import jax
+
+    n = 7
+    c = QCircuit(n)
+    c.append_1q(0, mat.H2)
+    c.append_1q(n - 1, mat.H2)
+    c.append_ctrl((n - 1,), 0, np.diag([1.0, 1j]), 1)   # high control, diag
+    c.append_1q(n - 1, mat.T2)                          # high diag target
+    c.append_ctrl((0,), 1, np.asarray(mat.X2), 1)
+    planes = qftm.basis_planes(n, 0)
+    want = np.asarray(jax.jit(c.compile_fn(n))(planes))
+    got = np.asarray(c.compile_fn_pallas(n, block_pow=4, interpret=True)(planes))
+    np.testing.assert_allclose(got, want, atol=3e-5)
