@@ -310,11 +310,12 @@ def test_runfused_validates_and_caches():
     c2.append_1q(0, mat.H2)
     e2 = QEngineTPU(3, rng=QrackRandom(2), rand_global_phase=False)
     c2.RunFused(e2)
-    first = c2._fused_cache[3]
+    key = (3, False)  # (width, use_pallas)
+    first = c2._fused_cache[key]
     c2.RunFused(e2)
-    assert c2._fused_cache[3] is first
+    assert c2._fused_cache[key] is first
     c2.append_1q(1, mat.H2)
-    assert 3 not in c2._fused_cache
+    assert key not in c2._fused_cache
 
 
 def test_tensornetwork_rebuffers_after_measurement():
