@@ -51,9 +51,15 @@ def _remaining() -> float:
 
 
 def _workload_key() -> str:
-    if WORKLOAD == "qft":
-        return "qft"
+    if WORKLOAD in ("qft", "qft_unit"):
+        return WORKLOAD
     return f"{WORKLOAD}_d{DEPTH}"
+
+
+def _baseline_key() -> str:
+    # the optimizer-stack workload compares against the reference's
+    # QUnit-stack row, not the dense engine
+    return {"qft_unit": "qft_optimal"}.get(_workload_key(), _workload_key())
 
 
 def _bench_dtype():
@@ -68,7 +74,7 @@ def _bench_dtype():
 def _make_fn(width: int):
     from qrack_tpu.models import qft as qftm
 
-    if WORKLOAD not in ("qft", "rcs", "xeb"):
+    if WORKLOAD not in ("qft", "rcs", "xeb", "qft_unit"):
         raise ValueError(f"unknown QRACK_BENCH workload {WORKLOAD!r}")
     dt = _bench_dtype()
     if WORKLOAD in ("rcs", "xeb"):
@@ -118,8 +124,29 @@ def _stats(times):
     }
 
 
+def _measure_unit_stack(width: int, samples: int):
+    """Optimizer-stack QFT (reference protocol row "QUnit -> ...",
+    test_qft_permutation_init): basis init + QFT + Finish per sample.
+    Phase fusion keeps the whole circuit in buffered links, so this
+    never touches an engine (safe even with a wedged TPU tunnel)."""
+    from qrack_tpu.layers.qunit import QUnit
+    from qrack_tpu.utils.rng import QrackRandom
+
+    times = []
+    for s in range(samples + 1):
+        q = QUnit(width, rng=QrackRandom(s), rand_global_phase=False)
+        q.SetPermutation(12345 & ((1 << width) - 1))
+        t0 = time.perf_counter()
+        q.QFT(0, width)
+        q.Finish()
+        times.append(time.perf_counter() - t0)
+    return _stats(times[1:])  # first sample excluded (interpreter warmup)
+
+
 def _measure(width: int, samples: int):
     """Compile + warm-run once (excluded), then time `samples` runs."""
+    if WORKLOAD == "qft_unit":
+        return _measure_unit_stack(width, samples)
     import jax
 
     plat = os.environ.get("QRACK_BENCH_PLATFORM")
@@ -165,7 +192,7 @@ def _load_baseline():
 
 def _baseline_seconds(width: int):
     """Best-available baseline for (workload, width): reference C++ first."""
-    entry = _load_baseline().get(_workload_key(), {}).get(str(width))
+    entry = _load_baseline().get(_baseline_key(), {}).get(str(width))
     if entry:
         return float(entry["seconds"]), entry.get("source", "unknown")
     return None, None
@@ -182,7 +209,7 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     vs = (round(base_s / stats["avg"], 3)
           if (base_s and stats["avg"] > 0) else None)
     line = {
-        "metric": (f"{_workload_key()}_w{width}_fused_wall"
+        "metric": (f"{_workload_key()}_w{width}_wall"
                    + ("_bf16" if DTYPE == "bfloat16" else "")
                    + label_suffix),
         "value": round(stats["avg"], 6),
