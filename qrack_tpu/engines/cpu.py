@@ -106,7 +106,7 @@ class QEngineCPU(QEngine):
         new[dst_idx] = self._state[src_idx]
         self._state = new
 
-    def _k_phase_fn(self, fn) -> None:
+    def _k_phase_fn(self, fn, split=None) -> None:
         fre, fim = fn(np, self._idx)
         if np.isscalar(fim) and fim == 0.0:
             # pure-real factor (Z/phase flips): skip the complex promote

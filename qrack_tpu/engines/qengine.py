@@ -43,6 +43,16 @@ from .. import matrices as mat
 from ..utils.bits import bit_reg_mask, log2, is_pow2
 
 
+def _parity_rz_split(mask):
+    """Shared split-index body for the parity-phase family: factor
+    cc + i*(±ss) selected on the parity of (index & mask); PhaseParity
+    and UniformParityRZ differ only in their host-side angle prep."""
+    def body(xp, pid, lidx, L, cc, ss):
+        par = alu.split_parity(xp, pid, lidx, L, mask)
+        return cc, xp.where(par == 1, ss, -ss)
+    return body
+
+
 class QEngine(QInterface):
     """Dense-ket engine base; see module docstring for the kernel contract."""
 
@@ -86,7 +96,12 @@ class QEngine(QInterface):
             par = self._parity_of(xp, idx, mask)
             return xp.where(par == 1, -1.0, 1.0), 0.0
 
-        self._k_phase_fn(fn)
+        self._k_phase_fn(fn, split=(
+            ("zmask", mask),
+            lambda xp, pid, lidx, L: (
+                xp.where(alu.split_parity(xp, pid, lidx, L, mask) == 1, -1.0, 1.0),
+                0.0),
+            ()))
 
     @staticmethod
     def _parity_of(xp, idx, mask):
@@ -107,7 +122,7 @@ class QEngine(QInterface):
             par = self._parity_of(xp, idx, mask)
             return c, xp.where(par == 1, s_, -s_)
 
-        self._k_phase_fn(fn)
+        self._k_phase_fn(fn, split=(("parz", mask), _parity_rz_split(mask), (c, s_)))
 
     def Swap(self, q1: int, q2: int) -> None:
         if q1 == q2:
@@ -177,7 +192,12 @@ class QEngine(QInterface):
             par = self._parity_of(xp, idx, mask)
             return xp.where(par == want, scale, 0.0), 0.0
 
-        self._k_phase_fn(fn)
+        self._k_phase_fn(fn, split=(
+            ("forcempar", mask, want),
+            lambda xp, pid, lidx, L, sc: (
+                xp.where(alu.split_parity(xp, pid, lidx, L, mask) == want, sc, 0.0),
+                0.0),
+            (scale,)))
         return bool(result)
 
     def MAll(self) -> int:
@@ -296,12 +316,16 @@ class QEngine(QInterface):
     def MUL(self, to_mul: int, in_out_start: int, carry_start: int, length: int) -> None:
         if to_mul == 1 or not length:
             return
+        if getattr(self, "_wide_alu", False):
+            return self._muldiv_wide(to_mul, in_out_start, carry_start, length, False)
         src, dst = alu.mul_pair(self._xp, self.qubit_count, to_mul, in_out_start, carry_start, length)
         self._k_out_of_place(src, dst, None)
 
     def DIV(self, to_div: int, in_out_start: int, carry_start: int, length: int) -> None:
         if to_div == 1 or not length:
             return
+        if getattr(self, "_wide_alu", False):
+            return self._muldiv_wide(to_div, in_out_start, carry_start, length, True)
         src, dst = alu.mul_pair(self._xp, self.qubit_count, to_div, in_out_start, carry_start, length)
         self._k_out_of_place(dst, src, None)
 
@@ -311,6 +335,9 @@ class QEngine(QInterface):
             return self.MUL(to_mul, in_out_start, carry_start, length)
         if to_mul == 1 or not length:
             return
+        if getattr(self, "_wide_alu", False):
+            return self._muldiv_wide(to_mul, in_out_start, carry_start, length,
+                                     False, controls)
         src, dst = alu.mul_pair(self._xp, self.qubit_count, to_mul, in_out_start, carry_start, length)
         self._ctrl_out_of_place(src, dst, controls)
 
@@ -320,8 +347,35 @@ class QEngine(QInterface):
             return self.DIV(to_div, in_out_start, carry_start, length)
         if to_div == 1 or not length:
             return
+        if getattr(self, "_wide_alu", False):
+            return self._muldiv_wide(to_div, in_out_start, carry_start, length,
+                                     True, controls)
         src, dst = alu.mul_pair(self._xp, self.qubit_count, to_div, in_out_start, carry_start, length)
         self._ctrl_out_of_place(dst, src, controls)
+
+    def _muldiv_wide(self, to_mul, in_out_start, carry_start, length,
+                     inverse, controls=()) -> None:
+        """Width-generic MUL/DIV: the pair-scatter path builds full-width
+        host index arrays, so past int32 widths the same map runs as a
+        split-index gather with host-built product tables (reference
+        width-generic mul/div kernels, qheader_alu.cl:~260)."""
+        lo, hi, inv, k = alu.mul_tables(to_mul, length)
+        perm_all = (1 << len(controls)) - 1
+        src_split = alu.div_src_split if inverse else alu.mul_src_split
+
+        def body(xp, pid, lidx, L, lo_t, hi_t, inv_t):
+            sp, sl, keep = src_split(xp, pid, lidx, L, lo_t, hi_t, inv_t, k,
+                                     in_out_start, carry_start, length)
+            if controls:
+                ok = alu.split_ctrl_match(xp, pid, lidx, L, controls, perm_all)
+                sp = xp.where(ok, sp, pid)
+                sl = xp.where(ok, sl, lidx)
+                keep = keep | ~ok
+            return sp, sl, keep
+
+        key = ("divw" if inverse else "mulw", k,
+               in_out_start, carry_start, length, controls)
+        self._k_gather(None, split=(key, body, (lo, hi, inv)))
 
     def _ctrl_out_of_place(self, src, dst, controls) -> None:
         """Restrict an out-of-place map to the control-matching subspace;
@@ -511,17 +565,25 @@ class QEngine(QInterface):
     def PhaseFlipIfLess(self, greater_perm: int, start: int, length: int) -> None:
         self._k_phase_fn(
             lambda xp, idx: (alu.phase_flip_less_factor(
-                xp, idx, greater_perm, start, length), 0.0)
-        )
+                xp, idx, greater_perm, start, length), 0.0),
+            split=(("pfless", start, length),
+                   lambda xp, pid, lidx, L, gp: (alu.phase_flip_less_factor_split(
+                       xp, pid, lidx, L, gp, start, length), 0.0),
+                   (greater_perm,)))
 
     def CPhaseFlipIfLess(self, greater_perm: int, start: int, length: int, flag_index: int) -> None:
         self._k_phase_fn(
             lambda xp, idx: (alu.phase_flip_less_factor(
-                xp, idx, greater_perm, start, length, flag_index), 0.0)
-        )
+                xp, idx, greater_perm, start, length, flag_index), 0.0),
+            split=(("cpfless", start, length, flag_index),
+                   lambda xp, pid, lidx, L, gp: (alu.phase_flip_less_factor_split(
+                       xp, pid, lidx, L, gp, start, length, flag_index), 0.0),
+                   (greater_perm,)))
 
     def PhaseFlip(self) -> None:
-        self._k_phase_fn(lambda xp, idx: (-1.0, 0.0))
+        self._k_phase_fn(lambda xp, idx: (-1.0, 0.0),
+                         split=(("pflip",),
+                                lambda xp, pid, lidx, L: (-1.0, 0.0), ()))
 
     def UniformParityRZ(self, mask: int, angle: float) -> None:
         c, s_ = math.cos(angle), math.sin(angle)
@@ -530,7 +592,7 @@ class QEngine(QInterface):
             par = self._parity_of(xp, idx, mask)
             return c, xp.where(par == 1, s_, -s_)
 
-        self._k_phase_fn(fn)
+        self._k_phase_fn(fn, split=(("parz", mask), _parity_rz_split(mask), (c, s_)))
 
     def CUniformParityRZ(self, controls, mask: int, angle: float) -> None:
         controls = tuple(controls)
@@ -540,6 +602,7 @@ class QEngine(QInterface):
         cmask = 0
         for ctl in controls:
             cmask |= 1 << ctl
+        perm_all = (1 << len(controls)) - 1
 
         def fn(xp, idx):
             par = self._parity_of(xp, idx, mask)
@@ -548,7 +611,14 @@ class QEngine(QInterface):
             fim = xp.where(active, xp.where(par == 1, s_, -s_), 0.0)
             return fre, fim
 
-        self._k_phase_fn(fn)
+        def body(xp, pid, lidx, L, cc, ss):
+            par = alu.split_parity(xp, pid, lidx, L, mask)
+            active = alu.split_ctrl_match(xp, pid, lidx, L, controls, perm_all)
+            fre = xp.where(active, cc, 1.0)
+            fim = xp.where(active, xp.where(par == 1, ss, -ss), 0.0)
+            return fre, fim
+
+        self._k_phase_fn(fn, split=(("cuprz", mask, controls), body, (c, s_)))
 
     # ------------------------------------------------------------------
     # structure ops
@@ -623,8 +693,11 @@ class QEngine(QInterface):
     def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:
         raise NotImplementedError
 
-    def _k_phase_fn(self, fn) -> None:
-        """Apply a per-index complex factor: fn(xp, idx) -> (re, im)."""
+    def _k_phase_fn(self, fn, split=None) -> None:
+        """Apply a per-index complex factor: fn(xp, idx) -> (re, im).
+        `split` optionally carries the width-generic (key, body, targs)
+        form, body(xp, pid, lidx, L, *targs) -> (re, im), used by paged
+        engines past int32 widths (single-shard engines ignore it)."""
         raise NotImplementedError
 
     def _k_probs(self) -> np.ndarray:

@@ -157,7 +157,7 @@ class QEngineTPU(QEngine):
         new = new.at[:, dst_idx].set(self._state[:, src_idx])
         self._state = new
 
-    def _k_phase_fn(self, fn) -> None:
+    def _k_phase_fn(self, fn, split=None) -> None:
         fre, fim = fn(jnp, gk.iota_for(self._state))
         self._state = _j_phase_apply(self._state, fre, fim)
 

@@ -366,6 +366,99 @@ def indexed_adc_src_split(xp, pid, lidx, L, table, index_start, index_length,
     return split_bit_set(xp, sp, sl, L, carry_index, src_ext >> value_length)
 
 
+def mul_tables(to_mul: int, length: int):
+    """Host-built int32 tables for width-generic MUL/DIV (reference
+    kernels mul/div, qheader_alu.cl:~260). For each L-bit input x the
+    split product halves lo[x] = (x*toMul) & (2^L-1) and
+    hi[x] = ((x*toMul) >> L) & (2^L-1); plus the modular inverse table
+    inv[(x*odd) mod 2^L] = x where odd = toMul >> k, k = v2(toMul) —
+    x -> (x*odd) mod 2^L is a bijection because odd is invertible mod a
+    power of two. Register values stay < 2^31, so every lane is int32."""
+    import numpy as np
+
+    if to_mul <= 0:
+        raise ValueError("MUL/DIV multiplier must be positive")
+    if length > 31:
+        raise ValueError("register length > 31 bits exceeds int32 lanes")
+    k = (to_mul & -to_mul).bit_length() - 1
+    if k > length:
+        raise ValueError(
+            "v2(to_mul) exceeds the register length: the carry-truncated "
+            "product map is not a bijection")
+    size = 1 << length
+    mask = size - 1
+    odd = to_mul >> k
+    # vectorized over all 2^L register values; products decomposed into
+    # masked halves so every intermediate fits int64 even at length=31
+    x = np.arange(size, dtype=np.int64)
+    tm_l = to_mul & mask
+    tm_h = (to_mul >> length) & mask
+    p_l = x * tm_l
+    lo = (p_l & mask).astype(np.int32)
+    hi = (((p_l >> length) + x * tm_h) & mask).astype(np.int32)
+    inv = np.empty(size, dtype=np.int32)
+    inv[(x * (odd & mask)) & mask] = x
+    return lo, hi, inv, k
+
+
+def mul_src_split(xp, pid, lidx, L, lo_tab, hi_tab, inv_tab, k,
+                  in_out_start, carry_start, length):
+    """Gather form of MUL past int32 widths: destination (inOut=o,
+    carry=c) receives src (inOut=x, carry=0) when x*toMul == (c<<L)|o,
+    else zero. The unique candidate x comes from the odd-part inverse:
+    (product >> k) mod 2^L == (x*odd) mod 2^L, whose low L bits are
+    recoverable from (o, c) without ever forming the 2L-bit product."""
+    o = split_reg_get(xp, pid, lidx, L, in_out_start, length)
+    c = split_reg_get(xp, pid, lidx, L, carry_start, length)
+    if k:
+        u = ((c & ((1 << k) - 1)) << (length - k)) | (o >> k)
+    else:
+        u = o
+    x = inv_tab[u]
+    keep = (lo_tab[x] == o) & (hi_tab[x] == c)
+    sp, sl = split_reg_set(xp, pid, lidx, L, in_out_start, length, x)
+    sp, sl = split_reg_set(xp, sp, sl, L, carry_start, length,
+                           xp.zeros_like(x))
+    return sp, sl, keep
+
+
+def div_src_split(xp, pid, lidx, L, lo_tab, hi_tab, inv_tab, k,
+                  in_out_start, carry_start, length):
+    """Gather form of DIV (exact inverse of MUL): destination
+    (inOut=x, carry=0) receives src (inOut=lo[x], carry=hi[x]); any
+    destination with carry != 0 zeroes (the MUL image never lands
+    there). `inv_tab`/`k` are unused but keep one table signature for
+    both directions."""
+    x = split_reg_get(xp, pid, lidx, L, in_out_start, length)
+    c = split_reg_get(xp, pid, lidx, L, carry_start, length)
+    keep = c == 0
+    sp, sl = split_reg_set(xp, pid, lidx, L, in_out_start, length, lo_tab[x])
+    sp, sl = split_reg_set(xp, sp, sl, L, carry_start, length, hi_tab[x])
+    return sp, sl, keep
+
+
+def split_parity(xp, pid, lidx, L, mask):
+    """Parity of (global_index & mask) from the int32 halves: parity is
+    XOR-linear, so fold (lidx & mask_lo) ^ (pid & mask_hi)."""
+    w = (lidx & (mask & ((1 << L) - 1))) ^ (pid & (mask >> L))
+    width = w.dtype.itemsize * 8 if hasattr(w, "dtype") else 64
+    for s in (32, 16, 8, 4, 2, 1):
+        if s < width:
+            w = w ^ (w >> s)
+    return w & 1
+
+
+def phase_flip_less_factor_split(xp, pid, lidx, L, greater_perm, start, length,
+                                 flag_index=None):
+    """Split-index (C)PhaseFlipIfLess factor (reference kernels
+    cphaseflipifless/phaseflipifless, qheader_alu.cl:780-810)."""
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    cond = v < greater_perm
+    if flag_index is not None:
+        cond = cond & (split_bit_get(xp, pid, lidx, L, flag_index) == 1)
+    return xp.where(cond, -1.0, 1.0)
+
+
 def incdecsc_src_split(xp, pid, lidx, L, to_add, start, length, carry_index,
                        overflow_index=None):
     sp, sl = incdecc_src_split(xp, pid, lidx, L, to_add, start, length, carry_index)

@@ -360,16 +360,43 @@ class QPager(QEngine):
 
         return _program(self._key("phaseapply"), build)
 
-    def _k_phase_fn(self, fn) -> None:
+    def _k_phase_fn(self, fn, split=None) -> None:
+        if split is not None and self._wide_alu:
+            self._phase_fn_wide(split)
+            return
         if self.qubit_count > 31:
             raise NotImplementedError(
-                "generic diagonal ops above 31 qubits need split-mask "
-                "overrides (ZMask/PhaseParity/UniformParityRZ already have them)"
-            )
+                "this diagonal op lacks a split-index form for >31-qubit "
+                "pagers (see the `split=` forms in engines/qengine.py)")
         # factors computed eagerly (captured values stay out of any trace),
         # then applied by one cached program
         fre, fim = fn(jnp, self._global_iota())
         self._state = self._p_phase_apply()(self._state, fre, fim)
+
+    def _phase_fn_wide(self, split) -> None:
+        """Width-generic diagonal: per-shard factors from split (page,
+        local) indices — collective-free and exact at any width
+        (reference width-generic phase kernels, qheader_alu.cl:780-810)."""
+        from ..ops import sharded as shb
+
+        key, body, targs = split
+        L, mesh = self.local_bits, self.mesh
+
+        def build():
+            def f(local, *ta):
+                pid = shb.page_id()
+                lidx = gk.iota_for(local)
+                fre, fim = body(jnp, pid, lidx, L, *ta)
+                return gk.cmul(fre, fim, local).astype(local.dtype)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(P(None, "pages"),) + (P(),) * len(targs),
+                out_specs=P(None, "pages"),
+            ), donate_argnums=(0,))
+
+        prog = _program(self._key("phasefw") + tuple(key), build)
+        self._state = prog(self._state, *[jnp.asarray(t) for t in targs])
 
     def _p_gather(self):
         sh = self.sharding
@@ -441,7 +468,10 @@ class QPager(QEngine):
 
     def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:
         if self.qubit_count > 31:
-            raise NotImplementedError("see _k_gather")
+            # every public wide op routes through the split-index gather
+            # forms (MUL/DIV/*ModNOut included); reaching this kernel
+            # wide means a new op needs its own split form
+            raise NotImplementedError("see the `split=` gather forms")
         src_idx = jnp.asarray(src_idx, dtype=gk.IDX_DTYPE)
         dst_idx = jnp.asarray(dst_idx, dtype=gk.IDX_DTYPE)
         if passthrough_cmask is not None:

@@ -1,0 +1,24 @@
+"""Minimal TPU health probe. Writes result to stdout line-buffered.
+
+Run ONLY under a hard timeout from a parent; never SIGKILL mid-op if
+avoidable. Exits 0 with PROBE_OK on success.
+"""
+import sys, time, os
+
+def main():
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    print(f"PROBE devices={devs}", flush=True)
+    x = jnp.arange(16, dtype=jnp.float32)
+    y = (x * 2.0 + 1.0).block_until_ready()
+    print(f"PROBE small_op_ok sum={float(y.sum())} t={time.time()-t0:.2f}s", flush=True)
+    # a modestly sized matmul to confirm real compute works
+    a = jnp.ones((512, 512), dtype=jnp.float32)
+    b = (a @ a).block_until_ready()
+    print(f"PROBE matmul_ok val={float(b[0,0])} t={time.time()-t0:.2f}s", flush=True)
+    print("PROBE_OK", flush=True)
+
+if __name__ == "__main__":
+    main()

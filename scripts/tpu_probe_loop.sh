@@ -1,0 +1,11 @@
+#!/bin/bash
+# Periodically probe the axon TPU; append results to the log.
+# The wedge sometimes clears server-side; each probe is watchdogged.
+LOG=/tmp/tpu_probe_loop.log
+for i in $(seq 1 100); do
+  echo "=== probe $i at $(date +%H:%M:%S) ===" >> "$LOG"
+  timeout --signal=TERM --kill-after=15 120 python /root/repo/scripts/tpu_probe.py >> "$LOG" 2>&1
+  echo "exit=$? at $(date +%H:%M:%S)" >> "$LOG"
+  if grep -q PROBE_OK "$LOG"; then echo "HEALTHY at $(date +%H:%M:%S)" >> "$LOG"; exit 0; fi
+  sleep 600
+done

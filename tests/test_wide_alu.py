@@ -140,3 +140,67 @@ def test_shor_order_finding_slice_wide_path():
         eng.POWModNOut(2, 15, 0, 4, 4)
         eng.IQFT(0, 4)
     assert_match(o, p)
+
+
+def test_mul_div_across_pages():
+    # non-modular MUL/DIV through the split-index gather (carry register
+    # spans the page boundary: L=6 locals with 4 pages at n=8)
+    for to_mul in (3, 6, 5):  # odd, even (k=1), odd
+        o, p = make_pair(8, n_pages=4)
+        for eng in (o, p):
+            eng.H(0)
+            eng.H(1)
+            eng.H(2)
+            eng.H(7)
+            eng.MUL(to_mul, 0, 4, 3)
+        assert_match(o, p)
+        for eng in (o, p):
+            eng.DIV(to_mul, 0, 4, 3)
+        assert_match(o, p)
+
+
+def test_cmul_cdiv_paged_control():
+    o, p = make_pair(8, n_pages=4)
+    for eng in (o, p):
+        eng.H(0)
+        eng.H(1)
+        eng.H(7)                      # paged control in superposition
+        eng.CMUL(3, 0, 4, 3, (7,))
+    assert_match(o, p)
+    for eng in (o, p):
+        eng.CDIV(3, 0, 4, 3, (7,))
+    assert_match(o, p)
+
+
+def test_generic_diagonals_wide():
+    # every _k_phase_fn caller through the split-index wide path
+    n = 7
+    o, p = make_pair(n)
+    for eng in (o, p):
+        prep(eng, n)
+        eng.ZMask(0b1100101)               # parity spans pages
+        eng.PhaseParity(0.7, 0b0110011)
+        eng.UniformParityRZ(0b1010110, 0.3)
+        eng.CUniformParityRZ((6,), 0b0010011, 0.4)
+        eng.PhaseFlipIfLess(5, 3, 4)       # register spans the boundary
+        eng.CPhaseFlipIfLess(3, 0, 4, 6)   # flag on a paged bit
+        eng.PhaseFlip()
+    assert_match(o, p)
+
+
+def test_forcemparity_wide():
+    n = 7
+    o, p = make_pair(n)
+    for eng in (o, p):
+        prep(eng, n)
+        eng.ForceMParity(0b1100011, True)
+    assert_match(o, p)
+
+
+def test_mul_wide_rejects_overwide_pow2_factor():
+    # v2(to_mul) > length: the truncated product map is not a bijection,
+    # so the wide path refuses instead of silently corrupting the ket
+    o, p = make_pair(8, n_pages=4)
+    p.H(0)
+    with pytest.raises(ValueError):
+        p.MUL(16, 0, 4, 3)
