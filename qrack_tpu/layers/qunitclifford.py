@@ -19,11 +19,12 @@ from typing import Optional
 import numpy as np
 
 from .qunit import QUnit
-from .stabilizer import QStabilizer, CliffordError, clifford_sequence
+from .stabilizer import QStabilizer, CliffordError, clifford_sequence, _iphase
 
 
 def _stab_factory(n, **kw):
-    kw.pop("rand_global_phase", None)
+    # rand_global_phase passes through: tableaus track per-gate global
+    # phase now, so shard kets stay exact under QUnit recombination
     return QStabilizer(n, **kw)
 
 
@@ -48,10 +49,18 @@ class QUnitClifford(QUnit):
             if clifford_sequence(m) is None:
                 raise CliffordError(f"non-Clifford 1q gate on {target}")
         else:
-            is_cx = mat.is_invert(m) and abs(m[0, 1] - 1) < 1e-8 and abs(m[1, 0] - 1) < 1e-8
-            is_cy = mat.is_invert(m) and abs(m[0, 1] + 1j) < 1e-8 and abs(m[1, 0] - 1j) < 1e-8
-            is_cz = mat.is_phase(m) and abs(m[0, 0] - 1) < 1e-8 and abs(m[1, 1] + 1) < 1e-8
-            if len(live) > 1 or not (is_cx or is_cy or is_cz):
+            # Clifford controlled monomials: entries in {±1, ±i} with
+            # ratio ±1 (matches QStabilizer._ctrl_diag acceptance)
+            if mat.is_phase(m):
+                d0, d1 = m[0, 0], m[1, 1]
+            elif mat.is_invert(m):
+                d0, d1 = m[1, 0], m[0, 1]
+            else:
+                d0 = d1 = None
+            p0 = None if d0 is None else _iphase(d0)
+            p1 = None if d1 is None else _iphase(d1)
+            if (len(live) > 1 or p0 is None or p1 is None
+                    or (p1 - p0) % 2):
                 raise CliffordError("non-Clifford controlled gate")
         super().MCMtrxPerm(controls, m, target, perm)
 

@@ -12,17 +12,23 @@ Clifford-only by contract: MCMtrxPerm raises CliffordError for any
 non-Clifford payload, which is the signal QStabilizerHybrid uses to
 buffer/switch (reference: src/qstabilizerhybrid.cpp:206-239).
 
-Phase note: a `phase_offset` factor is tracked at the IO boundaries
-(SetPermutation / SetQuantumState / Compose / ket extraction), matching
-the reference's phaseOffset role there; per-GATE global-phase tracking
-(e.g. Z on a |1> eigenstate) remains canonicalized — a later-round
-parity item (reference: src/qstabilizer.cpp per-gate phaseOffset
-updates).
+Phase note: with `rand_global_phase=False` the global phase is tracked
+through EVERY tableau primitive (H/S/X/Y/Z/CNOT/collapse), so amplitude
+streams match the dense oracle exactly (reference: per-gate phaseOffset
+updates, src/qstabilizer.cpp:944-1010 and the AmplitudeEntry pattern at
+:1193). Mechanism here is independent: after each primitive the true
+amplitude at the new canonical seed state is computed from one or two
+pre-gate amplitudes (poly-time single-amplitude closure over the
+canonical form — stabilizer rows commute, so generator order is free),
+and `phase_offset` absorbs the difference from the extraction's
++real-seed convention. With the default `rand_global_phase=True` none
+of this runs (matching the reference's randGlobalPhase fast path).
 """
 
 from __future__ import annotations
 
 import math
+from contextlib import contextmanager
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -40,6 +46,14 @@ def _as_u8p(arr):
 
 class CliffordError(Exception):
     """Raised when a non-Clifford operation reaches the tableau."""
+
+
+def _iphase(v) -> Optional[int]:
+    """p with v == i^p (p in 0..3), or None."""
+    for p in range(4):
+        if abs(v - 1j ** p) < 1e-8:
+            return p
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -115,44 +129,151 @@ class QStabilizer(QInterface):
         self.z = np.zeros((2 * n + 1, n), dtype=np.uint8)
         self.r = np.zeros(2 * n + 1, dtype=np.uint8)
         self.phase_offset: complex = 1.0 + 0j
+        self._phase_paused = 0
         for i in range(n):
             self.x[i, i] = 1          # destabilizer X_i
             self.z[n + i, i] = 1      # stabilizer Z_i
         if init_state:
-            for q in range(n):
-                if (init_state >> q) & 1:
-                    self._x_gate(q)
+            with self._phase_freeze():
+                for q in range(n):
+                    if (init_state >> q) & 1:
+                        self._x_gate(q)
+
+    # ------------------------------------------------------------------
+    # per-gate global-phase tracking (see module docstring)
+    # ------------------------------------------------------------------
+
+    @property
+    def _track_phase(self) -> bool:
+        return not self.rand_global_phase and not self._phase_paused
+
+    @contextmanager
+    def _phase_freeze(self):
+        """Suspend tracking around net-identity conjugations and the
+        constructors that set phase_offset explicitly."""
+        self._phase_paused += 1
+        try:
+            yield
+        finally:
+            self._phase_paused -= 1
+
+    def _amp_closure(self):
+        """Single-amplitude oracle over the CURRENT state: perm -> the
+        complex amplitude up to the (positive) norm factor, 0 outside
+        the support. O(k*n) per query via the canonical form; the
+        stabilizer group is abelian, so generator product order is
+        immaterial."""
+        n = self.qubit_count
+        x, z, r, k = self._canonical_stab()
+        v0 = self._seed_state(x, z, r, k)
+        pivots = [int(np.nonzero(x[j])[0][0]) for j in range(k)]
+        po = self.phase_offset
+
+        def amp(perm: int) -> complex:
+            d = perm ^ v0
+            cur_x = np.zeros(n, dtype=np.uint8)
+            cur_z = np.zeros(n, dtype=np.uint8)
+            ph = 0
+            for j in range(k):
+                if (d >> pivots[j]) & 1:
+                    ph += 2 * int(r[j]) + int(
+                        self._g_vec(x[j], z[j], cur_x, cur_z).sum())
+                    cur_x ^= x[j]
+                    cur_z ^= z[j]
+            rem = d
+            for c in np.nonzero(cur_x)[0]:
+                rem ^= 1 << int(c)
+            if rem:
+                return 0j  # not in the support coset
+            zdot = 0
+            for c in np.nonzero(cur_z)[0]:
+                zdot ^= (v0 >> int(c)) & 1
+            y_count = int(np.count_nonzero(cur_x & cur_z))
+            return po * (1j ** ((ph + 2 * zdot + y_count) % 4))
+
+        return amp
+
+    def _phase_track(self, update, true_amp) -> None:
+        """Run a tableau `update`; then set phase_offset so extraction
+        reproduces the physical state: `true_amp(old_amp, v0_new)` gives
+        the post-gate amplitude at the new canonical seed in terms of
+        pre-gate amplitudes, and the raw extraction there is +norm by
+        construction, so the offset is exactly that amplitude's phase."""
+        old = self._amp_closure()
+        update()
+        x, z, r, k = self._canonical_stab()
+        v0 = self._seed_state(x, z, r, k)
+        t = complex(true_amp(old, v0))
+        a = abs(t)
+        if a > 1e-12:
+            self.phase_offset = t / a
 
     # ------------------------------------------------------------------
     # tableau primitives (reference: src/qstabilizer.cpp:944-1610)
     # ------------------------------------------------------------------
 
     def _cnot(self, c: int, t: int) -> None:
-        x, z, r = self.x, self.z, self.r
-        r ^= x[:, c] & z[:, t] & (x[:, t] ^ z[:, c] ^ 1)
-        x[:, t] ^= x[:, c]
-        z[:, c] ^= z[:, t]
+        def upd():
+            x, z, r = self.x, self.z, self.r
+            r ^= x[:, c] & z[:, t] & (x[:, t] ^ z[:, c] ^ 1)
+            x[:, t] ^= x[:, c]
+            z[:, c] ^= z[:, t]
+
+        if not self._track_phase:
+            return upd()
+        self._phase_track(
+            upd, lambda old, w: old(w ^ (((w >> c) & 1) << t)))
 
     def _h_gate(self, q: int) -> None:
-        x, z, r = self.x, self.z, self.r
-        r ^= x[:, q] & z[:, q]
-        tmp = x[:, q].copy()
-        x[:, q] = z[:, q]
-        z[:, q] = tmp
+        def upd():
+            x, z, r = self.x, self.z, self.r
+            r ^= x[:, q] & z[:, q]
+            tmp = x[:, q].copy()
+            x[:, q] = z[:, q]
+            z[:, q] = tmp
+
+        if not self._track_phase:
+            return upd()
+        m = 1 << q
+        self._phase_track(
+            upd,
+            lambda old, w: (old(w & ~m) + old(w | m)) if not (w >> q) & 1
+            else (old(w & ~m) - old(w | m)))
 
     def _s_gate(self, q: int) -> None:
-        x, z, r = self.x, self.z, self.r
-        r ^= x[:, q] & z[:, q]
-        z[:, q] ^= x[:, q]
+        def upd():
+            x, z, r = self.x, self.z, self.r
+            r ^= x[:, q] & z[:, q]
+            z[:, q] ^= x[:, q]
+
+        if not self._track_phase:
+            return upd()
+        self._phase_track(
+            upd, lambda old, w: old(w) * (1j if (w >> q) & 1 else 1.0))
 
     def _x_gate(self, q: int) -> None:
-        self.r ^= self.z[:, q]
+        if not self._track_phase:
+            self.r ^= self.z[:, q]
+            return
+        self._phase_track(
+            lambda: self.r.__ixor__(self.z[:, q]),
+            lambda old, w: old(w ^ (1 << q)))
 
     def _z_gate(self, q: int) -> None:
-        self.r ^= self.x[:, q]
+        if not self._track_phase:
+            self.r ^= self.x[:, q]
+            return
+        self._phase_track(
+            lambda: self.r.__ixor__(self.x[:, q]),
+            lambda old, w: old(w) * (-1.0 if (w >> q) & 1 else 1.0))
 
     def _y_gate(self, q: int) -> None:
-        self.r ^= self.x[:, q] ^ self.z[:, q]
+        if not self._track_phase:
+            self.r ^= self.x[:, q] ^ self.z[:, q]
+            return
+        self._phase_track(
+            lambda: self.r.__ixor__(self.x[:, q] ^ self.z[:, q]),
+            lambda old, w: old(w ^ (1 << q)) * (1j if (w >> q) & 1 else -1j))
 
     def _apply_seq(self, seq: str, q: int) -> None:
         for g in seq:
@@ -199,6 +320,24 @@ class QStabilizer(QInterface):
             seq = clifford_sequence(m)
             if seq is None:
                 raise CliffordError(f"non-Clifford 1q gate on {target}")
+            if self._track_phase:
+                # one composite tracking pass over the whole H/S
+                # sequence, with the true amplitude map taken from m
+                # itself — this also folds m's global phase, which the
+                # sequence only realizes up to a factor (reference:
+                # SetPhaseOffset(... + arg(mtrx0)) per recognized gate,
+                # src/qstabilizer.cpp:2770-2891)
+                mk = 1 << target
+
+                def upd():
+                    with self._phase_freeze():
+                        self._apply_seq(seq, target)
+
+                self._phase_track(
+                    upd,
+                    lambda old, w: (m[(w >> target) & 1, 0] * old(w & ~mk)
+                                    + m[(w >> target) & 1, 1] * old(w | mk)))
+                return
             self._apply_seq(seq, target)
             return
         if len(controls) > 1:
@@ -208,25 +347,35 @@ class QStabilizer(QInterface):
         if anti:
             self._x_gate(c)
         try:
-            if mat.is_invert(m) and abs(m[0, 1] - 1) < 1e-8 and abs(m[1, 0] - 1) < 1e-8:
+            # any controlled monomial with entries in {±1, ±i} whose
+            # entry ratio is ±1 is Clifford: diag(1,1,d0,d1) =
+            # [diag(1,d0) on c] · CZ^[(d1/d0)==-1], and an invert is
+            # that times CNOT (covers CX/CY/CZ and the phased variants
+            # QUnit link resolution emits; reference enumerates these
+            # case-by-case, src/qstabilizer.cpp:2770-2891)
+            if mat.is_phase(m):
+                self._ctrl_diag(c, target, m[0, 0], m[1, 1])
+            elif mat.is_invert(m):
+                self._ctrl_diag(c, target, m[1, 0], m[0, 1])
                 self._cnot(c, target)
-            elif mat.is_invert(m) and abs(m[0, 1] + 1j) < 1e-8 and abs(m[1, 0] - 1j) < 1e-8:
-                # CY = S_t CX S_t^dag
-                self._s_gate(target)
-                self._s_gate(target)
-                self._s_gate(target)  # S^3 = S^dag
-                self._cnot(c, target)
-                self._s_gate(target)
-            elif mat.is_phase(m) and abs(m[0, 0] - 1) < 1e-8 and abs(m[1, 1] + 1) < 1e-8:
-                # CZ = H_t CX H_t
-                self._h_gate(target)
-                self._cnot(c, target)
-                self._h_gate(target)
             else:
                 raise CliffordError("non-Clifford controlled gate")
         finally:
             if anti:
                 self._x_gate(c)
+
+    def _ctrl_diag(self, c: int, t: int, d0: complex, d1: complex) -> None:
+        """Apply diag(1,1,d0,d1) over (control c, target t)."""
+        p0 = _iphase(d0)
+        p1 = _iphase(d1)
+        if p0 is None or p1 is None or (p1 - p0) % 2:
+            raise CliffordError("non-Clifford controlled phase")
+        for _ in range(p0 % 4):
+            self._s_gate(c)
+        if (p1 - p0) % 4 == 2:
+            self._h_gate(t)
+            self._cnot(c, t)
+            self._h_gate(t)
 
     # fast paths used heavily by layers
     def H(self, q: int) -> None:
@@ -254,16 +403,39 @@ class QStabilizer(QInterface):
         self._cnot(c, t)
 
     def CZ(self, c: int, t: int) -> None:
-        self._h_gate(t)
-        self._cnot(c, t)
-        self._h_gate(t)
+        def upd():
+            with self._phase_freeze():
+                self._h_gate(t)
+                self._cnot(c, t)
+                self._h_gate(t)
+
+        if not self._track_phase:
+            return upd()
+        # one tracking pass over the composite (diagonal: -1 on |11>)
+        m = (1 << c) | (1 << t)
+        self._phase_track(
+            upd, lambda old, w: old(w) * (-1.0 if (w & m) == m else 1.0))
 
     def Swap(self, q1: int, q2: int) -> None:
         if q1 == q2:
             return
-        self._cnot(q1, q2)
-        self._cnot(q2, q1)
-        self._cnot(q1, q2)
+
+        def upd():
+            with self._phase_freeze():
+                self._cnot(q1, q2)
+                self._cnot(q2, q1)
+                self._cnot(q1, q2)
+
+        if not self._track_phase:
+            return upd()
+
+        def true_amp(old, w):
+            b1, b2 = (w >> q1) & 1, (w >> q2) & 1
+            if b1 != b2:
+                w ^= (1 << q1) | (1 << q2)
+            return old(w)
+
+        self._phase_track(upd, true_amp)
 
     def PermuteQubits(self, perm) -> None:
         """Relabel qubits: new column j holds old column perm[j].  A pure
@@ -272,8 +444,22 @@ class QStabilizer(QInterface):
         perm = np.asarray(perm, dtype=np.intp)
         if perm.shape[0] != self.qubit_count:
             raise ValueError("permutation length mismatch")
-        self.x = np.ascontiguousarray(self.x[:, perm])
-        self.z = np.ascontiguousarray(self.z[:, perm])
+
+        def upd():
+            self.x = np.ascontiguousarray(self.x[:, perm])
+            self.z = np.ascontiguousarray(self.z[:, perm])
+
+        if not self._track_phase:
+            return upd()
+
+        def true_amp(old, w):
+            # new bit j holds old bit perm[j]
+            old_w = 0
+            for j in range(perm.shape[0]):
+                old_w |= ((w >> j) & 1) << int(perm[j])
+            return old(old_w)
+
+        self._phase_track(upd, true_amp)
 
     def IsSeparable(self, q: int) -> bool:
         """Separable from the rest in some single-qubit basis
@@ -330,6 +516,13 @@ class QStabilizer(QInterface):
     def ForceM(self, q: int, result: bool, do_force: bool = True, do_apply: bool = True) -> bool:
         self._check_qubit(q)
         n = self.qubit_count
+        # projective collapse preserves surviving amplitudes up to the
+        # positive renormalization, so the tracked phase update is the
+        # identity map on the new seed (reference: post-measurement
+        # AmplitudeEntry fix, src/qstabilizer.cpp:2623)
+        old = (self._amp_closure()
+               if (self._track_phase and do_apply
+                   and self._find_random_row(q) is not None) else None)
         lib = get_tableau_lib()
         if (lib is not None and self.x.flags["C_CONTIGUOUS"]
                 and self.z.flags["C_CONTIGUOUS"]):
@@ -342,6 +535,8 @@ class QStabilizer(QInterface):
                                  rand_bit)
             if out < 0:
                 raise RuntimeError("ForceM: forced result has zero probability")
+            if old is not None:
+                self._phase_fix(old)
             return bool(out)
         p = self._find_random_row(q)
         if p is None:
@@ -362,7 +557,19 @@ class QStabilizer(QInterface):
         self.z[p] = 0
         self.z[p, q] = 1
         self.r[p] = 1 if out else 0
+        if old is not None:
+            self._phase_fix(old)
         return out
+
+    def _phase_fix(self, old) -> None:
+        """Re-anchor phase_offset after a state change whose amplitude
+        map is the identity on surviving support states."""
+        x, z, r, k = self._canonical_stab()
+        v0 = self._seed_state(x, z, r, k)
+        t = complex(old(v0))
+        a = abs(t)
+        if a > 1e-12:
+            self.phase_offset = t / a
 
     # ------------------------------------------------------------------
     # amplitudes (reference: GetAmplitude + gaussianCached,
@@ -580,18 +787,20 @@ class QStabilizer(QInterface):
         return self._find_random_row(q) is None
 
     def IsSeparableX(self, q: int) -> bool:
-        self._h_gate(q)
-        out = self.IsSeparableZ(q)
-        self._h_gate(q)
+        with self._phase_freeze():  # net-identity conjugation
+            self._h_gate(q)
+            out = self.IsSeparableZ(q)
+            self._h_gate(q)
         return out
 
     def IsSeparableY(self, q: int) -> bool:
         # conjugate by S^dag H? Y-basis: apply S^dag then H
-        self.IS(q)
-        self._h_gate(q)
-        out = self.IsSeparableZ(q)
-        self._h_gate(q)
-        self.S(q)
+        with self._phase_freeze():  # net-identity conjugation
+            self.IS(q)
+            self._h_gate(q)
+            out = self.IsSeparableZ(q)
+            self._h_gate(q)
+            self.S(q)
         return out
 
     def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
@@ -668,9 +877,10 @@ class QStabilizer(QInterface):
         for i in range(n):
             self.x[i, i] = 1
             self.z[n + i, i] = 1
-        for q in range(n):
-            if (perm >> q) & 1:
-                self._x_gate(q)
+        with self._phase_freeze():  # offset already set explicitly above
+            for q in range(n):
+                if (perm >> q) & 1:
+                    self._x_gate(q)
 
     def SetQuantumState(self, state) -> None:
         """Only stabilizer states are representable: synthesize by
@@ -772,22 +982,24 @@ class QStabilizer(QInterface):
                 raise CliffordError("support phases not quadratic")
         # build the state on a fresh tableau; the construction realizes
         # amp(v0) = +1/sqrt(2^k), so the input's v0 phase is the offset
+        # (tracking frozen: the offset above already carries the phase)
         self.SetPermutation(0, phase=amp0 / abs(amp0))
-        for b in range(n):
-            if (v0 >> b) & 1:
-                self._x_gate(b)
-        for j in range(k):
-            pj = pivots[j]
-            self._h_gate(pj)
+        with self._phase_freeze():
             for b in range(n):
-                if b != pj and (basis[j] >> b) & 1:
-                    self._cnot(pj, b)
-            for _ in range(l[j] % 4):
-                self._s_gate(pj)
-        for i in range(k):
-            for j in range(i + 1, k):
-                if q_mat[i, j]:
-                    self.CZ(pivots[i], pivots[j])
+                if (v0 >> b) & 1:
+                    self._x_gate(b)
+            for j in range(k):
+                pj = pivots[j]
+                self._h_gate(pj)
+                for b in range(n):
+                    if b != pj and (basis[j] >> b) & 1:
+                        self._cnot(pj, b)
+                for _ in range(l[j] % 4):
+                    self._s_gate(pj)
+            for i in range(k):
+                for j in range(i + 1, k):
+                    if q_mat[i, j]:
+                        self.CZ(pivots[i], pivots[j])
 
     def Clone(self) -> "QStabilizer":
         c = QStabilizer(self.qubit_count, rng=self.rng.spawn(),

@@ -51,7 +51,8 @@ class QStabilizerHybrid(QInterface):
         self._factory = engine_factory or _default_engine_factory
         self._eng_kwargs = {k: v for k, v in kwargs.items() if k != "rng"}
         self.stab: Optional[QStabilizer] = QStabilizer(
-            qubit_count, init_state=init_state, rng=self.rng.spawn())
+            qubit_count, init_state=init_state, rng=self.rng.spawn(),
+            rand_global_phase=self.rand_global_phase)
         self.engine = None
         self.shards: List[Optional[np.ndarray]] = [None] * qubit_count
         # reverse T-gadget state: ancillae live at tableau positions
@@ -133,9 +134,10 @@ class QStabilizerHybrid(QInterface):
         s = self.shards[q]
         if s is None:
             return
-        seq = clifford_sequence(s)
-        if seq is not None:
-            self.stab._apply_seq(seq, q)
+        if clifford_sequence(s) is not None:
+            # through the tableau's gate path so any global factor of
+            # the composed shard folds into phase_offset
+            self.stab.MCMtrxPerm((), s, q, 0)
             self.shards[q] = None
             return
         if mat.is_invert(s):
@@ -198,7 +200,9 @@ class QStabilizerHybrid(QInterface):
             new = m if cur is None else (m @ cur)
             seq = clifford_sequence(new)
             if seq is not None:
-                self.stab._apply_seq(seq, target)
+                # through the tableau's own gate path so the composed
+                # shard's global phase folds into phase_offset
+                self.stab.MCMtrxPerm((), new, target, 0)
                 self.shards[target] = None
                 return
             if mat.is_phase(new) or mat.is_invert(new):
@@ -404,7 +408,8 @@ class QStabilizerHybrid(QInterface):
         self._anc = 0
         self.log_fidelity = 0.0
         try:
-            stab = QStabilizer(self.qubit_count, rng=self.rng.spawn())
+            stab = QStabilizer(self.qubit_count, rng=self.rng.spawn(),
+                               rand_global_phase=self.rand_global_phase)
             stab.SetQuantumState(state)
             self.stab = stab
             self.engine = None
@@ -429,7 +434,9 @@ class QStabilizerHybrid(QInterface):
     def SetPermutation(self, perm: int, phase=None) -> None:
         # reset returns to the cheap representation (reference behavior)
         self.engine = None
-        self.stab = QStabilizer(self.qubit_count, init_state=perm, rng=self.rng.spawn())
+        self.stab = QStabilizer(self.qubit_count, init_state=perm,
+                                rng=self.rng.spawn(),
+                                rand_global_phase=self.rand_global_phase)
         self.shards = [None] * self.qubit_count
         self._anc = 0
         self.log_fidelity = 0.0

@@ -3,7 +3,7 @@
 Run ONLY under a hard timeout from a parent; never SIGKILL mid-op if
 avoidable. Exits 0 with PROBE_OK on success.
 """
-import sys, time, os
+import time
 
 def main():
     t0 = time.time()

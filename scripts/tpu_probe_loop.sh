@@ -2,10 +2,14 @@
 # Periodically probe the axon TPU; append results to the log.
 # The wedge sometimes clears server-side; each probe is watchdogged.
 LOG=/tmp/tpu_probe_loop.log
+ONE=/tmp/tpu_probe_once.log
 for i in $(seq 1 100); do
   echo "=== probe $i at $(date +%H:%M:%S) ===" >> "$LOG"
-  timeout --signal=TERM --kill-after=15 120 python /root/repo/scripts/tpu_probe.py >> "$LOG" 2>&1
+  timeout --signal=TERM --kill-after=15 120 python /root/repo/scripts/tpu_probe.py > "$ONE" 2>&1
   echo "exit=$? at $(date +%H:%M:%S)" >> "$LOG"
-  if grep -q PROBE_OK "$LOG"; then echo "HEALTHY at $(date +%H:%M:%S)" >> "$LOG"; exit 0; fi
+  cat "$ONE" >> "$LOG"
+  # only this iteration's output decides health (the log is append-only)
+  if grep -q PROBE_OK "$ONE"; then echo "HEALTHY at $(date +%H:%M:%S)" >> "$LOG"; exit 0; fi
   sleep 600
 done
+exit 1

@@ -248,3 +248,127 @@ def test_phase_offset_survives_decompose_dispose():
     s2.ForceM(1, True)
     s2.Dispose(1, 1)
     np.testing.assert_allclose(s2.GetQuantumState(), a_ket * np.exp(0.9j), atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# per-gate global-phase tracking (reference: per-gate phaseOffset updates,
+# src/qstabilizer.cpp:944-1010): with rand_global_phase=False, amplitude
+# streams must equal the dense oracle EXACTLY through long Clifford
+# circuits, with no IO-boundary canonicalization allowed to paper over a
+# dropped gate phase (e.g. Z on |1> contributes -1).
+# ---------------------------------------------------------------------------
+
+
+def test_pergate_phase_exact_parity_random_streams():
+    import random
+
+    random.seed(23)
+    n = 5
+    for trial in range(12):
+        st = QStabilizer(n, rng=QrackRandom(70 + trial), rand_global_phase=False)
+        d = QEngineCPU(n, rng=QrackRandom(70 + trial), rand_global_phase=False)
+        for _ in range(50):
+            g = random.choice(["H", "S", "IS", "X", "Y", "Z", "CNOT", "CZ", "Swap"])
+            q = random.randrange(n)
+            q2 = (q + 1 + random.randrange(n - 1)) % n
+            for eng in (st, d):
+                if g in ("CNOT", "CZ", "Swap"):
+                    getattr(eng, g)(q, q2)
+                else:
+                    getattr(eng, g)(q)
+        np.testing.assert_allclose(
+            st.GetQuantumState(), d.GetQuantumState(), atol=1e-10)
+
+
+def test_pergate_phase_simple_identities():
+    # Z|1> = -|1>, S|1> = i|1>, Y|0> = i|1>: pure global phases the
+    # tableau cannot represent — phase_offset must carry them per gate
+    st = QStabilizer(1, rng=QrackRandom(3), rand_global_phase=False)
+    st.X(0)
+    st.Z(0)
+    np.testing.assert_allclose(st.GetQuantumState(), [0, -1], atol=1e-12)
+    st.S(0)
+    np.testing.assert_allclose(st.GetQuantumState(), [0, -1j], atol=1e-12)
+    st2 = QStabilizer(1, rng=QrackRandom(3), rand_global_phase=False)
+    st2.Y(0)
+    np.testing.assert_allclose(st2.GetQuantumState(), [0, 1j], atol=1e-12)
+
+
+def test_pergate_phase_through_forced_measurement():
+    # collapse keeps surviving amplitudes' phases (up to +renorm)
+    st = QStabilizer(2, rng=QrackRandom(5), rand_global_phase=False)
+    d = QEngineCPU(2, rng=QrackRandom(5), rand_global_phase=False)
+    for eng in (st, d):
+        eng.H(0)
+        eng.S(0)
+        eng.CNOT(0, 1)
+        eng.Z(1)
+        eng.ForceM(0, True)
+    np.testing.assert_allclose(st.GetQuantumState(), d.GetQuantumState(), atol=1e-10)
+
+
+def test_pergate_phase_permute_qubits():
+    st = QStabilizer(3, rng=QrackRandom(8), rand_global_phase=False)
+    d = QEngineCPU(3, rng=QrackRandom(8), rand_global_phase=False)
+    for eng in (st, d):
+        eng.H(0)
+        eng.S(0)
+        eng.CNOT(0, 2)
+        eng.Y(1)
+    st.PermuteQubits([2, 0, 1])
+    # oracle: same relabeling via swaps
+    d.Swap(0, 2)  # now old2,old1,old0
+    d.Swap(1, 2)  # -> old2, old0, old1
+    np.testing.assert_allclose(st.GetQuantumState(), d.GetQuantumState(), atol=1e-10)
+
+
+def test_clifford_controlled_monomials():
+    # phased controlled monomials (Z_c·CZ, C(iX), anti-controlled forms)
+    # are Clifford and must match the oracle exactly
+    cases = [
+        (np.diag([-1, 1]), 1),                       # Z_c · CZ
+        (np.diag([1j, -1j]), 1),                     # S_c · CZ
+        (np.array([[0, 1j], [1j, 0]]), 1),           # C(iX) = S_c · CX
+        (np.array([[0, -1j], [1j, 0]]), 1),          # CY
+        (np.diag([1, -1]), 0),                       # anti-CZ
+        (np.array([[0, -1], [1, 0]]), 0),            # anti-C(-iY)
+    ]
+    for m, perm in cases:
+        st = QStabilizer(2, rng=QrackRandom(4), rand_global_phase=False)
+        d = QEngineCPU(2, rng=QrackRandom(4), rand_global_phase=False)
+        for eng in (st, d):
+            eng.H(0)
+            eng.H(1)
+            eng.S(1)
+            eng.MCMtrxPerm((0,), m, 1, perm)
+        np.testing.assert_allclose(
+            st.GetQuantumState(), d.GetQuantumState(), atol=1e-10,
+            err_msg=f"{m.tolist()} perm={perm}")
+
+
+def test_layer_stacks_exact_phase_parity():
+    # QStabilizerHybrid and QUnitClifford must inherit per-gate phase
+    # exactness (inner tableaus receive rand_global_phase)
+    import random
+
+    from qrack_tpu.layers.stabilizerhybrid import QStabilizerHybrid
+    from qrack_tpu.layers.qunitclifford import QUnitClifford
+
+    random.seed(97)
+    for trial in range(4):
+        engs = [QEngineCPU(4, rng=QrackRandom(300 + trial), rand_global_phase=False),
+                QStabilizerHybrid(4, rng=QrackRandom(300 + trial), rand_global_phase=False),
+                QUnitClifford(4, rng=QrackRandom(300 + trial), rand_global_phase=False)]
+        for _ in range(30):
+            g = random.choice(["H", "S", "X", "Z", "Y", "CNOT", "CZ", "Swap"])
+            q = random.randrange(4)
+            q2 = (q + 1 + random.randrange(3)) % 4
+            for e in engs:
+                if g in ("CNOT", "CZ", "Swap"):
+                    getattr(e, g)(q, q2)
+                else:
+                    getattr(e, g)(q)
+        a = engs[0].GetQuantumState()
+        for e in engs[1:]:
+            np.testing.assert_allclose(e.GetQuantumState(), a, atol=1e-8,
+                                       err_msg=f"{trial} {type(e).__name__}")
