@@ -82,39 +82,91 @@ def _mat_kind(m: Optional[np.ndarray]) -> str:
 
 
 class _PhaseLink:
-    """A buffered 2-qubit diagonal gate between shards a and b.
+    """A buffered 2-qubit controlled-monomial gate between shards a and b.
 
-    d[bit_a][bit_b] holds the unit-modulus phase applied to each joint
-    basis state (reference analogue: PhaseShard,
-    include/qengineshard.hpp:32-61, diagonal/"phase" case)."""
+    The operator is M = V · D (D applied first):
+      * D — diagonal: d[bit_a][bit_b] unit-modulus phases (reference
+        analogue: PhaseShard, include/qengineshard.hpp:32-61, "phase"
+        case);
+      * V — optional controlled-invert: X applied to endpoint `xt` when
+        the OTHER endpoint (the control) has bit value v with x[v] == 1
+        (reference analogue: PhaseShard isInvert,
+        include/qengineshard.hpp:62-100).
+    A plain diagonal link has xt None.  CNOT-echo pairs cancel in the
+    bag: merging two identical controlled-inverts XORs x back to zero
+    and the link normalizes to (or toward) identity."""
 
-    __slots__ = ("a", "b", "d")
+    __slots__ = ("a", "b", "d", "xt", "x")
 
     def __init__(self, a: "_Shard", b: "_Shard", d: np.ndarray):
         self.a = a
         self.b = b
         self.d = d
+        self.xt: Optional["_Shard"] = None  # invert target endpoint
+        self.x = [0, 0]                     # X^(x[control_bit]) on xt
+
+    @property
+    def has_invert(self) -> bool:
+        return self.xt is not None and bool(self.x[0] or self.x[1])
+
+    def _normalize(self) -> None:
+        if self.xt is not None and not (self.x[0] or self.x[1]):
+            self.xt = None
+            self.x = [0, 0]
 
     def phases_for(self, shard: "_Shard", bit: int) -> np.ndarray:
-        """Diagonal on the OTHER endpoint once `shard` collapses to bit."""
+        """Diagonal on the OTHER endpoint once `shard` collapses to bit
+        (plain links only)."""
         return self.d[bit, :] if shard is self.a else self.d[:, bit]
 
-    def flip(self, shard: "_Shard") -> None:
-        """Commute an anti-diagonal pending past this link (X conjugation
-        permutes that endpoint's index)."""
-        if shard is self.a:
-            self.d = self.d[::-1, :].copy()
-        else:
-            self.d = self.d[:, ::-1].copy()
+    def resolve_for(self, shard: "_Shard", bit: int) -> np.ndarray:
+        """2x2 monomial applied to the OTHER endpoint once `shard`'s
+        base collapses to `bit`.  `shard` must not be the invert target
+        (callers flush such links before collapsing the target)."""
+        ph = self.phases_for(shard, bit)
+        op = np.diag(ph).astype(np.complex128)
+        if self.has_invert and self.x[bit]:
+            op = np.array([[0, ph[1]], [ph[0], 0]], dtype=np.complex128)
+        return op
 
-    def mul(self, shard_a: "_Shard", d: np.ndarray) -> None:
-        """Merge another diagonal payload, given in shard_a-major order."""
-        self.d = self.d * (d if shard_a is self.a else d.T)
+    def _orient(self, shard_a: "_Shard", d: np.ndarray) -> np.ndarray:
+        return d if shard_a is self.a else d.T
+
+    def absorb_diag(self, shard_a: "_Shard", d: np.ndarray) -> None:
+        """Merge a NEW diagonal payload arriving on top: M' = g·V·D =
+        V·(V†gV)·D, where conjugation by the controlled-invert permutes
+        g's target index on the control rows that fire."""
+        g = self._orient(shard_a, d).copy()
+        if self.has_invert:
+            if self.xt is self.b:  # control = a (axis 0)
+                for cb in (0, 1):
+                    if self.x[cb]:
+                        g[cb] = g[cb, ::-1]
+            else:                  # control = b (axis 1)
+                for cb in (0, 1):
+                    if self.x[cb]:
+                        g[:, cb] = g[::-1, cb]
+        self.d = self.d * g
+
+    def absorb_invert(self, ctrl: "_Shard", d2: np.ndarray, x2) -> None:
+        """Merge a NEW controlled-invert V2·D2 (ctrl-major d2) arriving
+        on top of V·D with the SAME orientation (self.xt is the other
+        endpoint, or self plain): V2·D2·V·D = (V2·V)·(V†·D2·V)·D."""
+        tgt = self.b if ctrl is self.a else self.a
+        self.absorb_diag(ctrl, d2)
+        if self.xt is None:
+            self.xt = tgt
+            self.x = list(x2)
+        else:
+            self.x = [self.x[0] ^ x2[0], self.x[1] ^ x2[1]]
+        self._normalize()
 
     def is_identity(self) -> bool:
-        return bool(np.allclose(self.d, 1.0, atol=_EPS))
+        return not self.has_invert and bool(np.allclose(self.d, 1.0, atol=_EPS))
 
     def uniform_scalar(self) -> Optional[complex]:
+        if self.has_invert:
+            return None
         c = self.d[0, 0]
         if np.allclose(self.d, c, atol=_EPS):
             return complex(c)
@@ -375,11 +427,38 @@ class QUnit(QInterface):
             s.unit.MCMtrxPerm((), np.diag(phases), s.mapped, 0)
             self.dispatch_count += 1
 
+    def _apply_base_monomial(self, s: _Shard, op: np.ndarray) -> None:
+        """Apply a 2x2 monomial at the *base* level of shard s."""
+        if _mat_kind(op) in ("id", "diag"):
+            self._apply_base_diag(s, np.array([op[0, 0], op[1, 1]]))
+            return
+        if s.cached:
+            s.amp0, s.amp1 = op[0, 1] * s.amp1, op[1, 0] * s.amp0
+        else:
+            s.unit.MCMtrxPerm((), op, s.mapped, 0)
+            self.dispatch_count += 1
+
+    def _is_x_target(self, s: _Shard) -> bool:
+        return any(l.has_invert and l.xt is s for l in s.links.values())
+
+    def _flush_invert_links(self, q: int) -> None:
+        """Resolve only the link(s) whose invert TARGETS q (they change
+        its Z marginal); buffered diagonal links stay lazy."""
+        s = self.shards[q]
+        for link in list(s.links.values()):
+            if link.has_invert and link.xt is s:
+                self._resolve_link(link)
+
     def _reduce_links(self, s: _Shard, bit: int) -> None:
         """Shard s's base collapsed to `bit`: every link reduces to a
-        1q diagonal on its partner (the buffered-CZ elision win)."""
+        1q monomial on its partner (the buffered-CZ elision win).  A
+        link whose invert TARGETS s cannot reduce (s's value depends on
+        the partner) and resolves fully instead."""
         for partner, link in list(s.links.items()):
-            self._apply_base_diag(partner, link.phases_for(s, bit))
+            if link.has_invert and link.xt is s:
+                self._resolve_link(link)
+                continue
+            self._apply_base_monomial(partner, link.resolve_for(s, bit))
             del s.links[partner]
             partner.links.pop(s, None)
 
@@ -393,11 +472,11 @@ class QUnit(QInterface):
         a.links.pop(b, None)
         b.links.pop(a, None)
         za, zb = a.base_z_value(), b.base_z_value()
-        if za is not None:
-            self._apply_base_diag(b, link.phases_for(a, za))
+        if za is not None and not (link.has_invert and link.xt is a):
+            self._apply_base_monomial(b, link.resolve_for(a, za))
             return
-        if zb is not None:
-            self._apply_base_diag(a, link.phases_for(b, zb))
+        if zb is not None and not (link.has_invert and link.xt is b):
+            self._apply_base_monomial(a, link.resolve_for(b, zb))
             return
         qa, qb = self._qubit_of(a), self._qubit_of(b)
         try:
@@ -405,8 +484,22 @@ class QUnit(QInterface):
         except MemoryError as exc:
             if not self.is_ace:
                 raise RuntimeError(self._ACE_ADVISORY) from exc
-            self._elide_cz(qa, qb, link.d)
+            if not link.has_invert:
+                self._elide_cz(qa, qb, link.d)
+                return
+            # invert link under ACE: condition the control on its most
+            # likely value, apply the reduced monomial, pay fidelity
+            ctrl, tgt = (a, b) if link.xt is b else (b, a)
+            qc = qa if ctrl is a else qb
+            qt = qb if ctrl is a else qa
+            pc = self.Prob(qc)
+            bit = 1 if pc >= 0.5 else 0
+            self.log_fidelity += math.log(
+                max(min(pc if bit else (1.0 - pc), 1.0), FP_NORM_EPSILON))
+            self._check_fidelity()
+            self._buffer_1q(qt, link.resolve_for(ctrl, bit))
             return
+        # diagonal part first (M = V . D, D acts first)
         d0, d1 = link.d[0], link.d[1]
         if np.allclose(d0, 1.0, atol=_EPS):
             if not np.allclose(d1, 1.0, atol=_EPS):
@@ -419,6 +512,15 @@ class QUnit(QInterface):
             unit.MCMtrxPerm((), np.diag(d0), b.mapped, 0)
             unit.MCMtrxPerm((a.mapped,), np.diag(d1 / d0), b.mapped, 1)
             self.dispatch_count += 2
+        if link.has_invert:
+            ctrl, tgt = (a, b) if link.xt is b else (b, a)
+            if link.x[0] and link.x[1]:
+                unit.MCMtrxPerm((), mat.X2, tgt.mapped, 0)
+                self.dispatch_count += 1
+            else:
+                fire = 1 if link.x[1] else 0
+                unit.MCMtrxPerm((ctrl.mapped,), mat.X2, tgt.mapped, fire)
+                self.dispatch_count += 1
 
     def _flush_links(self, q: int) -> None:
         s = self.shards[q]
@@ -429,13 +531,8 @@ class QUnit(QInterface):
         s = self.shards[q]
         if s.pending is None:
             return
-        k = _mat_kind(s.pending)
-        if s.links:
-            if k == "gen":
-                self._flush_links(q)
-            elif k == "anti":
-                for link in s.links.values():
-                    link.flip(s)
+        # links are always drained first (_flush orders links, then
+        # pending), so no link commutation is needed here
         m = s.pending
         s.pending = None
         if s.cached:
@@ -472,12 +569,36 @@ class QUnit(QInterface):
             a1 = m[1, 0] * s.amp0 + m[1, 1] * s.amp1
             s.amp0, s.amp1 = a0, a1
             return
-        if s.cached and _mat_kind(m) == "diag" and s.pending is None:
-            # diagonals commute with every link: fold into the base amps
+        if (s.cached and _mat_kind(m) == "diag" and s.pending is None
+                and not self._is_x_target(s)):
+            # diagonals commute with every link that doesn't X this
+            # shard: fold into the base amps
             self._apply_base_diag(s, np.array([m[0, 0], m[1, 1]]))
             return
         nm = m if s.pending is None else m @ s.pending
         s.pending = None if _mat_kind(nm) == "id" else nm
+
+    def _unbuffer_conflicting_links(self, sc: _Shard, st: _Shard) -> None:
+        """The link bag is unordered, so members must mutually commute:
+        an arriving payload touching (sc, st) conflicts with any OTHER
+        pair's link whose invert targets sc or st (X vs. target-indexed
+        phases).  Resolve those before buffering."""
+        for s in (sc, st):
+            for partner, link in list(s.links.items()):
+                if (link.has_invert and link.xt is s
+                        and partner is not sc and partner is not st):
+                    self._resolve_link(link)
+
+    def _link_cancel_check(self, sc: _Shard, st: _Shard, link: _PhaseLink) -> None:
+        if link.has_invert:
+            return
+        scalar = link.uniform_scalar()
+        if scalar is not None:
+            # pure (global-per-pair) phase: the gate pair cancelled
+            del sc.links[st]
+            del st.links[sc]
+            if abs(scalar - 1) > _EPS:
+                self._apply_base_diag(sc, np.array([scalar, scalar]))
 
     def _buffer_phase_link(self, c: int, t: int, m: np.ndarray,
                            fire_on: int) -> None:
@@ -487,6 +608,7 @@ class QUnit(QInterface):
         for q, s in ((c, sc), (t, st)):
             if _mat_kind(s.pending) == "gen":
                 self._flush(q)
+        self._unbuffer_conflicting_links(sc, st)
         d = np.ones((2, 2), dtype=np.complex128)
         d[fire_on, 0] = m[0, 0]
         d[fire_on, 1] = m[1, 1]
@@ -500,14 +622,69 @@ class QUnit(QInterface):
             sc.links[st] = link
             st.links[sc] = link
         else:
-            link.mul(sc, d)
-        scalar = link.uniform_scalar()
-        if scalar is not None:
-            # pure (global-per-pair) phase: the gate pair cancelled
+            link.absorb_diag(sc, d)
+        self._link_cancel_check(sc, st, link)
+
+    def _buffer_invert_link(self, c: int, t: int, m: np.ndarray,
+                            fire_on: int) -> None:
+        """Buffer a single-control ANTI-diagonal gate (CNOT/CY/phased
+        variants) as an invert link: V·D with D = diag(m[1,0], m[0,1])
+        on the fire row and V = controlled-X on t (reference: PhaseShard
+        isInvert buffering, include/qengineshard.hpp:62-100).  A second
+        identical controlled-invert XORs the X away — CNOT echoes never
+        reach an engine."""
+        sc, st = self.shards[c], self.shards[t]
+        if _mat_kind(sc.pending) == "gen":
+            self._flush(c)
+        if _mat_kind(st.pending) == "gen":
+            self._flush(t)
+        # X on t does not commute with OTHER links touching t at all
+        # (diagonal or invert: either the X or our fire-row phases break
+        # the bag's commutation); resolve them first
+        for partner, link in list(st.links.items()):
+            if partner is not sc:
+                self._resolve_link(link)
+        self._unbuffer_conflicting_links(sc, st)
+        # same-pair link with roles crossed (its invert targets c): the
+        # two inverts do not commute; flush it
+        link = sc.links.get(st)
+        if link is not None and link.has_invert and link.xt is sc:
+            self._resolve_link(link)
+            link = None
+        d2 = np.ones((2, 2), dtype=np.complex128)
+        d2[fire_on, 0] = m[1, 0]   # anti = X . diag(bl, tr)
+        d2[fire_on, 1] = m[0, 1]
+        x2 = [0, 0]
+        x2[fire_on] = 1
+        # commute the arriving gate below the endpoint pendings:
+        # control-side anti swaps which value fires (phases cancel);
+        # target-side monomial P = X^p·diag(u0,u1) flips d2's target
+        # index if p and adds (ū1·u0, ū0·u1) on the firing rows
+        if _mat_kind(sc.pending) == "anti":
+            d2 = d2[::-1, :]
+            x2 = [x2[1], x2[0]]
+        pk = _mat_kind(st.pending)
+        if pk in ("diag", "anti"):
+            p = st.pending
+            if pk == "anti":
+                u0, u1 = p[1, 0], p[0, 1]
+                d2 = d2[:, ::-1]
+            else:
+                u0, u1 = p[0, 0], p[1, 1]
+            extra = np.array([np.conj(u1) * u0, np.conj(u0) * u1])
+            for cb in (0, 1):
+                if x2[cb]:
+                    d2[cb] = d2[cb] * extra
+        if link is None:
+            link = _PhaseLink(sc, st, np.ones((2, 2), dtype=np.complex128))
+            sc.links[st] = link
+            st.links[sc] = link
+        link.absorb_invert(sc, d2, x2)
+        if link.is_identity():
             del sc.links[st]
             del st.links[sc]
-            if abs(scalar - 1) > _EPS:
-                self._apply_base_diag(sc, np.array([scalar, scalar]))
+            return
+        self._link_cancel_check(sc, st, link)
 
     # ------------------------------------------------------------------
     # gate primitive with control trimming
@@ -518,6 +695,8 @@ class QUnit(QInterface):
         buffers, or None."""
         if not s.cached:
             return None
+        if self._is_x_target(s):
+            return None  # value depends on the link's control
         zb = s.base_z_value()
         if zb is not None:
             if s.pending is None:
@@ -568,10 +747,14 @@ class QUnit(QInterface):
         if not live:
             self._buffer_1q(target, m)
             return
-        if (self.phase_fusion and len(live) == 1
-                and _mat_kind(m) == "diag" and live[0] != target):
-            self._buffer_phase_link(live[0], target, m, live_perm & 1)
-            return
+        if self.phase_fusion and len(live) == 1 and live[0] != target:
+            k = _mat_kind(m)
+            if k == "diag":
+                self._buffer_phase_link(live[0], target, m, live_perm & 1)
+                return
+            if k == "anti":
+                self._buffer_invert_link(live[0], target, m, live_perm & 1)
+                return
         for q in live + (target,):
             self._flush(q)
         try:
@@ -634,6 +817,9 @@ class QUnit(QInterface):
     def Prob(self, q: int) -> float:
         self._check_qubit(q)
         s = self.shards[q]
+        if self._is_x_target(s):
+            # an invert link targeting q DOES change its Z marginal
+            self._flush_invert_links(q)
         k = _mat_kind(s.pending)
         if k == "gen":
             # a general pending mixes branches whose relative phases the
@@ -695,6 +881,8 @@ class QUnit(QInterface):
         dropped after the collapse; monomial pendings relabel outcomes
         (general pendings are flushed first)."""
         for q in range(self.qubit_count):
+            if self._is_x_target(self.shards[q]):
+                self._flush_invert_links(q)
             if _mat_kind(self.shards[q].pending) == "gen":
                 self._flush(q)
         result = 0
@@ -732,9 +920,12 @@ class QUnit(QInterface):
 
     def ProbParity(self, mask: int) -> float:
         bits = [q for q in range(self.qubit_count) if (mask >> q) & 1]
-        # parity is a Z-diagonal observable: links don't affect it and
-        # monomial pendings just flip contributions
+        # parity is a Z-diagonal observable: diagonal links don't affect
+        # it and monomial pendings just flip contributions (invert links
+        # targeting a measured bit must resolve first)
         for q in bits:
+            if self._is_x_target(self.shards[q]):
+                self._flush_invert_links(q)
             if _mat_kind(self.shards[q].pending) == "gen":
                 self._flush(q)
         # split by unit: parity distribution composes by XOR convolution
@@ -770,6 +961,11 @@ class QUnit(QInterface):
         if isinstance(qubits, (int, np.integer)):
             qubits = (int(qubits),)
         tol = error_tol if error_tol is not None else self.sep_threshold
+        # buffered links are pending entanglement: resolve them so the
+        # probes judge the true state, not the bare base
+        for q in qubits:
+            if self.shards[q].links:
+                self._flush_links(q)
         if len(qubits) == 2:
             return self._try_separate_2qb(qubits[0], qubits[1], tol)
         ok = True
@@ -1289,6 +1485,9 @@ class QUnit(QInterface):
                 seen_links.add(id(link))
                 na, nb = shard_map[id(link.a)], shard_map[id(link.b)]
                 nl = _PhaseLink(na, nb, link.d.copy())
+                if link.xt is not None:
+                    nl.xt = shard_map[id(link.xt)]
+                    nl.x = list(link.x)
                 na.links[nb] = nl
                 nb.links[na] = nl
         return c

@@ -378,8 +378,11 @@ def mul_tables(to_mul: int, length: int):
 
     if to_mul <= 0:
         raise ValueError("MUL/DIV multiplier must be positive")
-    if length > 31:
-        raise ValueError("register length > 31 bits exceeds int32 lanes")
+    if length > 24:
+        # three 2^L int32 tables: 24 bits is already 200 MB of host RAM;
+        # larger registers need a table-free per-lane division
+        raise ValueError("wide MUL/DIV register length capped at 24 bits "
+                         "(host product tables)")
     k = (to_mul & -to_mul).bit_length() - 1
     if k > length:
         raise ValueError(

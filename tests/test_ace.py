@@ -32,14 +32,17 @@ def entangle_pairs(q, n):
     for i in range(0, n - 1, 2):
         q.H(i)
         q.CNOT(i, i + 1)
+        q.Prob(i + 1)   # force the buffered link into a real 2q unit
 
 
 def test_guard_raises_advisory_not_memoryerror():
     q = make(6, cap=4)
     entangle_pairs(q, 6)          # 2q units: within cap
-    q.CNOT(1, 2)                  # merges to 4: still within cap
+    q.CNOT(1, 2)                  # buffered...
+    q.Prob(2)                     # ...merges to 4: still within cap
+    q.CNOT(3, 4)                  # buffers as an invert link: no merge yet
     with pytest.raises(RuntimeError, match="ACE"):
-        q.CNOT(3, 4)              # 4 + 2 = 6 > 4
+        q.Prob(4)                 # target marginal forces the flush: 4+2 > 4
 
 
 def test_cnot_above_guard_fires_at_flush_time():
@@ -48,16 +51,18 @@ def test_cnot_above_guard_fires_at_flush_time():
     q = make(6, cap=3)
     entangle_pairs(q, 6)
     q.CZ(1, 2)                    # buffered: no entanglement, no error
+    q.CNOT(1, 2)                  # absorbs into the same link: still lazy
     assert q.GetUnitaryFidelity() == 1.0
     with pytest.raises(RuntimeError, match="ACE"):
-        q.CNOT(1, 2)
+        q.Prob(2)                 # measuring the invert target forces it
 
 
 def test_ace_elides_cz_with_fidelity_cost():
     q = make(6, ace=True, cap=3)
     entangle_pairs(q, 6)
     q.CZ(1, 2)                    # buffered
-    q.CNOT(1, 2)                  # forces link flush -> merge fails -> elide
+    q.CNOT(1, 2)                  # absorbed into the link
+    q.Prob(2)                     # flush -> merge fails -> elide
     assert q.GetUnitaryFidelity() < 1.0
     # the state is still normalized and factored within the cap
     sizes = [s.unit.qubit_count for s in q.shards if s.unit is not None]
@@ -73,6 +78,7 @@ def test_ace_cnot_shadow_conditions_on_likely_control():
     q.H(1)                        # make it non-definite so trim can't elide
     q.RY(0.2, 1)
     q.CNOT(1, 2)
+    q.Prob(2)                     # force the buffered link down
     # cap=1 forbids ALL merges: the gate became a shadow
     assert all(s.cached for s in q.shards)
     assert q.GetUnitaryFidelity() < 1.0
@@ -92,6 +98,7 @@ def test_max_alloc_mb_enforced(monkeypatch):
     with pytest.raises(RuntimeError, match="ACE"):
         for i in range(1, 29, 2):
             q2.CNOT(i, i + 1)
+        q2.GetQuantumState()      # flush forces the over-budget merges
 
 
 def test_ace_full_circuit_stays_bounded():

@@ -43,12 +43,15 @@ def test_single_qubit_gates_never_allocate_units():
 def test_entangle_and_factor_accounting():
     q = make(6)
     q.H(0)
-    q.CNOT(0, 1)          # unit {0,1}
+    q.CNOT(0, 1)          # buffered invert link first...
+    q.Prob(1)             # ...measuring the target forces unit {0,1}
     q.H(3)
-    q.CNOT(3, 4)          # unit {3,4}
+    q.CNOT(3, 4)
+    q.Prob(4)             # unit {3,4}
     assert q.GetUnitCount() == 4  # two 2q units + two cached
     assert q.GetMaxUnitSize() == 2
-    q.CNOT(1, 3)          # merges into one 4q unit
+    q.CNOT(1, 3)          # buffered; flush merges into one 4q unit
+    q.Prob(3)
     assert q.GetMaxUnitSize() == 4
     # measurement separates everything
     q.rng.seed(3)
@@ -93,6 +96,7 @@ def test_measurement_separates():
     q.H(0)
     for i in range(3):
         q.CNOT(i, i + 1)
+    q.Prob(3)             # resolve the tail link: full GHZ unit
     assert q.GetMaxUnitSize() == 4
     q.rng.seed(5)
     m = q.M(2)
@@ -106,7 +110,9 @@ def test_try_separate():
     q = make(3, seed=9)
     q.H(0)
     q.CNOT(0, 1)
-    q.CNOT(0, 1)  # undone: product state again, but still one unit
+    q.Prob(1)     # force the real entangle
+    q.CNOT(0, 1)  # undone at the engine: product state, still one unit
+    q.Prob(1)
     assert q.GetMaxUnitSize() == 2
     assert q.TrySeparate(1)
     assert q.shards[1].cached
@@ -213,8 +219,9 @@ def test_wide_sparse_circuit():
         q.H(i)
         q.CNOT(i, i + 1)
         q.T(i + 1)
+    assert q.GetMaxUnitSize() <= 2   # links may still be buffered
+    assert q.GetAmplitude(0) != 0    # flushes: genuine 2q units now
     assert q.GetMaxUnitSize() == 2
-    assert q.GetAmplitude(0) != 0
     q.rng.seed(1)
     r = q.MAll()
     assert isinstance(r, int)
@@ -230,7 +237,9 @@ def test_two_qubit_cnot_probe_separation():
         eng.RY(0.3, 0)
         eng.RY(0.7, 1)
         eng.CNOT(0, 1)
+        eng.Prob(1)      # force the real entangle
         eng.CNOT(0, 1)   # net identity, but the unit stays merged
+        eng.Prob(1)
     assert any(not s.cached for s in q.shards[:2])
     assert not q._try_separate_1qb(0, 1e-8)  # 1q probes fail off-axis
     assert q.TrySeparate((0, 1))

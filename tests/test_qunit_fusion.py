@@ -224,3 +224,85 @@ def test_maall_with_buffers_distribution():
         hits.add(r)
     assert hits <= {0b010, 0b101}, hits
     assert len(hits) == 2
+
+
+# ---------------------------------------------------------------------------
+# controlled-invert links (reference: PhaseShard isInvert buffering,
+# include/qengineshard.hpp:62-100): CNOT-echo patterns cancel in the
+# link bag and never dispatch to an engine
+# ---------------------------------------------------------------------------
+
+
+def test_cnot_echo_zero_dispatch():
+    u = QUnit(3, rng=QrackRandom(1))
+    u.H(0)
+    u.H(1)
+    d0 = u.dispatch_count
+    u.CNOT(0, 1)
+    u.S(1)
+    u.Z(1)
+    u.CNOT(0, 1)
+    assert u.dispatch_count == d0
+    o = QEngineCPU(3, rng=QrackRandom(1), rand_global_phase=False)
+    o.H(0)
+    o.H(1)
+    o.CNOT(0, 1)
+    o.S(1)
+    o.Z(1)
+    o.CNOT(0, 1)
+    assert abs(np.vdot(u.GetQuantumState(), o.GetQuantumState())) ** 2 > 1 - 1e-9
+
+
+def test_cy_and_anticnot_echo_cancel():
+    u = QUnit(2, rng=QrackRandom(3))
+    u.H(0)
+    u.H(1)
+    d0 = u.dispatch_count
+    u.CY(0, 1)
+    u.CY(0, 1)          # CY·CY = diag(1,1,-1,-1)·... stays buffered
+    u.AntiCNOT(0, 1)
+    u.AntiCNOT(0, 1)
+    assert u.dispatch_count == d0
+    o = QEngineCPU(2, rng=QrackRandom(3), rand_global_phase=False)
+    o.H(0)
+    o.H(1)
+    o.CY(0, 1)
+    o.CY(0, 1)
+    o.AntiCNOT(0, 1)
+    o.AntiCNOT(0, 1)
+    assert abs(np.vdot(u.GetQuantumState(), o.GetQuantumState())) ** 2 > 1 - 1e-9
+
+
+def test_invert_link_random_parity():
+    import random
+
+    random.seed(5)
+    for trial in range(8):
+        u = QUnit(4, rng=QrackRandom(200 + trial))
+        o = QEngineCPU(4, rng=QrackRandom(200 + trial), rand_global_phase=False)
+        for _ in range(45):
+            g = random.choice(["H", "S", "X", "Y", "Z", "T", "CNOT", "CZ",
+                               "CY", "AntiCNOT", "Swap", "M"])
+            q = random.randrange(4)
+            q2 = (q + 1 + random.randrange(3)) % 4
+            if g == "M":
+                r = u.M(q)
+                o.ForceM(q, r)
+                continue
+            for e in (u, o):
+                if g in ("CNOT", "CZ", "CY", "AntiCNOT", "Swap"):
+                    getattr(e, g)(q, q2)
+                else:
+                    getattr(e, g)(q)
+        fid = abs(np.vdot(u.GetQuantumState(), o.GetQuantumState())) ** 2
+        assert fid > 1 - 1e-8, (trial, fid)
+
+
+def test_invert_link_measurement_flush():
+    # measuring the invert TARGET must account for the buffered CNOT
+    u = QUnit(2, rng=QrackRandom(7))
+    u.X(0)          # control definite |1> — but via link path when buffered
+    u.H(0)
+    u.CNOT(0, 1)    # Bell-ish via link
+    p = u.Prob(1)   # target marginal must see the buffered X
+    assert abs(p - 0.5) < 1e-9

@@ -32,6 +32,7 @@ def test_factoring_accounting():
     for i in range(0, 40, 2):
         q.H(i)
         q.CNOT(i, i + 1)
+        q.Prob(i + 1)   # force the buffered link into a real 2q unit
     assert q.GetMaxUnitSize() == 2
     assert q.Prob(39) == pytest.approx(0.5)
     q.rng.seed(7)
@@ -50,6 +51,7 @@ def test_measurement_and_separation():
     q.H(0)
     for i in range(4):
         q.CNOT(i, i + 1)
+    q.Prob(4)   # resolve the tail link: full GHZ unit
     assert q.GetMaxUnitSize() == 5
     q.rng.seed(11)
     q.M(2)
