@@ -438,6 +438,14 @@ class QUnit(QInterface):
             s.unit.MCMtrxPerm((), op, s.mapped, 0)
             self.dispatch_count += 1
 
+    def _base_prob1(self, s: _Shard) -> float:
+        """P(bit = 1) at the *base* level of shard s (below pendings and
+        links)."""
+        if s.cached:
+            nrm = abs(s.amp0) ** 2 + abs(s.amp1) ** 2
+            return (abs(s.amp1) ** 2 / nrm) if nrm > 0 else 0.0
+        return s.unit.Prob(s.mapped)
+
     def _is_x_target(self, s: _Shard) -> bool:
         return any(l.has_invert and l.xt is s for l in s.links.values())
 
@@ -488,16 +496,16 @@ class QUnit(QInterface):
                 self._elide_cz(qa, qb, link.d)
                 return
             # invert link under ACE: condition the control on its most
-            # likely value, apply the reduced monomial, pay fidelity
+            # likely BASE value and apply the reduced monomial at base
+            # level — the link lives BELOW the pendings, so both the
+            # probability and the insertion point must ignore them
             ctrl, tgt = (a, b) if link.xt is b else (b, a)
-            qc = qa if ctrl is a else qb
-            qt = qb if ctrl is a else qa
-            pc = self.Prob(qc)
+            pc = self._base_prob1(ctrl)
             bit = 1 if pc >= 0.5 else 0
             self.log_fidelity += math.log(
                 max(min(pc if bit else (1.0 - pc), 1.0), FP_NORM_EPSILON))
             self._check_fidelity()
-            self._buffer_1q(qt, link.resolve_for(ctrl, bit))
+            self._apply_base_monomial(tgt, link.resolve_for(ctrl, bit))
             return
         # diagonal part first (M = V . D, D acts first)
         d0, d1 = link.d[0], link.d[1]

@@ -378,11 +378,18 @@ def mul_tables(to_mul: int, length: int):
 
     if to_mul <= 0:
         raise ValueError("MUL/DIV multiplier must be positive")
-    if length > 24:
-        # three 2^L int32 tables: 24 bits is already 200 MB of host RAM;
-        # larger registers need a table-free per-lane division
-        raise ValueError("wide MUL/DIV register length capped at 24 bits "
-                         "(host product tables)")
+    import os
+
+    cap = int(os.environ.get("QRACK_WIDE_MUL_TABLE_QB", "24"))
+    if length > min(cap, 31):
+        # three 2^L int32 tables: 24 bits is already 200 MB of host RAM,
+        # and each extra bit doubles it (31 bits = 24 GB) — raise the
+        # cap explicitly when the host can pay for the register width
+        raise ValueError(
+            f"wide MUL/DIV register length {length} exceeds the host "
+            f"product-table cap ({min(cap, 31)} bits, "
+            "QRACK_WIDE_MUL_TABLE_QB to raise; 3 int32 tables of 2^L "
+            "entries each)")
     k = (to_mul & -to_mul).bit_length() - 1
     if k > length:
         raise ValueError(

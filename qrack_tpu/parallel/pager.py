@@ -64,6 +64,18 @@ def _state_specs(n_scalars: int):
 from ..ops.sharded import split_masks as _split_masks  # single source of truth
 
 
+def _host_read(x) -> np.ndarray:
+    """Host value of a program output.
+
+    Multi-host safe for REPLICATED outputs (out_specs=P() /
+    out_shardings P()): when the mesh spans jax.distributed processes
+    the array is not fully addressable, but any process-local shard of
+    a replicated array holds the whole value."""
+    if x.is_fully_addressable:
+        return np.asarray(x)
+    return np.asarray(x.addressable_shards[0].data)
+
+
 class QPager(QEngine):
     """Paged dense engine over a 1-D 'pages' mesh axis."""
 
@@ -481,11 +493,14 @@ class QPager(QEngine):
             self._state = self._p_out_of_place(False)(self._state, src_idx, dst_idx)
 
     def _k_probs(self) -> np.ndarray:
+        if not self._state.is_fully_addressable:
+            planes = self._fetch(0, 1 << self.qubit_count)
+            return planes[0] ** 2 + planes[1] ** 2
         return np.asarray(jax.jit(gk.probs)(self._state), dtype=np.float64)
 
     def _k_prob_mask(self, mask, perm) -> float:
         lmask, lval, gmask, gval = _split_masks(mask, perm, self.local_bits)
-        p = float(self._p_prob_mask()(self._state, lmask, lval, gmask, gval))
+        p = float(_host_read(self._p_prob_mask()(self._state, lmask, lval, gmask, gval)))
         return min(max(p, 0.0), 1.0)
 
     def _k_collapse(self, mask, val, nrm_sq) -> None:
@@ -495,12 +510,15 @@ class QPager(QEngine):
     def MAll(self) -> int:
         """Two-stage sample: page marginals (psum over mesh), then an
         in-page draw — only one page ever reaches the host."""
-        page_probs = np.asarray(self._p_page_probs()(self._state), dtype=np.float64)
+        pp = self._p_page_probs()(self._state)
+        if not pp.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            pp = multihost_utils.process_allgather(pp, tiled=True)
+        page_probs = np.asarray(pp, dtype=np.float64)
         page = int(self.rng.choice_from_probs(page_probs, 1)[0])
         L = self.local_bits
-        local = np.asarray(
-            jax.device_get(self._state[:, page << L:(page + 1) << L]), dtype=np.float64
-        )
+        local = self._fetch(page << L, 1 << L)
         p_local = local[0] ** 2 + local[1] ** 2
         sub = int(self.rng.choice_from_probs(p_local, 1)[0])
         result = (page << L) | sub
@@ -515,7 +533,7 @@ class QPager(QEngine):
             b = other._state
         else:
             b = jax.device_put(gk.to_planes(other.GetQuantumState(), self.dtype), self.sharding)
-        return float(self._p_sum_sqr_diff()(self._state, b))
+        return float(_host_read(self._p_sum_sqr_diff()(self._state, b)))
 
     # -- structural ops: device-side sharded programs (reference rebalances
     #    pages device-side, src/qpager.cpp:316-367; here XLA/GSPMD inserts
@@ -558,7 +576,7 @@ class QPager(QEngine):
         n1, n2 = self.qubit_count, other.qubit_count
         if self._mesh_would_change(n1 + n2):
             # ket was below the page count (tiny): host-stage the regrow
-            a = (np.asarray(jax.device_get(self._state), dtype=np.float64))
+            a = self._fetch(0, 1 << n1)
             a = a[0] + 1j * a[1]
             b = np.asarray(other.GetQuantumState())
             full = gk.compose(gk.to_planes(a, self.dtype),
@@ -610,8 +628,8 @@ class QPager(QEngine):
 
     def _host_split(self, start, length, perm):
         """Host-staged split fallback (mesh shrink / tiny results)."""
-        planes = np.asarray(jax.device_get(self._state), dtype=np.float64)
         n = self.qubit_count
+        planes = self._fetch(0, 1 << n)
         hi, mid, lo = 1 << (n - start - length), 1 << length, 1 << start
         a = (planes[0] + 1j * planes[1]).reshape(hi, mid, lo)
         if perm is not None:
@@ -637,7 +655,7 @@ class QPager(QEngine):
             return self._host_split(start, length, None)
         rem, dest = self._p_decompose(n, start, length, True)(self._state)
         self._state = rem
-        d = np.asarray(jax.device_get(dest), dtype=np.float64)
+        d = np.asarray(_host_read(dest), dtype=np.float64)
         vec = d[0] + 1j * d[1]
         nrm = np.linalg.norm(vec)
         return vec / nrm if nrm > 0 else vec
@@ -776,8 +794,27 @@ class QPager(QEngine):
     # state access
     # ------------------------------------------------------------------
 
+    def _fetch(self, offset: int, length: int) -> np.ndarray:
+        """(2, length) host-side planes window, float64.
+
+        Multi-host safe: when this process cannot address every shard
+        (a mesh spanning jax.distributed processes), the window is
+        replicated through a collective program first — the only legal
+        read pattern on such meshes (see parallel/cluster.py)."""
+        if self._state.is_fully_addressable:
+            return np.asarray(
+                jax.device_get(self._state[:, offset:offset + length]),
+                dtype=np.float64)
+        from .cluster import replicate_program
+
+        prog = _program(self._key("replicate", length),
+                        lambda: replicate_program(self.mesh, length))
+        return np.asarray(_host_read(prog(self._state, offset)),
+                          dtype=np.float64)
+
     def GetQuantumState(self) -> np.ndarray:
-        return gk.from_planes(jax.device_get(self._state))
+        planes = self._fetch(0, 1 << self.qubit_count)
+        return planes[0] + 1j * planes[1]
 
     def SetQuantumState(self, state) -> None:
         st = np.asarray(state).reshape(-1)
@@ -786,8 +823,8 @@ class QPager(QEngine):
         self._state = jax.device_put(gk.to_planes(st, self.dtype), self.sharding)
 
     def GetAmplitude(self, perm: int) -> complex:
-        amp = np.asarray(jax.device_get(self._state[:, perm]), dtype=np.float64)
-        return complex(amp[0], amp[1])
+        amp = self._fetch(perm, 1)
+        return complex(amp[0, 0], amp[1, 0])
 
     def SetAmplitude(self, perm: int, amp: complex) -> None:
         amp = complex(amp)
@@ -822,7 +859,7 @@ class QPager(QEngine):
             rng=self.rng.spawn(), do_normalize=self.do_normalize,
             rand_global_phase=self.rand_global_phase,
         )
-        c._state = jnp.array(self._state, copy=True)
+        c._state = jax.jit(jnp.copy)(self._state)
         return c
 
     def CloneEmpty(self) -> "QPager":
@@ -848,10 +885,15 @@ class QPager(QEngine):
         )
 
     def IsZeroAmplitude(self) -> bool:
-        return not bool(jnp.any(self._state != 0))
+        def build():
+            return jax.jit(lambda s: jnp.any(s != 0),
+                           out_shardings=NamedSharding(self.mesh, P()))
+
+        return not bool(_host_read(_program(self._key("iszero"), build)(self._state)))
 
     def GetAmplitudePage(self, offset: int, length: int) -> np.ndarray:
-        return gk.from_planes(jax.device_get(self._state[:, offset:offset + length]))
+        planes = self._fetch(offset, length)
+        return planes[0] + 1j * planes[1]
 
     def SetAmplitudePage(self, page, offset: int) -> None:
         sh = self.sharding
