@@ -859,9 +859,13 @@ def SetAceMaxQb(sid, qb: int) -> None:
 
 
 def SetSparseAceMaxMb(sid, mb: int) -> None:
-    from .config import get_config
+    q = _sim(sid)
+    if hasattr(q, "SetSparseAceMaxMb"):
+        q.SetSparseAceMaxMb(int(mb))
+    else:
+        from .config import get_config
 
-    get_config().max_alloc_mb = int(mb)
+        get_config().max_alloc_mb = int(mb)
 
 
 def ResetUnitaryFidelity(sid) -> None:

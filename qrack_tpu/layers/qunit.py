@@ -224,6 +224,10 @@ class QUnit(QInterface):
         self.is_ace = (os.environ.get("QRACK_DISABLE_QUNIT_FIDELITY_GUARD", "0")
                        not in ("", "0"))
         self.ace_qubits: Optional[int] = None  # extra width cap (SetAceMaxQubits)
+        # per-instance sparse-entangle budget (reference: QUnit::aceMb
+        # seeded from QRACK_SPARSE_MAX_ALLOC_MB_DEFAULT, src/qunit.cpp:94)
+        self.ace_mb: Optional[int] = int(
+            os.environ.get("QRACK_SPARSE_MAX_ALLOC_MB", "512"))
         self.log_fidelity = 0.0
         # TrySeparate tolerance (reference: QRACK_QUNIT_SEPARABILITY_THRESHOLD)
         self.sep_threshold = (
@@ -251,6 +255,14 @@ class QUnit(QInterface):
 
     def SetAceMaxQubits(self, qb: Optional[int]) -> None:
         self.ace_qubits = qb
+
+    def SetSparseAceMaxMb(self, mb: Optional[int]) -> None:
+        """Per-instance RAM cap for entangling SPARSE subsystems
+        (reference: QUnit::aceMb, include/qunit.hpp:705; enforced at
+        entangle time against the PRODUCT of sparse amplitude counts,
+        src/qunit.cpp:451-461) — distinct from the global dense-ket
+        QRACK_MAX_ALLOC_MB cap."""
+        self.ace_mb = mb
 
     def GetUnitaryFidelity(self) -> float:
         f = math.exp(self.log_fidelity)
@@ -284,17 +296,35 @@ class QUnit(QInterface):
         src/qunit.cpp:455-477; enforces QRACK_MAX_ALLOC_MB)."""
         total = 0
         seen = set()
+        units = []
         for q in qubits:
             s = self.shards[q]
             if s.cached:
                 total += 1
             elif id(s.unit) not in seen:
                 seen.add(id(s.unit))
+                units.append(s.unit)
                 total += s.unit.qubit_count
         if self.ace_qubits is not None and total > self.ace_qubits:
             raise MemoryError(
                 f"QUnit entangle would span {total} qubits > ACE cap "
                 f"{self.ace_qubits}")
+        if units and all(hasattr(u, "nnz") for u in units) and self.ace_mb:
+            # sparse subsystems: account the PRODUCT of amplitude counts
+            # against this instance's sparse-ACE budget (reference:
+            # SPARSE_KEY_BYTES * prod(GetAmplitudeCount()) > aceMb,
+            # src/qunit.cpp:451-461)
+            mem = 24  # 8B index + 16B amplitude per entry
+            for u in units:
+                mem *= max(u.nnz(), 1)
+            mem <<= max(total - sum(u.qubit_count for u in units), 0)
+            if mem > (self.ace_mb << 20):
+                raise MemoryError(
+                    f"QUnit sparse entangle worst case {mem >> 20} MB "
+                    f"> sparse ACE cap {self.ace_mb} MB")
+            return
+        # sparse cap disabled (or mixed/dense units): the dense
+        # worst-case guard below still applies
         max_mb = self.config.max_alloc_mb
         if max_mb and (16 << total) > (max_mb << 20):
             raise MemoryError(

@@ -803,39 +803,78 @@ class QStabilizer(QInterface):
             self.S(q)
         return out
 
+    def DisposeZ(self, q: int) -> bool:
+        """Tableau-native disposal of ONE Z-eigenstate qubit: O(n) row
+        ops + one row/column delete, exact at any width (closes the
+        round-2 'wide tableau disposal pending' hole; the reference
+        disposes via its Decompose machinery, src/qstabilizer.cpp).
+        Returns the eigenvalue bit of the disposed qubit.
+
+        Method: the destabilizer rows with X support on q index exactly
+        the stabilizer generators whose product is ±Z_q (the
+        Aaronson–Gottesman determinism argument).  Folding them into a
+        pivot (with contravariant destabilizer fixes) makes the pivot
+        stabilizer literally ±Z_q; multiplying it away clears Z_q
+        support everywhere else, the pivot destabilizer is re-seated as
+        X_q, and the decoupled (X_q, ±Z_q) pair plus column q delete."""
+        self._check_qubit(q)
+        if not self.IsSeparableZ(q):
+            raise CliffordError("DisposeZ requires a Z-eigenstate qubit")
+        n = self.qubit_count
+        out = {}
+
+        def upd():
+            hits = np.nonzero(self.x[0:n, q])[0]
+            p = int(hits[0])
+            for i in hits[1:]:
+                i = int(i)
+                self._rowsum(p + n, i + n)   # pivot stab *= partner stab
+                self._rowsum(i, p)           # contravariant destab fix
+            out["b"] = bool(self.r[p + n])   # pivot is now exactly ±Z_q
+            for i in range(2 * n):
+                if i != p + n and i != p and self.z[i, q]:
+                    self._rowsum(i, p + n)   # clear Z_q support elsewhere
+            rows = ([i for i in range(n) if i != p]
+                    + [i + n for i in range(n) if i != p])
+            cols = [j for j in range(n) if j != q]
+            nn = n - 1
+            x = np.zeros((2 * nn + 1, nn), dtype=np.uint8)
+            z = np.zeros((2 * nn + 1, nn), dtype=np.uint8)
+            r = np.zeros(2 * nn + 1, dtype=np.uint8)
+            if nn:
+                x[:2 * nn] = self.x[np.ix_(rows, cols)]
+                z[:2 * nn] = self.z[np.ix_(rows, cols)]
+            r[:2 * nn] = self.r[rows]
+            self.x = np.ascontiguousarray(x)
+            self.z = np.ascontiguousarray(z)
+            self.r = r
+            self.qubit_count = nn
+
+        if not self._track_phase:
+            upd()
+            return out["b"]
+
+        lo = (1 << q) - 1
+
+        def true_amp(old, w):
+            w = int(w)
+            return old((w & lo) | ((w >> q) << (q + 1)) | (out["b"] << q))
+
+        self._phase_track(upd, true_amp)
+        return out["b"]
+
     def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
         """Drop qubits that are Z eigenstates (the common post-measurement
-        path). General separable disposal is a later-round extension."""
-        n = self.qubit_count
+        path), one tableau-native DisposeZ each — exact at any width.
+        General separable (non-Z-basis) disposal still routes through
+        measurement first."""
         for q in range(start, start + length):
             if not self.IsSeparableZ(q):
                 raise NotImplementedError(
                     "tableau Dispose requires Z-eigenstate qubits; measure first"
                 )
-        new_n = n - length
-        sub = QStabilizer(new_n, rng=self.rng.spawn())
-        # re-derive by projecting the ket for small n (exactness first;
-        # tableau-native truncation is a later-round optimization)
-        if n <= 20:
-            st = self.GetQuantumState()
-            m = st.reshape(-1)
-            from ..utils.bits import deposit_indices
-
-            base = deposit_indices(n, list(range(start, start + length)))
-            off = 0
-            for q in range(start, start + length):
-                if self._deterministic_outcome(q):
-                    off |= 1 << q
-            vec = m[base | off]
-            nrm = np.linalg.norm(vec)
-            if nrm > 0:
-                vec = vec / nrm
-            sub.SetQuantumState(vec)
-            self.x, self.z, self.r = sub.x, sub.z, sub.r
-            self.phase_offset = sub.phase_offset
-            self.qubit_count = new_n
-            return
-        raise NotImplementedError("wide tableau disposal pending")
+        for q in range(start + length - 1, start - 1, -1):
+            self.DisposeZ(q)
 
     def Decompose(self, start: int, dest: "QStabilizer") -> None:
         length = dest.qubit_count

@@ -143,10 +143,67 @@ class QStabilizerHybrid(QInterface):
         if mat.is_invert(s):
             self._invert_to_phase(q)
             s = self.shards[q]
-        if mat.is_phase(s) and self.use_t_gadget and self._anc < self.max_ancilla:
+        if mat.is_phase(s) and self.use_t_gadget and self._ancilla_room():
             self._t_gadget(q)
         else:
             self.SwitchToEngine()
+
+    def _recycle_ancillae(self, only: Optional[int] = None) -> int:
+        """Dispose gadget ancillae whose magic went dead (reference
+        reuses/disposes dead ancillae, src/qstabilizerhybrid.cpp:206-239
+        and the ancilla disposal in FlushBuffers).
+
+        Once the tableau separates an ancilla into a Z eigenstate |b>
+        (a later collapse, or a gadget on an eigenstate qubit), the
+        deferred postselection <0| shard |b> reduces to a scalar: it
+        folds into phase_offset exactly (probability 1/2, no fidelity
+        cost) and the tableau column frees via DisposeZ.  This bounds
+        ancilla growth under long T streams with interleaved
+        measurements instead of forcing SwitchToEngine."""
+        freed = 0
+        n = self.qubit_count
+        positions = ([only] if only is not None
+                     else range(n + self._anc - 1, n - 1, -1))
+        for a in positions:
+            s = self.shards[a]
+            # rotate a separable ancilla into the Z basis; the shard is
+            # compensated by the inverse rotation on its input side
+            if self.stab.IsSeparableZ(a):
+                eff, undo = s, None
+            elif self.stab.IsSeparableX(a):
+                self.stab.H(a)
+                eff, undo = s @ np.asarray(mat.H2), ("H",)
+            elif self.stab.IsSeparableY(a):
+                self.stab.IS(a)
+                self.stab.H(a)
+                eff = s @ (np.asarray(mat.S2) @ np.asarray(mat.H2))
+                undo = ("IS", "H")  # applied order to revert: H then S
+            else:
+                continue
+            b = 1 if self.stab.Prob(a) >= 0.5 else 0
+            amp = complex(eff[0, b])
+            if abs(amp) <= 1e-12:
+                # postselection annihilates this branch: leave the
+                # ancilla for the (error-raising) materialized path
+                if undo == ("H",):
+                    self.stab.H(a)
+                elif undo:
+                    self.stab.H(a)
+                    self.stab.S(a)
+                continue
+            self.stab.DisposeZ(a)
+            self.stab.phase_offset *= amp / abs(amp)
+            del self.shards[a]
+            self._anc -= 1
+            freed += 1
+        return freed
+
+    def _ancilla_room(self) -> bool:
+        """Room for one more gadget ancilla, recycling dead ones first."""
+        if self._anc < self.max_ancilla:
+            return True
+        self._recycle_ancillae()
+        return self._anc < self.max_ancilla
 
     def _t_gadget(self, q: int) -> None:
         """Reverse T-injection (reference: src/qstabilizerhybrid.cpp:
@@ -185,6 +242,10 @@ class QStabilizerHybrid(QInterface):
         # ancilla shard = H . P(residual): buffered magic, never blocked
         # because ancillae receive no further gates
         self.shards.append(np.asarray(mat.H2, dtype=np.complex128) @ gate)
+        # a gadget on a Z-eigenstate qubit leaves THE FRESH ancilla
+        # separable: its magic is already a scalar — reclaim it now
+        # (older ancillae cannot have separated here; skip their scans)
+        self._recycle_ancillae(only=a)
 
     # ------------------------------------------------------------------
     # gate primitive
@@ -212,7 +273,7 @@ class QStabilizerHybrid(QInterface):
             # part before it poisons the qubit (reference gadgets the
             # phase shard the moment a non-commuting gate arrives,
             # src/qstabilizerhybrid.cpp:206-239)
-            if cur is not None and self.use_t_gadget and self._anc < self.max_ancilla:
+            if cur is not None and self.use_t_gadget and self._ancilla_room():
                 # stored shards are never Clifford (they'd have folded at
                 # store time), so only the monomial salvage paths exist
                 if mat.is_invert(cur):
@@ -282,14 +343,34 @@ class QStabilizerHybrid(QInterface):
             self.SwitchToEngine()
             return self.engine.ForceM(q, result, do_force, do_apply)
         if self._anc and self._touches_ancilla(q):
-            # collapse must follow the true (ancilla-weighted)
-            # distribution (reference: src/qstabilizerhybrid.cpp:1560-1570)
-            self.SwitchToEngine()
-            return self.engine.ForceM(q, result, do_force, do_apply)
+            # the outcome must follow the true (ancilla-weighted)
+            # marginal (reference: src/qstabilizerhybrid.cpp:1560-1570),
+            # but the Z collapse itself commutes with the ancilla
+            # shards + postselection (they act on DIFFERENT qubits): so
+            # draw via a materialized clone, then force the collapse on
+            # the live tableau — the stabilizer representation survives
+            # the measurement and dead ancillae recycle right after
+            p1 = self.Prob(q)
+            if not do_force:
+                result = bool(self.rng.rand() < p1)
+            else:
+                result = bool(result)
+                if (p1 if result else 1.0 - p1) <= 1e-12:
+                    raise RuntimeError("ForceM on zero-probability branch")
+            if not do_apply:
+                return result
+            if s is not None:
+                self.shards[q] = None  # diagonal shard dies with collapse
+            self.stab.ForceM(q, result, do_force=True, do_apply=True)
+            self._recycle_ancillae()
+            return result
         if s is not None and do_apply:
             self.shards[q] = None  # diagonal shard is destroyed by collapse
         # the tableau draws from OUR stream for reproducibility
         self.stab.rng = self.rng
+        # this branch is only reached when q is disjoint from every
+        # ancilla (_touches_ancilla was False), so the collapse cannot
+        # have separated any — no recycle sweep needed here
         return self.stab.ForceM(q, result, do_force, do_apply)
 
     # ------------------------------------------------------------------

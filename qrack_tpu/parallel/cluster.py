@@ -36,14 +36,22 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_INITIALIZED = False
+
+
 def is_initialized() -> bool:
     """True once jax.distributed has been brought up in this process."""
+    if _INITIALIZED:
+        return True
     try:
         from jax._src import distributed
 
         return distributed.global_state.client is not None
-    except (ImportError, AttributeError):  # private API moved
-        return jax.process_count() > 1
+    except (ImportError, AttributeError):
+        # private API moved: fall back to the module flag alone —
+        # touching jax.process_count() here would initialize the
+        # backend and break the initialize we are guarding
+        return False
 
 
 def init_cluster(
@@ -76,14 +84,19 @@ def init_cluster(
         # single-process: nothing to bring up (mirrors the reference,
         # where cluster backends are compile-time optional)
         return
-    if jax.config.jax_platforms and "cpu" in str(jax.config.jax_platforms):
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # gloo is the cpu backend's only cross-process wire format; setting
+    # it is a no-op for TPU backends, so select it unconditionally
+    # (checking the platform here would initialize the backend, which
+    # must not happen before jax.distributed.initialize)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         local_device_ids=local_device_ids,
     )
+    global _INITIALIZED
+    _INITIALIZED = True
 
 
 def process_count() -> int:

@@ -116,3 +116,50 @@ def test_compose_width_guard():
     b = QEngineSparse(40, rng=QrackRandom(2), rand_global_phase=False)
     with pytest.raises(MemoryError):
         a.Compose(b)
+
+
+def test_qunit_sparse_ace_mb_budget():
+    """Per-instance sparse entangle budget (reference: QUnit::aceMb,
+    src/qunit.cpp:451-461): the product of sparse amplitude counts is
+    accounted against SetSparseAceMaxMb, not the global dense cap."""
+    from qrack_tpu.layers.qunit import QUnit
+
+    def sparse_factory(n, **kw):
+        kw.setdefault("rand_global_phase", False)
+        return QEngineSparse(n, **kw)
+
+    q = QUnit(60, unit_factory=sparse_factory, rng=QrackRandom(3),
+              rand_global_phase=False)
+    # build two entangled 15-qubit sparse units with 2^15 entries each
+    for base in (0, 15):
+        for i in range(base, base + 15):
+            q.H(i)
+        for i in range(base, base + 14):
+            q.CNOT(i, i + 1)
+    # 2^30 product entries * 24B ~ 24 GB >> 1 MB cap
+    q.SetSparseAceMaxMb(1)
+    with pytest.raises(MemoryError):
+        q._merge_budget_check([0, 15])
+    # end-to-end: the blocked entangle surfaces as the ACE advisory
+    with pytest.raises(RuntimeError):
+        q.CZ(0, 15)
+        q._flush_all()
+    # disabling the sparse cap re-enables the dense worst-case guard
+    q.SetSparseAceMaxMb(None)
+    with pytest.raises(MemoryError):
+        q.config.max_alloc_mb = 1
+        try:
+            q._merge_budget_check([0, 15])
+        finally:
+            q.config.max_alloc_mb = 1 << 20
+    # a generous cap admits the same entangle
+    q2 = QUnit(60, unit_factory=sparse_factory, rng=QrackRandom(3),
+               rand_global_phase=False)
+    q2.H(0)
+    q2.CNOT(0, 1)
+    q2.H(2)
+    q2.CNOT(2, 3)
+    q2.SetSparseAceMaxMb(512)
+    q2.CZ(0, 2)
+    q2._flush_all()
+    assert abs(q2.ProbAll(0) - 0.25) < 1e-6

@@ -372,3 +372,41 @@ def test_layer_stacks_exact_phase_parity():
         for e in engs[1:]:
             np.testing.assert_allclose(e.GetQuantumState(), a, atol=1e-8,
                                        err_msg=f"{trial} {type(e).__name__}")
+
+
+def test_dispose_z_native_parity_and_wide():
+    """Tableau-native DisposeZ: exact amplitude parity vs the dense
+    oracle after forced collapse, and works far past the old 20-qubit
+    ket-projection cap (closes 'wide tableau disposal pending')."""
+    rng = np.random.Generator(np.random.PCG64(5))
+    gates = ["H", "S", "X", "Y", "Z", "CNOT", "CZ"]
+    for trial in range(25):
+        n = int(rng.integers(2, 7))
+        st = QStabilizer(n, rng=QrackRandom(trial), rand_global_phase=False)
+        o = QEngineCPU(n, rng=QrackRandom(trial), rand_global_phase=False)
+        for _ in range(int(rng.integers(5, 25))):
+            g = gates[int(rng.integers(0, len(gates)))]
+            if g in ("CNOT", "CZ"):
+                a, b = rng.choice(n, 2, replace=False)
+                getattr(st, g)(int(a), int(b))
+                getattr(o, g)(int(a), int(b))
+            else:
+                q = int(rng.integers(0, n))
+                getattr(st, g)(q)
+                getattr(o, g)(q)
+        q = int(rng.integers(0, n))
+        st.rng = o.rng = QrackRandom(999 + trial)
+        r = st.ForceM(q, False, do_force=False)
+        o.ForceM(q, r, do_force=True)
+        assert st.DisposeZ(q) == r
+        o.Dispose(q, 1, int(r))
+        np.testing.assert_allclose(
+            st.GetQuantumState(), o.GetQuantumState(), atol=1e-7)
+
+    st = QStabilizer(40, rng=QrackRandom(1))
+    for i in range(39):
+        st.CNOT(i, i + 1)
+    st.H(0)
+    st.ForceM(20, False, do_force=False)
+    st.DisposeZ(20)
+    assert st.qubit_count == 39

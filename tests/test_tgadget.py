@@ -230,3 +230,47 @@ def test_clifford_pair_measures_on_tableau_despite_unrelated_ancilla():
     b1 = q.M(1)
     assert b0 == b1       # Bell correlation preserved
     assert q.engine is None  # never materialized (would be 2^31)
+
+
+def test_ancilla_recycling_bounds_long_t_stream():
+    """Dead gadget ancillae recycle via tableau-native DisposeZ instead
+    of accumulating toward max_ancilla (reference reuses/disposes dead
+    ancillae, src/qstabilizerhybrid.cpp:206-239)."""
+    q = make(4, 2)
+    max_seen = 0
+    for rnd in range(60):
+        t = rnd % 4
+        q.H(t)
+        q.T(t)
+        q.H(t)
+        q.M(t)
+        max_seen = max(max_seen, q._anc)
+        assert q.engine is None, f"materialized at round {rnd}"
+    assert max_seen <= 2
+
+
+def test_magic_measurement_statistics_follow_true_marginal():
+    # H.T.H|0>: P(0) = cos^2(pi/8) — the outcome draw must weight the
+    # buffered ancilla magic even though collapse stays on the tableau
+    wins, n = 0, 600
+    for seed in range(n):
+        q = make(1, seed)
+        q.H(0)
+        q.T(0)
+        q.H(0)
+        wins += 0 if q.M(0) else 1
+    p = wins / n
+    assert abs(p - math.cos(math.pi / 8) ** 2) < 0.05
+
+
+def test_post_collapse_amplitudes_match_oracle_without_materializing():
+    for seed in range(6):
+        h = make(3, seed)
+        o = oracle(3, seed)
+        for eng in (h, o):
+            eng.H(0); eng.T(0); eng.H(0); eng.CNOT(0, 1); eng.T(1); eng.H(1)
+        r = h.ForceM(0, False, do_force=False)
+        o.ForceM(0, r, do_force=True)
+        assert h.engine is None
+        np.testing.assert_allclose(
+            h.GetQuantumState(), o.GetQuantumState(), atol=1e-7)
