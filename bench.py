@@ -160,12 +160,19 @@ def _measure(width: int, samples: int):
     fn = jax.jit(body, donate_argnums=(0,))
     planes = fn(planes)
     planes.block_until_ready()
+    prof_dir = os.environ.get("QRACK_BENCH_PROFILE")
+    if prof_dir:
+        # xplane dump for MFU/HBM analysis (SURVEY §5 tracing row);
+        # wraps only the timed region so compile time stays out
+        jax.profiler.start_trace(prof_dir)
     times = []
     for _ in range(samples):
         t0 = time.perf_counter()
         planes = fn(planes)
         planes.block_until_ready()
         times.append(time.perf_counter() - t0)
+    if prof_dir:
+        jax.profiler.stop_trace()
     st = _stats(times)
     if WORKLOAD == "xeb":
         st["xeb_fidelity"] = round(_xeb_from_planes(planes, width), 6)

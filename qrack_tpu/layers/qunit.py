@@ -1316,6 +1316,26 @@ class QUnit(QInterface):
         self._reg_op("INCS", [(start, length)], [overflow_index],
                      lambda u, b, e: u.INCS(to_add, b[0], length, e[0]))
 
+    def INCBCD(self, to_add: int, start: int, length: int) -> None:
+        self._reg_op("INCBCD", [(start, length)], [],
+                     lambda u, b, e: u.INCBCD(to_add, b[0], length))
+
+    def DECBCD(self, to_sub: int, start: int, length: int) -> None:
+        self._reg_op("DECBCD", [(start, length)], [],
+                     lambda u, b, e: u.DECBCD(to_sub, b[0], length))
+
+    def INCDECBCDC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
+        self._reg_op("INCDECBCDC", [(start, length)], [carry_index],
+                     lambda u, b, e: u.INCDECBCDC(to_add, b[0], length, e[0]))
+
+    def INCBCDC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
+        self._reg_op("INCBCDC", [(start, length)], [carry_index],
+                     lambda u, b, e: u.INCBCDC(to_add, b[0], length, e[0]))
+
+    def DECBCDC(self, to_sub: int, start: int, length: int, carry_index: int) -> None:
+        self._reg_op("DECBCDC", [(start, length)], [carry_index],
+                     lambda u, b, e: u.DECBCDC(to_sub, b[0], length, e[0]))
+
     def INCDECSC(self, to_add: int, start: int, length: int, *flags) -> None:
         self._reg_op("INCDECSC", [(start, length)], list(flags),
                      lambda u, b, e: u.INCDECSC(to_add, b[0], length, *e))

@@ -558,7 +558,9 @@ class QStabilizerHybrid(QInterface):
 # ALU / register ops: not Clifford — materialize, then use the engine's
 # vectorized kernels (reference: ALU is engine-level; the tableau never
 # sees it)
-for _name in ("INC", "CINC", "INCDECC", "INCS", "INCDECSC", "MUL", "DIV",
+for _name in ("INC", "CINC", "INCDECC", "INCS", "INCDECSC",
+              "INCBCD", "DECBCD", "INCDECBCDC", "INCBCDC", "DECBCDC",
+              "MUL", "DIV",
               "CMUL", "CDIV", "MULModNOut", "IMULModNOut", "CMULModNOut",
               "CIMULModNOut", "POWModNOut", "CPOWModNOut", "IndexedLDA",
               "IndexedADC", "IndexedSBC", "Hash", "PhaseFlipIfLess",

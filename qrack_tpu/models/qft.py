@@ -16,7 +16,6 @@ comparable with the gate-at-a-time path.
 
 from __future__ import annotations
 
-import cmath
 import math
 
 import numpy as np
@@ -34,18 +33,35 @@ def _h_mp(dtype):
     return jnp.stack([re, jnp.zeros_like(re)])
 
 
+def _stage_phase(planes, pairs):
+    """ONE fused elementwise pass applying a whole stage's controlled
+    phases: diagonal gates commute, so their product is a single
+    exp(i*theta(idx)) with theta = sum over (c, t, ang) of
+    ang * bit_c(idx) * bit_t(idx).  Collapsing the reference's
+    kernel-per-gate chain (test/benchmarks.cpp test_qft_*) to one HBM
+    pass per stage bounds both traffic and XLA temp pressure at
+    O(n) passes for the whole QFT instead of O(n^2)."""
+    acc = jnp.float64 if planes.dtype == jnp.float64 else jnp.float32
+    idx = jax.lax.iota(jnp.int32, planes.shape[-1])
+    theta = jnp.zeros(planes.shape[-1], dtype=acc)
+    for c, t, ang in pairs:
+        on = ((idx >> c) & (idx >> t) & 1).astype(acc)
+        theta = theta + on * acc(ang)
+    fre = jnp.cos(theta).astype(planes.dtype)
+    fim = jnp.sin(theta).astype(planes.dtype)
+    return gk.cmul(fre, fim, planes)
+
+
 def qft_planes(planes, n: int):
     """Single-shard QFT over all n qubits (pure, trace-safe)."""
     hm = _h_mp(planes.dtype)
     end = n - 1
     for i in range(n):
         h_bit = end - i
-        for j in range(i):
-            c, t = h_bit, h_bit + 1 + j
-            ph = cmath.exp(1j * math.pi / (1 << (j + 1)))
-            cmask = 1 << c
-            planes = gk.apply_diag(planes, 1.0, 0.0, ph.real, ph.imag,
-                                   n, 1 << t, cmask, cmask)
+        if i:
+            planes = _stage_phase(planes, [
+                (h_bit, h_bit + 1 + j, math.pi / (1 << (j + 1)))
+                for j in range(i)])
         planes = gk.apply_2x2(planes, hm, n, h_bit)
     return planes
 
@@ -53,12 +69,10 @@ def qft_planes(planes, n: int):
 def iqft_planes(planes, n: int):
     hm = _h_mp(planes.dtype)
     for i in range(n):
-        for j in range(i):
-            c, t = (i) - (j + 1), i
-            ph = cmath.exp(-1j * math.pi / (1 << (j + 1)))
-            cmask = 1 << c
-            planes = gk.apply_diag(planes, 1.0, 0.0, ph.real, ph.imag,
-                                   n, 1 << t, cmask, cmask)
+        if i:
+            planes = _stage_phase(planes, [
+                (i - (j + 1), i, -math.pi / (1 << (j + 1)))
+                for j in range(i)])
         planes = gk.apply_2x2(planes, hm, n, i)
     return planes
 
@@ -93,17 +107,22 @@ def _sharded_h(local, hm, L, npg, target):
     return local * dd + other * s
 
 
-def _sharded_cphase(local, L, c, t, ph_re, ph_im):
-    """Controlled phase with split local/page masks — always collective-free."""
+def _sharded_stage_phase(local, L, pairs):
+    """Whole stage of controlled phases as ONE collective-free
+    elementwise pass (split local/page bit reads; see _stage_phase)."""
     pid = jax.lax.axis_index("pages")
     idx = gk.iota_for(local)
-    cmask, tmask = 1 << c, 1 << t
-    clo, chi = cmask & ((1 << L) - 1), cmask >> L
-    tlo, thi = tmask & ((1 << L) - 1), tmask >> L
-    on = (((idx & clo) == clo) if clo else (pid & chi) == chi) & \
-         (((idx & tlo) != 0) if tlo else ((pid & thi) != 0))
-    fre = jnp.where(on, jnp.asarray(ph_re, local.dtype), jnp.ones((), local.dtype))
-    fim = jnp.where(on, jnp.asarray(ph_im, local.dtype), jnp.zeros((), local.dtype))
+
+    def gbit(b):
+        return ((idx >> b) & 1) if b < L else ((pid >> (b - L)) & 1)
+
+    acc = jnp.float64 if local.dtype == jnp.float64 else jnp.float32
+    theta = jnp.zeros(local.shape[-1], dtype=acc)
+    for c, t, ang in pairs:
+        on = (gbit(c) & gbit(t)).astype(acc)
+        theta = theta + on * acc(ang)
+    fre = jnp.cos(theta).astype(local.dtype)
+    fim = jnp.sin(theta).astype(local.dtype)
     return gk.cmul(fre, fim, local)
 
 
@@ -123,15 +142,17 @@ def make_sharded_qft_fn(mesh: Mesh, n: int, inverse: bool = False):
         if not inverse:
             for i in range(n):
                 h_bit = end - i
-                for j in range(i):
-                    ph = cmath.exp(1j * math.pi / (1 << (j + 1)))
-                    local = _sharded_cphase(local, L, h_bit, h_bit + 1 + j, ph.real, ph.imag)
+                if i:
+                    local = _sharded_stage_phase(local, L, [
+                        (h_bit, h_bit + 1 + j, math.pi / (1 << (j + 1)))
+                        for j in range(i)])
                 local = _sharded_h(local, hm, L, npg, h_bit)
         else:
             for i in range(n):
-                for j in range(i):
-                    ph = cmath.exp(-1j * math.pi / (1 << (j + 1)))
-                    local = _sharded_cphase(local, L, i - (j + 1), i, ph.real, ph.imag)
+                if i:
+                    local = _sharded_stage_phase(local, L, [
+                        (i - (j + 1), i, -math.pi / (1 << (j + 1)))
+                        for j in range(i)])
                 local = _sharded_h(local, hm, L, npg, i)
         return local
 

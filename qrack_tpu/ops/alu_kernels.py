@@ -480,3 +480,84 @@ def incdecsc_src_split(xp, pid, lidx, L, to_add, start, length, carry_index,
     ob = split_bit_get(xp, sp, sl, L, overflow_index)
     fp, fl = split_bit_set(xp, sp, sl, L, overflow_index, ob ^ 1)
     return xp.where(ovf, fp, sp), xp.where(ovf, fl, sl)
+
+
+# ---------------------------------------------------------------------------
+# BCD arithmetic (reference kernels incbcd/incdecbcdc,
+# src/common/qheader_bcd.cl:1-143): the register is packed 4-bit decimal
+# digits; to_add is a DECIMAL integer whose digits add nibble-wise with
+# decimal carries.  Non-BCD inputs (any nibble > 9) pass through
+# unchanged.  Gather form: dst digits v (valid) receive src
+# bcd_sub(v, to_add); the borrow out of the top digit reproduces the
+# forward kernel's carry-out.
+# ---------------------------------------------------------------------------
+
+
+def bcd_digits(to_add: int, nibbles: int):
+    """Decimal digits of to_add, little-endian, host-side."""
+    ds = []
+    ta = int(to_add)
+    for _ in range(nibbles):
+        ds.append(ta % 10)
+        ta //= 10
+    return ds
+
+
+def _bcd_sub(xp, v, digits, nibbles: int):
+    """(src_value, borrow_out, valid): decimal digit-wise v - digits
+    (mod 10^nibbles), vectorized with a static digit unroll.  `digits`
+    may be host ints or a traced int array (the wide-pager programs
+    pass digits as data so one compile serves every addend)."""
+    out = xp.zeros_like(v)
+    borrow = xp.zeros_like(v)
+    valid = xp.ones_like(v, dtype=bool)
+    for j in range(nibbles):
+        d = (v >> (4 * j)) & 15
+        valid = valid & (d <= 9)
+        s = d - digits[j] - borrow
+        neg = s < 0
+        s = xp.where(neg, s + 10, s)
+        out = out | (s << (4 * j))
+        borrow = xp.where(neg, xp.ones_like(borrow), xp.zeros_like(borrow))
+    return out, borrow, valid
+
+
+def incbcd_src(xp, idx, to_add, start, length):
+    """INCBCD (reference kernel incbcd, qheader_bcd.cl:1-67)."""
+    nibbles = length // 4
+    v = _reg_get(xp, idx, start, length)
+    src_v, _, valid = _bcd_sub(xp, v, bcd_digits(to_add, nibbles), nibbles)
+    src = _reg_set(xp, idx, start, length, src_v)
+    return xp.where(valid, src, idx)
+
+
+def incbcd_src_split(xp, pid, lidx, L, digits, start, length):
+    nibbles = length // 4
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    src_v, _, valid = _bcd_sub(xp, v, digits, nibbles)
+    sp, sl = split_reg_set(xp, pid, lidx, L, start, length, src_v)
+    return xp.where(valid, sp, pid), xp.where(valid, sl, lidx)
+
+
+def incdecbcdc_src(xp, idx, to_add, start, length, carry_index):
+    """INCDECBCDC (reference kernel incdecbcdc, qheader_bcd.cl:67-143):
+    carry-out = carry-in XOR decimal-overflow, so the inverse XORs the
+    top-digit borrow back into the carry bit."""
+    nibbles = length // 4
+    v = _reg_get(xp, idx, start, length)
+    src_v, borrow, valid = _bcd_sub(xp, v, bcd_digits(to_add, nibbles), nibbles)
+    src = _reg_set(xp, idx, start, length, src_v)
+    src = src ^ (borrow << carry_index)
+    return xp.where(valid, src, idx)
+
+
+def incdecbcdc_src_split(xp, pid, lidx, L, digits, start, length, carry_index):
+    nibbles = length // 4
+    v = split_reg_get(xp, pid, lidx, L, start, length)
+    src_v, borrow, valid = _bcd_sub(xp, v, digits, nibbles)
+    sp, sl = split_reg_set(xp, pid, lidx, L, start, length, src_v)
+    if carry_index < L:
+        sl = sl ^ (borrow << carry_index)
+    else:
+        sp = sp ^ (borrow << (carry_index - L))
+    return xp.where(valid, sp, pid), xp.where(valid, sl, lidx)

@@ -126,6 +126,69 @@ def gather_ring(local, npg: int, L: int, split_body, targs, keep_default=None):
     return out
 
 
+def compose_ring(a_local, b, npg: int, L_in: int, start: int, n1: int, n2: int):
+    """Device-side Compose: out = A (x) B with B's qubits inserted at
+    `start`, built per page with bounded memory (reference:
+    CombineEngines assembles each target page from one source page at a
+    time, src/qpager.cpp:316-367).
+
+    Runs INSIDE a shard_map body: `a_local` is this page's (2, 2^L_in)
+    planes of the n1-qubit ket A, `b` the REPLICATED (2, 2^n2) planes
+    of B.  Each output element out[(pid, i)] = A[a_src] * B[j] with
+    (a_src, j) decoded from the output's split (page, local) index; the
+    ring rotates A's pages so every page sees each source block once.
+    Peak per-device memory: out block + one A page + B — never a full
+    gather of A (the GSPMD fallback could choose one).  Rounds where
+    the source page is always the resident page (B below the page
+    bits) skip the rotation entirely and the program is collective-free.
+    Requires n1, n2 <= 31 (int32 index lanes); wider composes use the
+    einsum fallback."""
+    pid = page_id()
+    L_out = L_in + n2
+    i = jax.lax.iota(gk.IDX_DTYPE, 1 << L_out)
+
+    def field(lo: int, width: int):
+        """Bits [lo, lo+width) of the global output index, split-read
+        from (i, pid) without forming a >int32 global index."""
+        if width <= 0:
+            return jnp.zeros((), gk.IDX_DTYPE)
+        out = jnp.zeros((), gk.IDX_DTYPE)
+        take = 0
+        if lo < L_out:
+            take = min(width, L_out - lo)
+            out = (i >> lo) & ((1 << take) - 1)
+        if lo + width > L_out:
+            plo = max(lo, L_out) - L_out
+            pw = lo + width - max(lo, L_out)
+            out = out | (((pid >> plo) & ((1 << pw) - 1)) << take)
+        return out
+
+    l = field(0, start)
+    j = field(start, n2)
+    h = field(start + n2, n1 - start)
+    a_src = (h << start) | l
+    sp = a_src >> L_in
+    sl = a_src & ((1 << L_in) - 1)
+    br, bi = b[0][j], b[1][j]
+    # B below the page bits (start <= L_in): the source page id equals
+    # the resident page id for every element — no rotation needed
+    aligned = start <= L_in
+    out = jnp.zeros((a_local.shape[0], 1 << L_out), a_local.dtype)
+    buf = a_local
+    perm = [(k, (k - 1) % npg) for k in range(npg)]
+    for k in range(npg if not aligned else 1):
+        holder = (pid + k) % npg
+        take = sp == holder if not aligned else None
+        ar, ai = buf[0][sl], buf[1][sl]
+        vr = ar * br - ai * bi
+        vi = ar * bi + ai * br
+        vals = jnp.stack([vr, vi])
+        out = vals if take is None else jnp.where(take, vals, out)
+        if k + 1 < npg and not aligned:
+            buf = jax.lax.ppermute(buf, "pages", perm)
+    return out
+
+
 def split_masks(mask: int, val: int, local_bits: int):
     lmask = mask & ((1 << local_bits) - 1)
     lval = val & ((1 << local_bits) - 1)

@@ -572,6 +572,21 @@ class QPager(QEngine):
 
         return _program(self._key("compose", n1, n2, start), build)
 
+    def _p_compose_ring(self, n1, n2, start):
+        from ..ops import sharded as shb
+
+        mesh, npg, L = self.mesh, self.n_pages, self.local_bits
+
+        def build():
+            def f(a, b):
+                return shb.compose_ring(a, b, npg, L, start, n1, n2)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=(P(None, "pages"), P()),
+                out_specs=P(None, "pages")), donate_argnums=(0,))
+
+        return _program(self._key("composering", n1, n2, start), build)
+
     def _k_compose(self, other, start) -> None:
         n1, n2 = self.qubit_count, other.qubit_count
         if self._mesh_would_change(n1 + n2):
@@ -588,7 +603,18 @@ class QPager(QEngine):
             b = other._state  # device-to-device: same device set
         else:
             b = gk.to_planes(np.asarray(other.GetQuantumState()), self.dtype)
-        new_state = self._p_compose(n1, n2, start)(self._state, b)
+        if (n1 <= 31 and n2 <= self.local_bits
+                and (n1 + n2 - self.g_bits) <= 31):
+            # ring outer product: per-device memory bounded to one A
+            # page + replicated B + the output block (reference
+            # CombineEngines discipline, src/qpager.cpp:316-367) —
+            # GSPMD's einsum partitioning is free to all-gather A.
+            # B IS replicated here, so the path is gated on B at most
+            # one page's size (n2 <= local_bits); bigger composed-in
+            # states keep the einsum form, where GSPMD may shard B
+            new_state = self._p_compose_ring(n1, n2, start)(self._state, b)
+        else:
+            new_state = self._p_compose(n1, n2, start)(self._state, b)
         self._sharding_for(n1 + n2)
         self._state = new_state
 

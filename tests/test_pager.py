@@ -6,6 +6,8 @@ MetaSwap page permutation, meta-controlled page selection."""
 
 import math
 
+import jax.numpy as jnp
+
 import numpy as np
 import pytest
 
@@ -320,3 +322,49 @@ def test_tensornetwork_over_pager_materializes_fused():
     assert t.M(1) == o.M(1)
     np.testing.assert_allclose(t.GetQuantumState(), o.GetQuantumState(),
                                atol=3e-5)
+
+
+def test_compose_ring_all_starts_and_no_allgather():
+    """The ring Compose kernel (reference CombineEngines discipline,
+    src/qpager.cpp:316-367): exact at every insertion point on 8 pages,
+    and the compiled HLO contains no all-gather of the paged ket —
+    cross-page movement rides collective-permute only."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from qrack_tpu.ops import sharded as shb
+    from qrack_tpu.ops import gatekernels as gk
+
+    n1, n2 = 6, 3
+    for start in (0, 2, 3, 5, 6):
+        o, p = make_pair(n1)
+        other_o = QEngineCPU(n2, rng=QrackRandom(31), rand_global_phase=False)
+        other_p = QEngineCPU(n2, rng=QrackRandom(31), rand_global_phase=False)
+        for eng in (o, p):
+            eng.H(1)
+            eng.CNOT(1, 4)
+            eng.T(4)
+        for eng in (other_o, other_p):
+            eng.H(0)
+            eng.CNOT(0, 2)
+        o.Compose(other_o, start)
+        p.Compose(other_p, start)
+        assert_match(o, p)
+
+    # HLO inspection: jit the ring body at an unaligned start (crosses
+    # pages) with B replicated — no all-gather may appear
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("pages",))
+    L = n1 - 3
+
+    def f(a, b):
+        return shb.compose_ring(a, b, 8, L, n1, n1, n2)
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(None, "pages"), P()),
+        out_specs=P(None, "pages")))
+    a = jnp.zeros((2, 1 << n1), dtype=jnp.float32)
+    a = jax.device_put(a, jax.sharding.NamedSharding(mesh, P(None, "pages")))
+    b = jnp.zeros((2, 1 << n2), dtype=jnp.float32)
+    hlo = fn.lower(a, b).compile().as_text()
+    assert "all-gather" not in hlo, "ring compose must not all-gather the ket"
+    assert "collective-permute" in hlo, "ring compose should ppermute"

@@ -146,12 +146,13 @@ def test_qunit_sparse_ace_mb_budget():
         q._flush_all()
     # disabling the sparse cap re-enables the dense worst-case guard
     q.SetSparseAceMaxMb(None)
+    saved_mb = q.config.max_alloc_mb
     with pytest.raises(MemoryError):
         q.config.max_alloc_mb = 1
         try:
             q._merge_budget_check([0, 15])
         finally:
-            q.config.max_alloc_mb = 1 << 20
+            q.config.max_alloc_mb = saved_mb
     # a generous cap admits the same entangle
     q2 = QUnit(60, unit_factory=sparse_factory, rng=QrackRandom(3),
                rand_global_phase=False)
