@@ -200,33 +200,13 @@ def MAll(sid) -> int:
 
 
 def _transform_pauli_basis(q, bases, qubits) -> int:
-    """Rotate X/Y observables into Z; returns the joint mask (reference:
+    """Delegates to the layer-overridable QInterface method (reference:
     TransformPauliBasis, src/pinvoke_api.cpp)."""
-    from .pauli import Pauli
-
-    mask = 0
-    for b, qi in zip(bases, qubits):
-        p = Pauli(b)
-        if p == Pauli.PauliX:
-            q.H(qi)
-        elif p == Pauli.PauliY:
-            q.IS(qi)
-            q.H(qi)
-        if p != Pauli.PauliI:
-            mask |= 1 << qi
-    return mask
+    return q._transform_pauli_basis(bases, qubits)
 
 
 def _revert_pauli_basis(q, bases, qubits) -> None:
-    from .pauli import Pauli
-
-    for b, qi in zip(bases, qubits):
-        p = Pauli(b)
-        if p == Pauli.PauliX:
-            q.H(qi)
-        elif p == Pauli.PauliY:
-            q.H(qi)
-            q.S(qi)
+    q._revert_pauli_basis(bases, qubits)
 
 
 def Measure(sid, bases: Sequence[int], qubits: Sequence[int]) -> bool:
@@ -715,39 +695,24 @@ def FactorizedVarianceFpRdm(sid, qubits, weights, round_rz: bool = True) -> floa
 
 
 def PauliExpectation(sid, bases: Sequence[int], qubits: Sequence[int]) -> float:
-    """<P> for a Pauli string: +-1 eigenvalues weighted by parity."""
-    q = _sim(sid)
-    mask = _transform_pauli_basis(q, bases, qubits)
-    p_odd = q.ProbParity(mask) if mask else 0.0
-    _revert_pauli_basis(q, bases, qubits)
-    return 1.0 - 2.0 * p_odd
+    """<P> for a Pauli string (reference: PauliExpectation,
+    src/pinvoke_api.cpp) — layer-overridable QInterface method."""
+    return float(_sim(sid).ExpectationPauliAll(list(qubits), list(bases)))
 
 
 def PauliVariance(sid, bases: Sequence[int], qubits: Sequence[int]) -> float:
-    e = PauliExpectation(sid, bases, qubits)
-    return max(0.0, 1.0 - e * e)  # P^2 == I for any Pauli string
+    return float(_sim(sid).VariancePauliAll(list(qubits), list(bases)))
 
 
 def _rotated_stat(sid, qubits, mtrxs, eigenvals, variance: bool):
     """Expectation/variance of per-qubit observables diagonalized by the
     given 2x2 unitaries (reference: UnitaryExpectation/MatrixExpectation
-    family, include/pinvoke_api.hpp:86-104). Rotation is applied by
-    conjugation and undone afterwards."""
+    family, include/pinvoke_api.hpp:86-104) — delegates to the
+    layer-overridable ExpectationUnitaryAll/VarianceUnitaryAll."""
     q = _sim(sid)
-    ms = [np.asarray(m, dtype=np.complex128).reshape(2, 2) for m in mtrxs]
-    for qi, m in zip(qubits, ms):
-        q.Mtrx(np.conj(m.T), qi)
-    try:
-        # reference defaults each qubit's observable to +1/-1 eigenvalues
-        # (ExpVarUnitaryAll, src/qinterface/qinterface.cpp:478)
-        w = ([1.0, -1.0] * len(list(qubits)) if eigenvals is None
-             else [float(v) for v in eigenvals])
-        stat = (q.VarianceFloatsFactorized(list(qubits), w) if variance
-                else q.ExpectationFloatsFactorized(list(qubits), w))
-    finally:
-        for qi, m in zip(qubits, ms):
-            q.Mtrx(m, qi)
-    return float(stat)
+    if variance:
+        return float(q.VarianceUnitaryAll(list(qubits), mtrxs, eigenvals))
+    return float(q.ExpectationUnitaryAll(list(qubits), mtrxs, eigenvals))
 
 
 def _u3(theta, phi, lambd):

@@ -292,11 +292,6 @@ class QEngine(QInterface):
                        xp, pid, lidx, L, digits, start, length),
                    (alu.bcd_digits(to_add, length // 4),)))
 
-    def DECBCD(self, to_sub: int, start: int, length: int) -> None:
-        """Reference: QAlu::DECBCD, src/qalu.cpp:155-159."""
-        max_val = 10 ** (length // 4) if length else 1
-        self.INCBCD(max_val - (to_sub % max_val), start, length)
-
     def INCDECBCDC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
         """Packed-BCD add with carry-out XOR (reference kernel
         incdecbcdc, src/common/qheader_bcd.cl:67-143)."""
@@ -313,22 +308,6 @@ class QEngine(QInterface):
                    lambda xp, pid, lidx, L, digits: alu.incdecbcdc_src_split(
                        xp, pid, lidx, L, digits, start, length, carry_index),
                    (alu.bcd_digits(to_add, length // 4),)))
-
-    def INCBCDC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
-        """Reference: QAlu::INCBCDC, src/qalu.cpp:163-172."""
-        if self.M(carry_index):
-            self.X(carry_index)
-            to_add = to_add + 1
-        self.INCDECBCDC(to_add, start, length, carry_index)
-
-    def DECBCDC(self, to_sub: int, start: int, length: int, carry_index: int) -> None:
-        """Reference: QAlu::DECBCDC, src/qalu.cpp:175-189."""
-        if self.M(carry_index):
-            self.X(carry_index)
-        else:
-            to_sub = to_sub + 1
-        max_val = 10 ** (length // 4) if length else 1
-        self.INCDECBCDC(max_val - (to_sub % max_val), start, length, carry_index)
 
     def INCS(self, to_add: int, start: int, length: int, overflow_index: int) -> None:
         if not length:

@@ -182,6 +182,27 @@ class AluMixin:
             inv -= 1
         self.INCDECC(inv, start, length, carry_index)
 
+    # -- BCD derived ops over the INCBCD/INCDECBCDC primitives
+    #    (reference: src/qalu.cpp:155-189 DECBCD/INCBCDC/DECBCDC) --
+
+    def DECBCD(self, to_sub: int, start: int, length: int) -> None:
+        max_val = 10 ** (length // 4) if length else 1
+        self.INCBCD(max_val - (to_sub % max_val), start, length)
+
+    def INCBCDC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
+        if self.M(carry_index):
+            self.X(carry_index)
+            to_add = to_add + 1
+        self.INCDECBCDC(to_add, start, length, carry_index)
+
+    def DECBCDC(self, to_sub: int, start: int, length: int, carry_index: int) -> None:
+        if self.M(carry_index):
+            self.X(carry_index)
+        else:
+            to_sub = to_sub + 1
+        max_val = 10 ** (length // 4) if length else 1
+        self.INCDECBCDC(max_val - (to_sub % max_val), start, length, carry_index)
+
     # -- signed variants (reference: src/qalu.cpp INCS/INCSC/DECS/DECSC) --
 
     def _signed_overflow_range(self, to_add: int, length: int) -> Tuple[int, int]:

@@ -382,6 +382,79 @@ class QInterfaceBase:
             vals[k] = v
         return self._variance_from(dist, vals)
 
+    # -- Pauli / single-qubit-unitary tensor observables, overridable at
+    #    the layer level (reference: ExpectationPauliAll /
+    #    VariancePauliAll / ExpectationUnitaryAll,
+    #    include/qinterface.hpp:2688-2712; ExpVarUnitaryAll,
+    #    src/qinterface/qinterface.cpp:478) --
+
+    def _transform_pauli_basis(self, paulis, bits) -> int:
+        """Rotate X/Y observables into Z; returns the joint Z mask
+        (reference: TransformPauliBasis, src/pinvoke_api.cpp)."""
+        from ..pauli import Pauli
+
+        mask = 0
+        for b, qi in zip(paulis, bits):
+            p = Pauli(b)
+            if p == Pauli.PauliX:
+                self.H(qi)
+            elif p == Pauli.PauliY:
+                self.IS(qi)
+                self.H(qi)
+            if p != Pauli.PauliI:
+                mask |= 1 << qi
+        return mask
+
+    def _revert_pauli_basis(self, paulis, bits) -> None:
+        from ..pauli import Pauli
+
+        for b, qi in zip(paulis, bits):
+            p = Pauli(b)
+            if p == Pauli.PauliX:
+                self.H(qi)
+            elif p == Pauli.PauliY:
+                self.H(qi)
+                self.S(qi)
+
+    def ExpectationPauliAll(self, bits: Sequence[int], paulis: Sequence[int]) -> float:
+        """<P_1 (x) P_2 (x) ...> by basis conjugation: +-1 eigenvalues
+        weighted by joint parity."""
+        mask = self._transform_pauli_basis(paulis, bits)
+        try:
+            p_odd = self.ProbParity(mask) if mask else 0.0
+        finally:
+            self._revert_pauli_basis(paulis, bits)
+        return 1.0 - 2.0 * p_odd
+
+    def VariancePauliAll(self, bits: Sequence[int], paulis: Sequence[int]) -> float:
+        e = self.ExpectationPauliAll(bits, paulis)
+        return max(0.0, 1.0 - e * e)  # P^2 == I for any Pauli string
+
+    def _unitary_stat(self, bits, basis_ops, eigen_vals, variance: bool) -> float:
+        """Expectation/variance of per-qubit observables diagonalized by
+        the given 2x2 unitaries; conjugation is applied and undone."""
+        ms = [np.asarray(m, dtype=np.complex128).reshape(2, 2)
+              for m in basis_ops]
+        for qi, m in zip(bits, ms):
+            self.Mtrx(np.conj(m.T), qi)
+        try:
+            w = ([1.0, -1.0] * len(list(bits)) if eigen_vals is None
+                 else [float(v) for v in eigen_vals])
+            stat = (self.VarianceFloatsFactorized(list(bits), w) if variance
+                    else self.ExpectationFloatsFactorized(list(bits), w))
+        finally:
+            for qi, m in zip(bits, ms):
+                self.Mtrx(m, qi)
+        return float(stat)
+
+    def ExpectationUnitaryAll(self, bits: Sequence[int], basis_ops,
+                              eigen_vals=None) -> float:
+        return self._unitary_stat(bits, basis_ops, eigen_vals, False)
+
+    def VarianceUnitaryAll(self, bits: Sequence[int], basis_ops,
+                           eigen_vals=None) -> float:
+        return self._unitary_stat(bits, basis_ops, eigen_vals, True)
+
     # Reduced-density-matrix ("Rdm") variants: for exact simulation these
     # coincide with the plain versions; approximate layers override
     # (reference: include/qinterface.hpp:2483-2798 *Rdm family).

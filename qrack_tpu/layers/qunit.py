@@ -1292,6 +1292,61 @@ class QUnit(QInterface):
         extra_mapped = list(range(off, off + len(extra_bits)))
         call(unit, bases, extra_mapped)
 
+    # ------------------------------------------------------------------
+    # Fourier transforms: closed-form product fast path
+    # ------------------------------------------------------------------
+
+    def _product_fourier(self, start: int, length: int, inverse: bool) -> bool:
+        """Closed-form QFT/IQFT on a computational-basis register.
+
+        With every shard in range cached, definite, and bufferless, the
+        qrack gate order (reference: QInterface::QFT,
+        src/qinterface/qinterface.cpp:114) keeps the register a product
+        state — every controlled phase has a definite control (QFT) or
+        definite target (IQFT) — so the whole transform reduces to one
+        O(length^2) host pass over per-qubit phases instead of
+        length^2/2 buffered gate calls.  This is the reference
+        benchmark protocol's headline optimizer-stack case
+        (test_qft_permutation_init)."""
+        if not length:
+            return True
+        sh = self.shards[start:start + length]
+        bits = []
+        for s in sh:
+            if not s.cached or s.pending is not None or s.links:
+                return False
+            b = s.base_z_value()
+            if b is None:
+                return False
+            bits.append(b)
+        n = length
+        bv = np.asarray(bits, dtype=np.float64)
+        k = np.arange(n)
+        d = k[None, :] - k[:, None]                 # t - c
+        w = np.where(d > 0, np.exp2(-d.astype(np.float64)), 0.0)
+        if not inverse:
+            theta = math.pi * (bv @ w)              # on targets t
+        else:
+            theta = -math.pi * (w @ bv)             # on controls c
+        ph = np.exp(1j * theta) / math.sqrt(2.0)
+        inv_s2 = 1.0 / math.sqrt(2.0)
+        for idx, s in enumerate(sh):
+            a = s.amp0 + s.amp1                     # definite amp's phase
+            sgn = -1.0 if bits[idx] else 1.0
+            s.amp0 = a * inv_s2
+            s.amp1 = a * sgn * complex(ph[idx])
+        return True
+
+    def QFT(self, start: int, length: int, try_separate: bool = False) -> None:
+        if self._product_fourier(start, length, inverse=False):
+            return
+        super().QFT(start, length, try_separate)
+
+    def IQFT(self, start: int, length: int, try_separate: bool = False) -> None:
+        if self._product_fourier(start, length, inverse=True):
+            return
+        super().IQFT(start, length, try_separate)
+
     def INC(self, to_add: int, start: int, length: int) -> None:
         if not length:
             return
@@ -1320,21 +1375,9 @@ class QUnit(QInterface):
         self._reg_op("INCBCD", [(start, length)], [],
                      lambda u, b, e: u.INCBCD(to_add, b[0], length))
 
-    def DECBCD(self, to_sub: int, start: int, length: int) -> None:
-        self._reg_op("DECBCD", [(start, length)], [],
-                     lambda u, b, e: u.DECBCD(to_sub, b[0], length))
-
     def INCDECBCDC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
         self._reg_op("INCDECBCDC", [(start, length)], [carry_index],
                      lambda u, b, e: u.INCDECBCDC(to_add, b[0], length, e[0]))
-
-    def INCBCDC(self, to_add: int, start: int, length: int, carry_index: int) -> None:
-        self._reg_op("INCBCDC", [(start, length)], [carry_index],
-                     lambda u, b, e: u.INCBCDC(to_add, b[0], length, e[0]))
-
-    def DECBCDC(self, to_sub: int, start: int, length: int, carry_index: int) -> None:
-        self._reg_op("DECBCDC", [(start, length)], [carry_index],
-                     lambda u, b, e: u.DECBCDC(to_sub, b[0], length, e[0]))
 
     def INCDECSC(self, to_add: int, start: int, length: int, *flags) -> None:
         self._reg_op("INCDECSC", [(start, length)], list(flags),

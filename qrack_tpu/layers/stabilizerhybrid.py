@@ -559,7 +559,7 @@ class QStabilizerHybrid(QInterface):
 # vectorized kernels (reference: ALU is engine-level; the tableau never
 # sees it)
 for _name in ("INC", "CINC", "INCDECC", "INCS", "INCDECSC",
-              "INCBCD", "DECBCD", "INCDECBCDC", "INCBCDC", "DECBCDC",
+              "INCBCD", "INCDECBCDC",
               "MUL", "DIV",
               "CMUL", "CDIV", "MULModNOut", "IMULModNOut", "CMULModNOut",
               "CIMULModNOut", "POWModNOut", "CPOWModNOut", "IndexedLDA",

@@ -42,7 +42,7 @@ def _stage_phase(planes, pairs):
     pass per stage bounds both traffic and XLA temp pressure at
     O(n) passes for the whole QFT instead of O(n^2)."""
     acc = jnp.float64 if planes.dtype == jnp.float64 else jnp.float32
-    idx = jax.lax.iota(jnp.int32, planes.shape[-1])
+    idx = gk.iota_for(planes)
     theta = jnp.zeros(planes.shape[-1], dtype=acc)
     for c, t, ang in pairs:
         on = ((idx >> c) & (idx >> t) & 1).astype(acc)

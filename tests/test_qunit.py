@@ -256,3 +256,30 @@ def test_two_qubit_probe_nondestructive_on_entangled():
         eng.RY(0.4, 1)
     assert not q.TrySeparate((0, 1))
     assert fid(q, o) == pytest.approx(1.0, abs=1e-7)
+
+
+def test_product_fourier_fast_path_parity():
+    """Closed-form basis-register QFT/IQFT (the optimizer-stack headline
+    case, reference protocol test_qft_permutation_init): exact parity
+    with the gate path, zero engine dispatches, generic fallback when
+    the register is not a basis state."""
+    for trial in range(8):
+        perm = (trial * 23) & 63
+        start, length = (0, 6) if trial % 2 == 0 else (1, 4)
+        for inverse in (False, True):
+            u = QUnit(6, rng=QrackRandom(trial), rand_global_phase=False)
+            o = QEngineCPU(6, rng=QrackRandom(trial), rand_global_phase=False)
+            for eng in (u, o):
+                eng.SetPermutation(perm)
+                (eng.IQFT if inverse else eng.QFT)(start, length)
+            np.testing.assert_allclose(
+                u.GetQuantumState(), o.GetQuantumState(), atol=1e-10)
+            assert u.dispatch_count == 0
+    u = QUnit(5, rng=QrackRandom(3), rand_global_phase=False)
+    o = QEngineCPU(5, rng=QrackRandom(3), rand_global_phase=False)
+    for eng in (u, o):
+        eng.SetPermutation(9)
+        eng.RY(0.7, 2)
+        eng.QFT(0, 5)
+    np.testing.assert_allclose(u.GetQuantumState(), o.GetQuantumState(),
+                               atol=1e-7)
