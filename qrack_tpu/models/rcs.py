@@ -38,6 +38,36 @@ def rcs_layers(n: int, depth: int, seed: int):
     return plan
 
 
+def _iswap_layer(planes, n: int, pairs):
+    """A whole brick-wall ISwap layer as ONE transpose + ONE phase pass.
+
+    ISwap = SWAP . diag(1, i, i, 1): disjoint pairs make the layer's
+    permutation part a product of adjacent bit-axis swaps (a single
+    jnp.transpose) and its phase part i^(number of pairs whose bits
+    differ) — one fused elementwise multiply.  Collapses the
+    reference's kernel-per-coupler chain (test/benchmarks.cpp:4141) to
+    2 HBM passes per layer instead of n/2 4x4 contractions, and shrinks
+    the traced program accordingly (tunnel compile time scales with op
+    count)."""
+    import jax.numpy as jnp
+
+    shape = (2,) + (2,) * n
+    perm = list(range(n + 1))
+    for (a, b) in pairs:
+        pa, pb = n - a, n - b  # C-order: axis k holds bit n - k
+        perm[pa], perm[pb] = perm[pb], perm[pa]
+    out = planes.reshape(shape).transpose(perm).reshape(2, -1)
+    idx = gk.iota_for(out)
+    k = None
+    for (a, b) in pairs:
+        t = ((idx >> a) ^ (idx >> b)) & 1
+        k = t if k is None else k + t
+    k = k & 3
+    re = jnp.asarray([1.0, 0.0, -1.0, 0.0], dtype=planes.dtype)[k]
+    im = jnp.asarray([0.0, 1.0, 0.0, -1.0], dtype=planes.dtype)[k]
+    return gk.cmul(re, im, out)
+
+
 def make_rcs_fn(n: int, depth: int, seed: int):
     """Jittable single-chip whole-RCS program over (2, 2^n) planes."""
     plan = rcs_layers(n, depth, seed)
@@ -47,9 +77,8 @@ def make_rcs_fn(n: int, depth: int, seed: int):
             for q, g in enumerate(roots):
                 mp = gk.mtrx_planes(_ROOTS[g], planes.dtype)
                 planes = gk.apply_2x2(planes, mp, n, q)
-            for (a, b) in pairs:
-                mp4 = gk.mtrx_planes(_ISWAP4, planes.dtype)
-                planes = gk.apply_4x4(planes, mp4, n, a, b)
+            if pairs:
+                planes = _iswap_layer(planes, n, pairs)
         return planes
 
     return fn

@@ -1,31 +1,399 @@
-"""Second binding surface: JSON-RPC veneer over the flat API.
+"""Dedicated JS/WASM binding surface.
 
 Role parity with the reference's wasm_api (reference:
-include/wasm_api.hpp:158-414, src/wasm_api.cpp — the same simulator
-surface re-idiomized for emscripten/JS consumers with vectors instead
-of raw pointers).  The TPU-native equivalent of "callable from a web
-runtime" is a transport-friendly JSON-RPC 2.0 dispatcher: every
-function exported by qrack_tpu.capi is callable by name with JSON
-params, complex values marshal as [re, im] pairs and arrays as lists,
-so a JS/WASM (or any remote) consumer drives simulators over a pipe or
-socket without Python bindings.
+include/wasm_api.hpp:27-414, src/wasm_api.cpp — the simulator surface
+re-idiomized for emscripten/JS consumers: typed structs instead of raw
+pointers).  The TPU-native equivalent of "callable from a web runtime"
+is a transport-friendly JSON-RPC 2.0 service with an EXPLICIT export
+registry mirroring the reference's export list name for name, plus the
+same typed payloads re-idiomized as JSON objects:
 
-    >>> dispatch('{"jsonrpc":"2.0","method":"init_count","params":[2],"id":1}')
-    '{"jsonrpc": "2.0", "result": 0, "id": 1}'
+    QubitIndexState        {"q": 0, "v": true}
+    QubitIntegerExpVar     {"q": 0, "val": 3}      (or "val": [v0, v1])
+    QubitRealExpVar        {"q": 0, "val": 0.5}    (or "val": [v0, v1])
+    QubitPauliBasis        {"q": 0, "b": 3}
+    QubitU3Basis           {"q": 0, "b": [theta, phi, lambda]}
+    QubitMatrixBasis       {"q": 0, "b": [[re,im],[re,im],[re,im],[re,im]]}
+    ...EigenVal variants   + {"e": [e0, e1]}
 
-`serve_stdio()` runs a newline-delimited request loop (the shape an
-emscripten worker or electron sidecar would speak).
+Complex scalars marshal as [re, im]; complex matrices as flat pair
+lists.  `describe()` returns the export table so a JS client can
+enumerate the surface.  `dispatch()` speaks JSON-RPC 2.0 with proper
+error codes (-32700 parse, -32601 unknown method, -32602 bad params,
+-32000 runtime) and batch arrays; `serve_stdio()` runs the
+newline-delimited loop an emscripten worker or electron sidecar would
+speak.  Methods of the flat C ABI (capi.py, the pinvoke mirror) that
+the reference's wasm surface does not re-export remain reachable as a
+documented superset.
 """
 
 from __future__ import annotations
 
 import json
 import sys
-from typing import Any
+from typing import Any, Callable, Dict, List
 
 import numpy as np
 
 from . import capi
+
+# ---------------------------------------------------------------------------
+# typed payload decoding (reference structs, include/wasm_api.hpp:29-140)
+# ---------------------------------------------------------------------------
+
+
+def _cpx_matrix(flat):
+    """2x2 (or larger) complex payload from pair-list JSON."""
+    arr = np.asarray(flat, dtype=np.float64)
+    if arr.ndim == 2 and arr.shape[1] == 2:
+        return arr[:, 0] + 1j * arr[:, 1]
+    return arr.reshape(-1, 2)[:, 0] + 1j * arr.reshape(-1, 2)[:, 1]
+
+
+def _index_states(structs):
+    """[{"q", "v"}] -> (qubits, packed perm) for the mask helpers."""
+    qubits, perm = [], 0
+    for j, s in enumerate(structs):
+        qubits.append(int(s["q"]))
+        if s.get("v"):
+            perm |= 1 << j
+    return qubits, perm
+
+
+def _expvar_pairs(structs, is_int: bool):
+    """[{"q", "val"}] -> (qubits, flat per-bit weights).  A scalar val
+    weights the |1> branch (|0> weighs 0); a 2-list gives both branch
+    weights explicitly."""
+    qubits, weights = [], []
+    for s in structs:
+        qubits.append(int(s["q"]))
+        v = s["val"]
+        if isinstance(v, (list, tuple)):
+            w0, w1 = v[0], v[1]
+        else:
+            w0, w1 = 0, v
+        if is_int:
+            weights.extend([int(w0), int(w1)])
+        else:
+            weights.extend([float(w0), float(w1)])
+    return qubits, weights
+
+
+def _pauli_bases(structs):
+    qubits = [int(s["q"]) for s in structs]
+    bases = [int(s["b"]) for s in structs]
+    return bases, qubits
+
+
+def _eigen_of(structs, require: bool):
+    """Flattened per-qubit eigenvalue pairs; every struct must agree on
+    carrying "e" or not (reference: the EigenVal struct variants,
+    include/wasm_api.hpp:103-140)."""
+    have = ["e" in s for s in structs]
+    if require and not all(have):
+        raise ValueError("every struct needs 2 eigenvalues ('e') here")
+    if not any(have):
+        return None
+    if not all(have):
+        raise ValueError("mixed structs: either all or none carry 'e'")
+    eigen = []
+    for s in structs:
+        eigen.extend([float(x) for x in s["e"]])
+    return eigen
+
+
+def _u3_bases(structs, require_eigen: bool = False):
+    qubits = [int(s["q"]) for s in structs]
+    triples = [[float(x) for x in s["b"]] for s in structs]
+    return qubits, triples, _eigen_of(structs, require_eigen)
+
+
+def _matrix_bases(structs, require_eigen: bool = False):
+    qubits = [int(s["q"]) for s in structs]
+    mats = [_cpx_matrix(s["b"]).reshape(2, 2) for s in structs]
+    return qubits, mats, _eigen_of(structs, require_eigen)
+
+
+# ---------------------------------------------------------------------------
+# export registry (reference export list, include/wasm_api.hpp:158-414)
+# ---------------------------------------------------------------------------
+
+EXPORTS: Dict[str, Callable] = {}
+
+
+def _export(name: str, fn: Callable = None):
+    if fn is None:
+        def deco(f):
+            EXPORTS[name] = f
+            return f
+
+        return deco
+    EXPORTS[name] = fn
+    return fn
+
+
+# -- exports whose calling shape already matches the flat ABI --
+for _n in ("init_count_type", "init_count", "init_count_stabilizer", "init",
+           "init_clone", "destroy", "seed", "set_concurrency", "set_device",
+           "set_device_list", "allocateQubit", "release", "num_qubits",
+           "qstabilizer_out_to_file",
+           "qstabilizer_in_from_file", "random_choice", "Prob", "ProbRdm",
+           "PermutationExpectation", "PermutationExpectationRdm", "Variance",
+           "VarianceRdm", "PhaseParity", "PhaseRootN",
+           "JointEnsembleProbability", "M", "ForceM", "MAll", "ResetAll",
+           "X", "Y", "Z", "H", "S", "SX", "SY", "T", "AdjS", "AdjSX",
+           "AdjSY", "AdjT", "U", "MCX", "MCY", "MCZ", "MCH", "MCS", "MCT",
+           "MCAdjS", "MCAdjT", "MCU", "MACX", "MACY", "MACZ", "MACH",
+           "MACS", "MACT", "MACAdjS", "MACAdjT", "MX", "MY", "MZ", "R",
+           "MCR", "Exp", "MCExp", "SWAP", "ISWAP", "AdjISWAP", "FSim",
+           "CSWAP", "ACSWAP", "Compose", "Decompose", "Dispose", "AND",
+           "OR", "XOR", "NAND", "NOR", "XNOR", "CLAND", "CLOR", "CLXOR",
+           "CLNAND", "CLNOR", "CLXNOR", "QFT", "IQFT", "ADD", "SUB",
+           "ADDS", "SUBS", "MCADD", "MCSUB", "MUL", "DIV", "MULN", "DIVN",
+           "POWN", "MCMUL", "MCDIV", "MCMULN", "MCDIVN", "MCPOWN", "LDA",
+           "ADC", "SBC", "Hash", "TrySeparate1Qb", "TrySeparate2Qb",
+           "TrySeparateTol", "Separate", "GetUnitaryFidelity",
+           "ResetUnitaryFidelity", "SetSdrp", "SetNcrp",
+           "SetReactiveSeparate", "SetTInjection", "SetNoiseParameter",
+           "Normalize", "init_qneuron", "clone_qneuron", "destroy_qneuron",
+           "set_qneuron_angles", "qneuron_predict", "qneuron_unpredict",
+           "qneuron_learn_cycle", "qneuron_learn",
+           "qneuron_learn_permutation", "init_qcircuit",
+           "init_qcircuit_clone", "qcircuit_inverse",
+           "qcircuit_past_light_cone", "destroy_qcircuit",
+           "get_qcircuit_qubit_count", "qcircuit_swap", "qcircuit_run",
+           "qcircuit_out_to_file", "qcircuit_in_from_file"):
+    _export(_n, getattr(capi, _n))
+
+
+@_export("SetPermutation")
+def _set_permutation(sid, perm: int):
+    """Reference: SetPermutation(quid, bitCapInt) — wasm-only export
+    (the pinvoke mirror reaches it through ResetAll + X chains)."""
+    return capi._sim(sid).SetPermutation(int(perm))
+
+
+@_export("init_qbdd_count")
+def _init_qbdd_count(q: int) -> int:
+    """Reference: init_qbdd_count — pure QBdt-stack simulator."""
+    from .layers.qbdthybrid import QBdtHybrid
+
+    sid = capi._new_sid()
+    capi._REGISTRY[sid] = QBdtHybrid(q)
+    return sid
+
+
+@_export("Mtrx")
+def _mtrx(sid, m, q):
+    return capi.Mtrx(sid, _cpx_matrix(m), q)
+
+
+@_export("MCMtrx")
+def _mcmtrx(sid, c, m, q):
+    return capi.MCMtrx(sid, c, _cpx_matrix(m), q)
+
+
+@_export("MACMtrx")
+def _macmtrx(sid, c, m, q):
+    return capi.MACMtrx(sid, c, _cpx_matrix(m), q)
+
+
+@_export("UCMtrx")
+def _ucmtrx(sid, c, m, q, perm):
+    return capi.UCMtrx(sid, c, _cpx_matrix(m), q, perm)
+
+
+@_export("Multiplex1Mtrx")
+def _multiplex(sid, c, q, m):
+    return capi.Multiplex1Mtrx(sid, c, q, _cpx_matrix(m))
+
+
+@_export("qcircuit_append_1qb")
+def _qc_append_1qb(cid, m, q):
+    return capi.qcircuit_append_1qb(cid, _cpx_matrix(m), q)
+
+
+@_export("qcircuit_append_mc")
+def _qc_append_mc(cid, m, c, q, perm):
+    return capi.qcircuit_append_mc(cid, _cpx_matrix(m), c, q, perm)
+
+
+@_export("InKet")
+def _inket(sid, ket):
+    return capi.InKet(sid, _cpx_matrix(ket))
+
+
+# -- typed-struct observables (reference wasm_api.cpp:1878-2130) --
+
+@_export("PermutationProb")
+def _perm_prob(sid, structs):
+    qubits, perm = _index_states(structs)
+    return capi.PermutationProb(sid, qubits, perm)
+
+
+@_export("PermutationProbRdm")
+def _perm_prob_rdm(sid, structs, r=True):
+    qubits, perm = _index_states(structs)
+    return capi.PermutationProbRdm(sid, qubits, perm, r)
+
+
+@_export("FactorizedExpectation")
+def _fact_exp(sid, structs):
+    qubits, vals = _expvar_pairs(structs, True)
+    return capi.FactorizedExpectation(sid, qubits, vals)
+
+
+@_export("FactorizedExpectationRdm")
+def _fact_exp_rdm(sid, structs, r=True):
+    qubits, vals = _expvar_pairs(structs, True)
+    return capi.FactorizedExpectationRdm(sid, qubits, vals, r)
+
+
+@_export("FactorizedExpectationFp")
+def _fact_exp_fp(sid, structs):
+    qubits, ws = _expvar_pairs(structs, False)
+    return capi.FactorizedExpectationFp(sid, qubits, ws)
+
+
+@_export("FactorizedExpectationFpRdm")
+def _fact_exp_fp_rdm(sid, structs, r=True):
+    qubits, ws = _expvar_pairs(structs, False)
+    return capi.FactorizedExpectationFpRdm(sid, qubits, ws, r)
+
+
+@_export("FactorizedVariance")
+def _fact_var(sid, structs):
+    qubits, vals = _expvar_pairs(structs, True)
+    return capi.FactorizedVariance(sid, qubits, vals)
+
+
+@_export("FactorizedVarianceRdm")
+def _fact_var_rdm(sid, structs, r=True):
+    qubits, vals = _expvar_pairs(structs, True)
+    return capi.FactorizedVarianceRdm(sid, qubits, vals, r)
+
+
+@_export("FactorizedVarianceFp")
+def _fact_var_fp(sid, structs):
+    qubits, ws = _expvar_pairs(structs, False)
+    return capi.FactorizedVarianceFp(sid, qubits, ws)
+
+
+@_export("FactorizedVarianceFpRdm")
+def _fact_var_fp_rdm(sid, structs, r=True):
+    qubits, ws = _expvar_pairs(structs, False)
+    return capi.FactorizedVarianceFpRdm(sid, qubits, ws, r)
+
+
+@_export("PauliExpectation")
+def _pauli_exp(sid, structs):
+    bases, qubits = _pauli_bases(structs)
+    return capi.PauliExpectation(sid, bases, qubits)
+
+
+@_export("PauliVariance")
+def _pauli_var(sid, structs):
+    bases, qubits = _pauli_bases(structs)
+    return capi.PauliVariance(sid, bases, qubits)
+
+
+@_export("Measure")
+def _measure(sid, structs):
+    bases, qubits = _pauli_bases(structs)
+    return capi.Measure(sid, bases, qubits)
+
+
+@_export("UnitaryExpectation")
+def _unitary_exp(sid, structs):
+    qubits, triples, eigen = _u3_bases(structs)
+    if eigen is not None:
+        return capi.UnitaryExpectationEigenVal(sid, qubits, triples, eigen)
+    return capi.UnitaryExpectation(sid, qubits, triples)
+
+
+@_export("UnitaryVariance")
+def _unitary_var(sid, structs):
+    qubits, triples, eigen = _u3_bases(structs)
+    if eigen is not None:
+        return capi.UnitaryVarianceEigenVal(sid, qubits, triples, eigen)
+    return capi.UnitaryVariance(sid, qubits, triples)
+
+
+@_export("UnitaryExpectationEigenVal")
+def _unitary_exp_ev(sid, structs):
+    qubits, triples, eigen = _u3_bases(structs, require_eigen=True)
+    return capi.UnitaryExpectationEigenVal(sid, qubits, triples, eigen)
+
+
+@_export("UnitaryVarianceEigenVal")
+def _unitary_var_ev(sid, structs):
+    qubits, triples, eigen = _u3_bases(structs, require_eigen=True)
+    return capi.UnitaryVarianceEigenVal(sid, qubits, triples, eigen)
+
+
+@_export("MatrixExpectation")
+def _matrix_exp(sid, structs):
+    qubits, mats, eigen = _matrix_bases(structs)
+    if eigen is not None:
+        return capi.MatrixExpectationEigenVal(sid, qubits, mats, eigen)
+    return capi.MatrixExpectation(sid, qubits, mats)
+
+
+@_export("MatrixVariance")
+def _matrix_var(sid, structs):
+    qubits, mats, eigen = _matrix_bases(structs)
+    if eigen is not None:
+        return capi.MatrixVarianceEigenVal(sid, qubits, mats, eigen)
+    return capi.MatrixVariance(sid, qubits, mats)
+
+
+@_export("MatrixExpectationEigenVal")
+def _matrix_exp_ev(sid, structs):
+    qubits, mats, eigen = _matrix_bases(structs, require_eigen=True)
+    return capi.MatrixExpectationEigenVal(sid, qubits, mats, eigen)
+
+
+@_export("MatrixVarianceEigenVal")
+def _matrix_var_ev(sid, structs):
+    qubits, mats, eigen = _matrix_bases(structs, require_eigen=True)
+    return capi.MatrixVarianceEigenVal(sid, qubits, mats, eigen)
+
+
+# -- QNeuron knobs the flat ABI exposes via the object (reference:
+#    set_qneuron_alpha family, include/wasm_api.hpp:380-392) --
+
+@_export("set_qneuron_alpha")
+def _set_alpha(nid, alpha: float):
+    capi._neuron(nid).alpha = float(alpha)
+
+
+@_export("get_qneuron_alpha")
+def _get_alpha(nid) -> float:
+    return float(capi._neuron(nid).alpha)
+
+
+@_export("set_qneuron_activation_fn")
+def _set_act(nid, f: int):
+    from .qneuron import ActivationFn
+
+    capi._neuron(nid).activation_fn = ActivationFn(int(f))
+
+
+@_export("get_qneuron_activation_fn")
+def _get_act(nid) -> int:
+    return int(capi._neuron(nid).activation_fn)
+
+
+def describe() -> List[str]:
+    """The export table (reference analogue: the emscripten
+    EXPORTED_FUNCTIONS list) — JS clients enumerate this to build
+    their bindings."""
+    return sorted(EXPORTS)
+
+
+# ---------------------------------------------------------------------------
+# JSON-RPC 2.0 transport
+# ---------------------------------------------------------------------------
 
 
 def _to_jsonable(v: Any) -> Any:
@@ -50,54 +418,71 @@ def _to_jsonable(v: Any) -> Any:
     return repr(v)
 
 
-def _from_jsonable(v: Any) -> Any:
-    # [re, im] number pairs arrive as lists; leave them — capi accepts
-    # sequences and numpy coercion handles pairs where complex matrices
-    # are expected via `_complex_list`
-    return v
-
-
-def _complex_list(flat):
-    """JSON matrix payloads: flat [re, im, re, im, ...] or [[re, im], ...]."""
-    arr = np.asarray(flat, dtype=np.float64)
-    if arr.ndim == 2 and arr.shape[1] == 2:
-        return arr[:, 0] + 1j * arr[:, 1]
-    return arr.reshape(-1, 2)[:, 0] + 1j * arr.reshape(-1, 2)[:, 1]
-
-
-# methods whose named positional arg is a complex 2x2 (or list of them):
-# the JSON side sends real/imag pairs
-_MATRIX_ARG = {"Mtrx": 1, "MCMtrx": 2, "MACMtrx": 2, "UCMtrx": 2,
-               "Multiplex1Mtrx": 3}
+class UnknownMethod(Exception):
+    pass
 
 
 def call(method: str, params) -> Any:
-    if method.startswith("_") or not hasattr(capi, method):
-        raise AttributeError(f"unknown method {method!r}")
-    fn = getattr(capi, method)
-    params = list(params or [])
-    if method in _MATRIX_ARG:
-        i = _MATRIX_ARG[method]
-        params[i] = _complex_list(params[i])
-    if method == "InKet":
-        params[1] = _complex_list(params[1])
-    return fn(*params)
+    """Resolve through the typed registry first; the flat C ABI
+    (pinvoke mirror, capi.py) remains reachable as a documented
+    superset for methods the reference wasm surface lacks."""
+    if method == "describe":
+        return describe()
+    fn = EXPORTS.get(method)
+    if fn is None:
+        if method.startswith("_") or not hasattr(capi, method):
+            raise UnknownMethod(method)
+        fn = getattr(capi, method)
+    return fn(*(params or []))
+
+
+def _handle_one(req):
+    """Response dict for one request, or None for a notification (a
+    request without an "id" gets no response, per JSON-RPC 2.0)."""
+    rid = req.get("id") if isinstance(req, dict) else None
+    if isinstance(req, dict) and "method" in req and "id" not in req:
+        try:
+            call(req["method"], req.get("params", []))
+        except Exception:
+            pass  # notifications never get error responses either
+        return None
+    if not isinstance(req, dict) or "method" not in req:
+        return {"jsonrpc": "2.0", "id": rid,
+                "error": {"code": -32600, "message": "invalid request"}}
+    try:
+        result = call(req["method"], req.get("params", []))
+    except UnknownMethod as exc:
+        return {"jsonrpc": "2.0", "id": rid,
+                "error": {"code": -32601, "message": f"unknown method {exc}"}}
+    except (TypeError, IndexError, ValueError) as exc:
+        return {"jsonrpc": "2.0", "id": rid,
+                "error": {"code": -32602,
+                          "message": f"{type(exc).__name__}: {exc}"}}
+    except Exception as exc:
+        return {"jsonrpc": "2.0", "id": rid,
+                "error": {"code": -32000,
+                          "message": f"{type(exc).__name__}: {exc}"}}
+    return {"jsonrpc": "2.0", "result": _to_jsonable(result), "id": rid}
 
 
 def dispatch(request: str) -> str:
-    """Handle one JSON-RPC 2.0 request string; returns the response."""
-    rid = None
+    """Handle one JSON-RPC 2.0 request string (single or batch)."""
     try:
         req = json.loads(request)
-        rid = req.get("id")
-        result = call(req["method"], req.get("params", []))
-        return json.dumps({"jsonrpc": "2.0",
-                           "result": _to_jsonable(result), "id": rid})
-    except Exception as exc:  # JSON-RPC error object, never an exception
-        return json.dumps({"jsonrpc": "2.0",
-                           "error": {"code": -32000,
-                                     "message": f"{type(exc).__name__}: {exc}"},
-                           "id": rid})
+    except Exception as exc:
+        return json.dumps({"jsonrpc": "2.0", "id": None,
+                           "error": {"code": -32700,
+                                     "message": f"parse error: {exc}"}})
+    if isinstance(req, list):
+        if not req:
+            return json.dumps({"jsonrpc": "2.0", "id": None,
+                               "error": {"code": -32600,
+                                         "message": "empty batch"}})
+        out = [r for r in (_handle_one(x) for x in req) if r is not None]
+        # all-notification batches get no response body
+        return json.dumps(out) if out else ""
+    res = _handle_one(req)
+    return json.dumps(res) if res is not None else ""
 
 
 def serve_stdio(stdin=None, stdout=None) -> None:
@@ -110,8 +495,10 @@ def serve_stdio(stdin=None, stdout=None) -> None:
             continue
         if line == "quit":
             break
-        stdout.write(dispatch(line) + "\n")
-        stdout.flush()
+        resp = dispatch(line)
+        if resp:  # notifications produce no response line
+            stdout.write(resp + "\n")
+            stdout.flush()
 
 
 if __name__ == "__main__":

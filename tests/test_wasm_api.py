@@ -69,3 +69,48 @@ def test_stdio_server_roundtrip():
     lines = [json.loads(l) for l in res.stdout.strip().splitlines()]
     assert lines[0]["result"] == 0
     assert lines[2]["result"] == pytest.approx(0.5, abs=1e-9)
+
+
+def test_typed_struct_exports():
+    """The dedicated registry mirrors the reference export list
+    (include/wasm_api.hpp:158-414) with typed JSON struct payloads."""
+    from qrack_tpu import wasm_api
+
+    table = wasm_api.describe()
+    for name in ("PermutationProb", "PauliExpectation", "UnitaryExpectation",
+                 "MatrixExpectation", "FactorizedExpectation", "Measure",
+                 "init_qbdd_count", "set_qneuron_alpha", "SetPermutation",
+                 "qcircuit_append_mc", "MCADD", "TrySeparateTol"):
+        assert name in table, name
+    assert len(table) >= 160  # reference exports ~165 functions
+
+    sid = rpc("init_count", 2)["result"]
+    rpc("H", sid, 0)
+    rpc("MCX", sid, [0], 1)
+    # Bell state: <ZZ> = 1 via QubitPauliBasis structs
+    e = rpc("PauliExpectation", sid,
+            [{"q": 0, "b": 2}, {"q": 1, "b": 2}])["result"]
+    assert abs(e - 1.0) < 1e-8
+    # P(|11>) = 1/2 via QubitIndexState structs
+    p = rpc("PermutationProb", sid,
+            [{"q": 0, "v": True}, {"q": 1, "v": True}])["result"]
+    assert abs(p - 0.5) < 1e-8
+    # U3 struct observable: identity rotation -> <Z> = 0 on qubit 0
+    u = rpc("UnitaryExpectation", sid,
+            [{"q": 0, "b": [0.0, 0.0, 0.0]}])["result"]
+    assert abs(u) < 1e-8
+    # matrix payload roundtrip via typed Mtrx
+    rpc("Mtrx", sid, [[0, 0], [1, 0], [1, 0], [0, 0]], 0)  # X
+    rpc("destroy", sid)
+
+    # batch requests + error codes
+    import json
+    from qrack_tpu.wasm_api import dispatch
+
+    out = json.loads(dispatch(json.dumps([
+        {"jsonrpc": "2.0", "method": "init_count", "params": [1], "id": 1},
+        {"jsonrpc": "2.0", "method": "NoSuch", "id": 2},
+    ])))
+    assert out[0]["result"] >= 0
+    assert out[1]["error"]["code"] == -32601
+    rpc("destroy", out[0]["result"])
