@@ -205,6 +205,25 @@ def _baseline_seconds(width: int):
     return None, None
 
 
+def _passes(width: int) -> int:
+    """HBM read+write passes of the fused program (stage-fused QFT:
+    one phase pass + one H contraction per stage; RCS: one pass per
+    root gate + 2 per ISwap layer)."""
+    if WORKLOAD in ("rcs", "xeb"):
+        return DEPTH * (width + 2)
+    return 2 * width
+
+
+def _implied_hbm(width: int, avg_s: float) -> float:
+    """Implied HBM throughput in GB/s: each pass reads + writes both
+    (2^w float32/bf16) planes.  v5e peak is ~819 GB/s — a wildly
+    higher implied number means the wall-clock did NOT capture real
+    execution (see scripts/tpu_timing_probe.py)."""
+    esize = 2 if DTYPE == "bfloat16" else 4
+    bytes_moved = _passes(width) * 2 * (1 << width) * esize * 2
+    return bytes_moved / max(avg_s, 1e-12) / 1e9
+
+
 def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     try:
         base_s, base_src = _baseline_seconds(width)
@@ -227,6 +246,11 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     }
     if base_src:
         line["baseline_source"] = base_src
+    if WORKLOAD != "qft_unit":
+        ghbm = _implied_hbm(width, stats["avg"])
+        line["implied_hbm_gbps"] = round(ghbm, 1)
+        if ghbm > 1600.0:  # ~2x v5e peak: physically impossible
+            line["suspect_timing"] = True
     print(json.dumps(line), flush=True)
 
 
