@@ -876,11 +876,72 @@ class QStabilizer(QInterface):
         for q in range(start + length - 1, start - 1, -1):
             self.DisposeZ(q)
 
+    def _separable_1q_state(self, q: int):
+        """(basis, bit) for a single-basis-separable qubit: basis in
+        {'Z','X','Y'} and the eigenvalue bit, or None.  Each candidate
+        basis costs one net-identity conjugation (check + bit read in
+        the same rotated frame)."""
+        if self.IsSeparableZ(q):
+            return "Z", self._deterministic_outcome(q)
+        with self._phase_freeze():
+            self._h_gate(q)
+            if self.IsSeparableZ(q):
+                b = self._deterministic_outcome(q)
+                self._h_gate(q)
+                return "X", b
+            self._h_gate(q)
+            self.IS(q)
+            self._h_gate(q)
+            if self.IsSeparableZ(q):
+                b = self._deterministic_outcome(q)
+                self._h_gate(q)
+                self.S(q)
+                return "Y", b
+            self._h_gate(q)
+            self.S(q)
+        return None
+
+    def _decompose_product_span(self, start: int, dest: "QStabilizer") -> bool:
+        """Width-generic Decompose of a span whose qubits are each
+        single-basis separable (the common post-measurement shape):
+        read each qubit's eigenstate, rotate it to Z, DisposeZ it, and
+        synthesize `dest` as the product tableau — O(n) row ops per
+        qubit at ANY width (no 2^n ket is ever formed)."""
+        length = dest.qubit_count
+        states = []
+        for q in range(start, start + length):
+            s = self._separable_1q_state(q)
+            if s is None:
+                return False
+            states.append(s)
+        for q in range(start + length - 1, start - 1, -1):
+            basis, _ = states[q - start]
+            if basis == "X":
+                self.H(q)
+            elif basis == "Y":
+                self.IS(q)
+                self.H(q)
+            self.DisposeZ(q)
+        dest.SetPermutation(0, phase=1.0)
+        for j, (basis, b) in enumerate(states):
+            if b:
+                dest.X(j)
+            if basis == "X":
+                dest.H(j)
+            elif basis == "Y":
+                dest.H(j)
+                dest.S(j)
+        return True
+
     def Decompose(self, start: int, dest: "QStabilizer") -> None:
         length = dest.qubit_count
         n = self.qubit_count
+        if self._decompose_product_span(start, dest):
+            return
         if n > 20:
-            raise NotImplementedError("wide tableau decompose pending")
+            raise NotImplementedError(
+                "wide tableau decompose of an internally-entangled span "
+                "pending (product spans decompose at any width)")
         st = self.GetQuantumState()
         from ..engines.cpu import QEngineCPU
 

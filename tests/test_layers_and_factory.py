@@ -351,3 +351,36 @@ def test_tensornetwork_rebuffers_after_measurement():
         eng.H(4)
     assert q.isBuffering()
     assert fid(q, o) == pytest.approx(1.0, abs=1e-8)
+
+
+def test_noisy_xeb_fidelity_sweep():
+    """supreme_estimate-style sweep (reference:
+    test/benchmarks.cpp test_noisy_fidelity_*): run the same RCS plan
+    noiseless and at increasing depolarization; the measured state
+    fidelity against the ideal ket must decrease monotonically-ish with
+    noise and track the wrapper's logFidelity estimate to first order."""
+    from qrack_tpu.models.rcs import reference_rcs_state
+
+    n, depth, seed = 5, 4, 11
+    ideal_eng = cpu_factory(n, rng=QrackRandom(1))
+    ideal = reference_rcs_state(n, depth, seed, ideal_eng)
+
+    fids = []
+    for lam in (0.0, 0.01, 0.05):
+        # average over stochastic noise realizations
+        acc = 0.0
+        reps = 8 if lam else 1
+        for r in range(reps):
+            q = QInterfaceNoisy(n, inner_factory=cpu_factory, noise=lam,
+                                rng=QrackRandom(100 + r))
+            st = reference_rcs_state(n, depth, seed, q)
+            acc += abs(np.vdot(ideal, st)) ** 2
+        fids.append(acc / reps)
+    assert fids[0] > 0.999999
+    assert fids[0] > fids[1] > fids[2]
+    # first-order agreement between estimate and measurement at low noise
+    q = QInterfaceNoisy(n, inner_factory=cpu_factory, noise=0.01,
+                        rng=QrackRandom(5))
+    reference_rcs_state(n, depth, seed, q)
+    est = q.GetUnitaryFidelity()
+    assert 0.2 < fids[1] / est < 2.5, (fids[1], est)

@@ -1,8 +1,8 @@
-"""Multi-host distribution: a REAL 2-process jax.distributed run.
+"""Multi-host distribution: REAL 2- and 4-process jax.distributed runs.
 
-Two subprocesses each own 4 virtual CPU devices; cluster bring-up
+N subprocesses each own 8/N virtual CPU devices; cluster bring-up
 (parallel/cluster.py) joins them into one 8-device global mesh, and a
-QPager shards one coherent 7-qubit ket across both processes.  The
+QPager shards one coherent 7-qubit ket across every process.  The
 paged-target gates in the worker circuit ppermute shard halves across
 the process boundary (gloo standing in for DCN), proving the sharded
 kernels are mesh-shape agnostic — the exact property SURVEY.md §2.3

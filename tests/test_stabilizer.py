@@ -410,3 +410,68 @@ def test_dispose_z_native_parity_and_wide():
     st.ForceM(20, False, do_force=False)
     st.DisposeZ(20)
     assert st.qubit_count == 39
+
+
+def test_product_span_decompose_any_width():
+    """Width-generic Decompose of single-basis-separable spans: exact
+    rem (x) dest == original reconstruction, X/Y bases included, and a
+    40-qubit case that the old 2^n ket projection could never run."""
+    rng = np.random.Generator(np.random.PCG64(3))
+    gates = ["H", "S", "X", "Y", "Z", "CNOT", "CZ"]
+    for trial in range(15):
+        n = int(rng.integers(3, 7))
+        st = QStabilizer(n, rng=QrackRandom(trial), rand_global_phase=False)
+        for _ in range(int(rng.integers(5, 20))):
+            g = gates[int(rng.integers(0, len(gates)))]
+            if g in ("CNOT", "CZ"):
+                a, b = rng.choice(n, 2, replace=False)
+                getattr(st, g)(int(a), int(b))
+            else:
+                getattr(st, g)(int(rng.integers(0, n)))
+        start = int(rng.integers(0, n - 1))
+        length = int(rng.integers(1, min(3, n - start) + 1))
+        for q in range(start, start + length):
+            st.ForceM(q, False, do_force=False)
+        full = st.GetQuantumState()
+        dest = QStabilizer(length, rng=QrackRandom(500 + trial),
+                           rand_global_phase=False)
+        st.Decompose(start, dest)
+        rem = st.GetQuantumState()
+        dv = dest.GetQuantumState()
+        rebuilt = np.zeros(1 << n, complex)
+        for i in range(1 << (n - length)):
+            lo = i & ((1 << start) - 1)
+            hi = i >> start
+            for j in range(1 << length):
+                idx = lo | (j << start) | (hi << (start + length))
+                rebuilt[idx] = rem[i] * dv[j]
+        np.testing.assert_allclose(rebuilt, full, atol=1e-9)
+
+    # X/Y-separable span, reconstruction-verified (no measurement)
+    st = QStabilizer(4, rng=QrackRandom(21), rand_global_phase=False)
+    st.H(1)             # X eigenstate |+>
+    st.X(2)
+    st.H(2)
+    st.S(2)             # Y eigenstate |y->
+    st.H(0)
+    st.CNOT(0, 3)       # entangled REST around the span
+    st.S(0)
+    full = st.GetQuantumState()
+    dest = QStabilizer(2, rng=QrackRandom(22), rand_global_phase=False)
+    st.Decompose(1, dest)
+    rem = st.GetQuantumState()
+    dv = dest.GetQuantumState()
+    rebuilt = np.zeros(16, complex)
+    for i in range(4):
+        lo, hi = i & 1, i >> 1
+        for j in range(4):
+            rebuilt[lo | (j << 1) | (hi << 3)] = rem[i] * dv[j]
+    np.testing.assert_allclose(rebuilt, full, atol=1e-9)
+
+    st = QStabilizer(40, rng=QrackRandom(9))
+    st.H(10)
+    st.H(11)
+    st.S(11)
+    dest = QStabilizer(2, rng=QrackRandom(3))
+    st.Decompose(10, dest)
+    assert st.qubit_count == 38 and dest.qubit_count == 2
