@@ -1,0 +1,51 @@
+#!/bin/bash
+# One-shot TPU evidence campaign. Run when scripts/tpu_probe.py passes.
+# Every stage is a watchdogged child; output accumulates in bench_out/.
+# Order matters: timing honesty first (nothing else is quotable until
+# it passes), then sweeps, then mode A/Bs, then threshold tuning.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_out
+LOG=bench_out/campaign_$(date +%H%M).log
+{
+  echo "=== 0) health ==="
+  timeout 120 python scripts/tpu_probe.py || exit 1
+
+  echo "=== 1) timing honesty (w20, w22) ==="
+  timeout 560 python scripts/tpu_timing_probe.py 20
+  timeout 560 python scripts/tpu_timing_probe.py 22
+
+  echo "=== 2) qft sweep 20:26 (stage-fused programs) ==="
+  QRACK_BENCH=qft QRACK_BENCH_SWEEP=20:26 QRACK_BENCH_QB=26 \
+    QRACK_BENCH_BUDGET=1800 timeout 1860 python bench.py
+
+  echo "=== 3) bf16 w24 ==="
+  QRACK_BENCH=qft QRACK_BENCH_DTYPE=bfloat16 QRACK_BENCH_QB=24 \
+    QRACK_BENCH_QB_FIRST=24 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+
+  echo "=== 4) rcs + xeb w22 ==="
+  QRACK_BENCH=rcs QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=20 \
+    QRACK_BENCH_BUDGET=900 timeout 960 python bench.py
+  QRACK_BENCH=xeb QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+    QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+
+  echo "=== 5) pallas native A/B (w20) ==="
+  QRACK_USE_PALLAS=0 QRACK_BENCH=qft QRACK_BENCH_QB=20 \
+    QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
+  QRACK_USE_PALLAS=1 QRACK_BENCH=qft QRACK_BENCH_QB=20 \
+    QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
+
+  echo "=== 6) device parity test ==="
+  timeout 300 python -m pytest tests/test_tpu_device.py -q
+
+  echo "=== 7) qhybrid threshold sweep ==="
+  timeout 900 python scripts/tune_threshold.py
+
+  echo "=== 8) profiler trace (w22) ==="
+  QRACK_BENCH_PROFILE=bench_out/xplane QRACK_BENCH=qft QRACK_BENCH_QB=22 \
+    QRACK_BENCH_PLATFORM="" QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_BUDGET=420 \
+    timeout 480 python bench.py
+
+  echo "=== CAMPAIGN DONE ==="
+} > "$LOG" 2>&1
+echo "$LOG"
