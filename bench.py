@@ -156,10 +156,36 @@ def _measure(width: int, samples: int):
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
+    # On the axon-tunneled TPU, block_until_ready acks dispatch rather
+    # than completion (measured: 235 us "wall" for a w22 QFT whose real
+    # execution is far longer) — the only trustworthy sync is an actual
+    # device->host read.  So off-CPU we time K chained applications
+    # bracketed by a 1-amplitude device_get, subtract the empty-queue
+    # devget round-trip, and divide by K (validated by
+    # scripts/tpu_timing_probe.py's K=1-vs-K=8 agreement check).
+    sync_mode = os.environ.get(
+        "QRACK_BENCH_SYNC", "block" if plat == "cpu" else "devget")
+    chain = int(os.environ.get(
+        "QRACK_BENCH_CHAIN", "1" if sync_mode == "block" else "4"))
+
+    def _sync(pl):
+        if sync_mode == "devget":
+            jax.device_get(pl[:, :1])
+        else:
+            pl.block_until_ready()
+
     body, planes = _make_fn(width)
     fn = jax.jit(body, donate_argnums=(0,))
     planes = fn(planes)
-    planes.block_until_ready()
+    _sync(planes)
+    sync_s = 0.0
+    if sync_mode == "devget":
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _sync(planes)
+            reps.append(time.perf_counter() - t0)
+        sync_s = min(reps)
     prof_dir = os.environ.get("QRACK_BENCH_PROFILE")
     if prof_dir:
         # xplane dump for MFU/HBM analysis (SURVEY §5 tracing row);
@@ -168,12 +194,25 @@ def _measure(width: int, samples: int):
     times = []
     for _ in range(samples):
         t0 = time.perf_counter()
-        planes = fn(planes)
-        planes.block_until_ready()
-        times.append(time.perf_counter() - t0)
+        for _ in range(chain):
+            planes = fn(planes)
+        _sync(planes)
+        times.append(max(time.perf_counter() - t0 - sync_s, 0.0) / chain)
     if prof_dir:
         jax.profiler.stop_trace()
     st = _stats(times)
+    st["sync"] = sync_mode
+    if sync_mode == "devget":
+        st["chain"] = chain
+        st["sync_overhead_s"] = round(sync_s, 6)
+    if WORKLOAD == "qft":
+        # the sweep silently switches program forms at FAST_COMPILE_QB;
+        # record which one this width ran so scaling curves attribute
+        # any discontinuity to the form change, not the hardware
+        from qrack_tpu.models import qft as qftm
+
+        st["qft_form"] = ("fast" if width >= qftm.FAST_COMPILE_QB
+                          else "unrolled")
     if WORKLOAD == "xeb":
         st["xeb_fidelity"] = round(_xeb_from_planes(planes, width), 6)
     return st
@@ -249,6 +288,10 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     if WORKLOAD != "qft_unit":
         ghbm = _implied_hbm(width, stats["avg"])
         line["implied_hbm_gbps"] = round(ghbm, 1)
+        # dense simulation is bandwidth-bound (2-4 flops/byte), so the
+        # roofline fraction IS the MFU analogue: fraction of the v5e's
+        # ~819 GB/s HBM peak the fused program sustains
+        line["hbm_roofline_frac"] = round(ghbm / 819.0, 4)
         if ghbm > 1600.0:  # ~2x v5e peak: physically impossible
             line["suspect_timing"] = True
     print(json.dumps(line), flush=True)
@@ -264,6 +307,14 @@ def _run_child(width: int, samples: int, timeout_s: float, platform: str = ""):
                QRACK_BENCH_SAMPLES=str(samples))
     if platform:
         env["QRACK_BENCH_PLATFORM"] = platform
+        if platform == "cpu":
+            # keep the fallback line immune to a wedged TPU tunnel: the
+            # axon sitecustomize (PYTHONPATH=/root/.axon_site) registers
+            # its PJRT plugin in every interpreter, and plugin init can
+            # hang even under JAX_PLATFORMS=cpu
+            env.pop("PYTHONPATH", None)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
     else:
         env.pop("QRACK_BENCH_PLATFORM", None)
     try:

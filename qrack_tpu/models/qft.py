@@ -17,6 +17,7 @@ comparable with the gate-at-a-time path.
 from __future__ import annotations
 
 import math
+import os
 
 import numpy as np
 
@@ -77,8 +78,56 @@ def iqft_planes(planes, n: int):
     return planes
 
 
-def make_qft_fn(n: int, inverse: bool = False):
+def _carried_phase(planes, frac, h_bit: int, sign: float):
+    """One stage's controlled phases from the carried fraction:
+    theta(idx) = sign * pi * bit_h(idx) * frac(idx)."""
+    acc = frac.dtype
+    idx = gk.iota_for(planes)
+    on = ((idx >> h_bit) & 1).astype(acc)
+    theta = jnp.asarray(sign * math.pi, dtype=acc) * on * frac
+    return gk.cmul(jnp.cos(theta).astype(planes.dtype),
+                   jnp.sin(theta).astype(planes.dtype), planes)
+
+
+def qft_planes_fast(planes, n: int, inverse: bool = False):
+    """O(n)-op QFT: stage i's angle sum  sum_j bit_{h+1+j} * pi/2^(j+1)
+    obeys the exact recurrence  frac_h = (frac_{h+1} + bit_{h+1}) / 2,
+    so one carried (2^n,) fraction array replaces the per-stage O(i)
+    term sums of `_stage_phase` — the traced HLO shrinks from O(n^2) to
+    O(n) ops (an ~n-fold compile-time cut, critical over a remote-compile
+    tunnel) at the cost of one extra array's HBM traffic per stage.
+    Bit-for-bit the same gate order as qft_planes/iqft_planes
+    (reference: QInterface::QFT, src/qinterface/qinterface.cpp:114);
+    f32 carried fractions add <= 2^-24 relative angle error."""
+    hm = _h_mp(planes.dtype)
+    acc = jnp.float64 if planes.dtype == jnp.float64 else jnp.float32
+    idx = gk.iota_for(planes)
+    frac = jnp.zeros(planes.shape[-1], dtype=acc)
+    end = n - 1
+    for i in range(n):
+        h_bit = i if inverse else end - i
+        if i:
+            prev = h_bit - 1 if inverse else h_bit + 1
+            pb = ((idx >> prev) & 1).astype(acc)
+            frac = (frac + pb) * acc(0.5)
+            planes = _carried_phase(planes, frac, h_bit,
+                                    -1.0 if inverse else 1.0)
+        planes = gk.apply_2x2(planes, hm, n, h_bit)
+    return planes
+
+
+# Above this width the O(n^2)-op unrolled programs compile slowly enough
+# (especially via the axon remote-compile tunnel) that the O(n)-op
+# carried-fraction form wins overall; exact-same gate order either way.
+FAST_COMPILE_QB = int(os.environ.get("QRACK_QFT_FAST_QB", "23"))
+
+
+def make_qft_fn(n: int, inverse: bool = False, fast: bool | None = None):
     """Jittable single-chip whole-QFT program over (2, 2^n) planes."""
+    if fast is None:
+        fast = n >= FAST_COMPILE_QB
+    if fast:
+        return lambda planes: qft_planes_fast(planes, n, inverse)
     body = iqft_planes if inverse else qft_planes
 
     def fn(planes):

@@ -38,6 +38,18 @@ import jax.numpy as jnp
 # host-side Python int (arbitrary precision).
 IDX_DTYPE = jnp.int32
 
+# Gate contractions are 2-4 wide: full-precision multiplies cost nothing,
+# while TPU DEFAULT precision truncates f32 operands to bf16 and visibly
+# decays the norm over deep circuits (measured: w22 QFT x18 -> |psi|^2 =
+# 0.918).  Explicit here as defense in depth — the package also sets
+# jax_default_matmul_precision at import — but honoring the same
+# QRACK_MATMUL_PRECISION override (None defers to the global default).
+import os as _os
+
+PREC = (None if _os.environ.get("QRACK_MATMUL_PRECISION", "highest")
+        in ("default", "")
+        else jax.lax.Precision.HIGHEST)
+
 
 # ---------------------------------------------------------------------------
 # plane representation helpers
@@ -92,7 +104,7 @@ def apply_2x2(planes, mp, n: int, target: int, cmask=0, cval=0):
     high = 1 << (n - 1 - target)
     low = 1 << target
     v = planes.reshape(2, high, 2, low)
-    out = jnp.einsum("PApa,phal->PhAl", _mix(mp), v).reshape(2, -1)
+    out = jnp.einsum("PApa,phal->PhAl", _mix(mp), v, precision=PREC).reshape(2, -1)
     if isinstance(cmask, int) and cmask == 0:
         return out
     return _ctrl_select(out, planes, cmask, cval)
@@ -142,9 +154,9 @@ def apply_4x4(planes, mp4, n: int, q1: int, q2: int):
     mix = _mix(mp4)  # [2, 4, 2, 4]
     mix = mix.reshape(2, 2, 2, 2, 2, 2)  # [P, B2, B1, p, b2, b1]
     if q1 < q2:
-        out = jnp.einsum("PABpab,phambl->PhAmBl", mix, v)
+        out = jnp.einsum("PABpab,phambl->PhAmBl", mix, v, precision=PREC)
     else:
-        out = jnp.einsum("PBApba,phambl->PhAmBl", mix, v)
+        out = jnp.einsum("PBApba,phambl->PhAmBl", mix, v, precision=PREC)
     return out.reshape(2, -1)
 
 
@@ -166,8 +178,10 @@ def uc_2x2(planes, mps, n: int, target: int, controls):
     v = jnp.transpose(t, perm).reshape(2, 1 << k, 2, -1)
     re, im = mps[0], mps[1]  # [2^k, 2, 2]
     vr, vi = v[0], v[1]
-    outr = jnp.einsum("kab,kbr->kar", re, vr) - jnp.einsum("kab,kbr->kar", im, vi)
-    outi = jnp.einsum("kab,kbr->kar", re, vi) + jnp.einsum("kab,kbr->kar", im, vr)
+    outr = (jnp.einsum("kab,kbr->kar", re, vr, precision=PREC)
+            - jnp.einsum("kab,kbr->kar", im, vi, precision=PREC))
+    outi = (jnp.einsum("kab,kbr->kar", re, vi, precision=PREC)
+            + jnp.einsum("kab,kbr->kar", im, vr, precision=PREC))
     out = jnp.stack([outr, outi]).reshape((2,) + (2,) * n)
     inv = np.argsort(np.asarray(perm))
     return jnp.transpose(out, list(inv)).reshape(2, -1)

@@ -5,19 +5,36 @@
 # it passes), then sweeps, then mode A/Bs, then threshold tuning.
 set -u
 cd "$(dirname "$0")/.."
+# Resolve an interpreter that actually has jax (container images differ),
+# then shim it onto PATH so every `python` below (incl. under `timeout`)
+# resolves to it.
+PY="${PYTHON:-}"
+if [ -z "$PY" ]; then
+  for cand in python /opt/venv/bin/python python3; do
+    if "$cand" -c 'import jax' >/dev/null 2>&1; then
+      PY="$(command -v "$cand")"; break
+    fi
+  done
+fi
+[ -n "$PY" ] || { echo "no python with jax found" >&2; exit 1; }
+PY="$(command -v "$PY")"   # absolute path — a bare name would make the
+                           # shim symlink below self-referential
+SHIM="$(mktemp -d)"
+ln -s "$PY" "$SHIM/python"
+export PATH="$SHIM:$PATH"
 mkdir -p bench_out
-LOG=bench_out/campaign_$(date +%H%M).log
+LOG=bench_out/campaign_$(date +%d%H%M%S).log
 {
   echo "=== 0) health ==="
   timeout 120 python scripts/tpu_probe.py || exit 1
 
   echo "=== 1) timing honesty (w20, w22) ==="
-  timeout 560 python scripts/tpu_timing_probe.py 20
-  timeout 560 python scripts/tpu_timing_probe.py 22
+  timeout 900 python scripts/tpu_timing_probe.py 20
+  timeout 900 python scripts/tpu_timing_probe.py 22
 
   echo "=== 2) qft sweep 20:26 (stage-fused programs) ==="
   QRACK_BENCH=qft QRACK_BENCH_SWEEP=20:26 QRACK_BENCH_QB=26 \
-    QRACK_BENCH_BUDGET=1800 timeout 1860 python bench.py
+    QRACK_BENCH_BUDGET=3000 timeout 3060 python bench.py
 
   echo "=== 3) bf16 w24 ==="
   QRACK_BENCH=qft QRACK_BENCH_DTYPE=bfloat16 QRACK_BENCH_QB=24 \

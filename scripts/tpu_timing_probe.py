@@ -1,13 +1,19 @@
 """Validate that bench wall-clocks measure REAL device execution.
 
-Three checks on the live chip:
-  1. scaling: N chained applications of the fused QFT program must cost
-     ~N x one application (if not, block_until_ready is lying and the
-     timing harness must switch to a device_get sync);
-  2. sync equivalence: wall time of block_until_ready vs device_get of
-     one amplitude;
-  3. correctness: the final state's total probability ~ 1 and matches
-     the CPU-XLA run of the SAME program at a checkable width.
+Measured on the axon-tunneled v5e: `block_until_ready` returns in
+~235 us after a w22 QFT whose actual execution takes far longer — the
+relay acks dispatch, not completion.  The only trustworthy sync is an
+actual device->host read (`jax.device_get` of one amplitude), so honest
+per-application cost is measured amortized:
+
+    t_sync   = devget cost with an EMPTY queue (tunnel round-trip)
+    t_K      = K chained applications + one devget
+    per_app  = (t_K - t_sync) / K     for K in {1, 8}
+
+and the two K estimates must agree within ~3x, else timing is still
+untrustworthy.  Also checks total probability ~ 1 (norm decay exposes
+low-precision matmuls: TPU DEFAULT precision truncates f32 einsum
+operands to bf16 — the package now forces HIGHEST).
 
 Run ONLY under a hard timeout from a parent (axon tunnel can wedge).
 """
@@ -34,37 +40,63 @@ def main() -> None:
     w = int(sys.argv[1]) if len(sys.argv) > 1 else 22
     fn = jax.jit(qftm.make_qft_fn(w), donate_argnums=(0,))
     planes = qftm.basis_planes(w, 12345 & ((1 << w) - 1))
-    planes = fn(planes)
-    planes.block_until_ready()
-    print(f"warm ok w={w}", flush=True)
 
-    # 1 application, synced by block_until_ready
+    def devget(pl):
+        return np.asarray(jax.device_get(pl[:, :1]))
+
+    t0 = time.perf_counter()
+    planes = fn(planes)
+    devget(planes)
+    print(f"warm ok w={w} t={time.perf_counter() - t0:.2f}s", flush=True)
+
+    # empty-queue sync cost (tunnel round trip for an 8-byte read)
+    syncs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        devget(planes)
+        syncs.append(time.perf_counter() - t0)
+    t_sync = min(syncs)
+    print(f"devget_empty_queue s={t_sync:.6f} (3 reps: "
+          f"{[round(s, 6) for s in syncs]})", flush=True)
+
+    per_app = {}
+    for k in (1, 8):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            planes = fn(planes)
+        devget(planes)
+        tk = time.perf_counter() - t0
+        per_app[k] = max(tk - t_sync, 0.0) / k
+        print(f"chain{k}_devget total_s={tk:.6f} per_app_s={per_app[k]:.6f}",
+              flush=True)
+
+    # legacy block_until_ready number, printed for comparison only
     t0 = time.perf_counter()
     planes = fn(planes)
     planes.block_until_ready()
-    t1 = time.perf_counter() - t0
-    print(f"one_apply_block s={t1:.6f}", flush=True)
+    print(f"one_apply_block s={time.perf_counter() - t0:.6f} "
+          "(UNTRUSTED on axon)", flush=True)
 
-    # 16 chained applications, synced once
-    t0 = time.perf_counter()
-    for _ in range(16):
-        planes = fn(planes)
-    planes.block_until_ready()
-    t16 = time.perf_counter() - t0
-    print(f"sixteen_apply_block s={t16:.6f} ratio={t16 / max(t1, 1e-9):.1f}",
-          flush=True)
-
-    # 1 application synced by an actual 1-amplitude device read
-    t0 = time.perf_counter()
-    planes = fn(planes)
-    amp = np.asarray(jax.device_get(planes[:, :1]))
-    tg = time.perf_counter() - t0
-    print(f"one_apply_devget s={tg:.6f} amp0={amp.ravel()[:2]}", flush=True)
-
-    # total probability check (device-side reduce, host scalar out)
-    p = float(jax.jit(lambda s: (s[0] ** 2 + s[1] ** 2).sum())(planes))
+    # total probability check (device-side reduce, host scalar out);
+    # 11 applications so far — any precision rot shows up here
+    p = float(jax.jit(lambda s: (s[0].astype(np.float32) ** 2
+                                 + s[1].astype(np.float32) ** 2).sum())(planes))
     print(f"total_prob={p:.6f}", flush=True)
     assert abs(p - 1.0) < 1e-2, p
+
+    # agreement check only when K=1 rises above tunnel round-trip
+    # jitter — a few-ms application under tens-of-ms jitter makes the
+    # K=1 estimate meaningless (the K=8 amortized number still stands)
+    jitter = max(syncs) - min(syncs)
+    if per_app[1] > 10.0 * max(jitter, 1e-4):
+        lo, hi = sorted((per_app[1], per_app[8]))
+        agree = hi / max(lo, 1e-9)
+        print(f"k1_vs_k8_ratio={agree:.2f}", flush=True)
+        assert agree < 3.0, (per_app, t_sync)
+    else:
+        print(f"k1 jitter-dominated (jitter={jitter:.6f}) — "
+              "trusting the K=8 amortized estimate", flush=True)
+    print(f"HONEST per_app_s={per_app[8]:.6f} (w={w})", flush=True)
     print("TIMING_PROBE_OK", flush=True)
 
 

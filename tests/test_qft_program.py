@@ -28,6 +28,22 @@ def test_fused_qft_matches_oracle():
     np.testing.assert_allclose(gk.from_planes(back), psi, atol=3e-5)
 
 
+def test_fast_compile_qft_matches_unrolled():
+    """The O(n)-op carried-fraction program is bit-for-bit the same
+    circuit as the O(n^2)-op unrolled one (forward and inverse)."""
+    n = 9
+    psi = rand_state(n, 11)
+    planes = gk.to_planes(psi)
+    for inverse in (False, True):
+        ref = jax.jit(qftm.make_qft_fn(n, inverse=inverse, fast=False))(planes)
+        fast = jax.jit(qftm.make_qft_fn(n, inverse=inverse, fast=True))(planes)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(ref), atol=2e-6)
+    # fast forward then fast inverse round-trips to the input
+    out = jax.jit(qftm.make_qft_fn(n, fast=True))(planes)
+    back = jax.jit(qftm.make_qft_fn(n, inverse=True, fast=True))(out)
+    np.testing.assert_allclose(gk.from_planes(back), psi, atol=3e-5)
+
+
 def test_sharded_qft_matches_oracle():
     n = 8
     devs = jax.devices("cpu")[:8]
