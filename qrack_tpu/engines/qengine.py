@@ -394,10 +394,39 @@ class QEngine(QInterface):
                      inverse, controls=()) -> None:
         """Width-generic MUL/DIV: the pair-scatter path builds full-width
         host index arrays, so past int32 widths the same map runs as a
-        split-index gather with host-built product tables (reference
-        width-generic mul/div kernels, qheader_alu.cl:~260)."""
-        lo, hi, inv, k = alu.mul_tables(to_mul, length)
+        split-index gather — with host-built product tables below the
+        table RAM cap, else recomputing products per-lane in uint32 limb
+        arithmetic (the 2^L table RAM ceiling is gone; the MUL/DIV
+        *register* itself stays <= 31 bits, the int32 lane bound —
+        total ket width is unbounded)
+        (reference width-generic mul/div kernels, qheader_alu.cl:~260)."""
+        import os
+
         perm_all = (1 << len(controls)) - 1
+        cap = min(int(os.environ.get("QRACK_WIDE_MUL_TABLE_QB", "24")), 31)
+        table_free = (os.environ.get("QRACK_WIDE_MUL_TABLE_FREE") == "1"
+                      or length > cap)
+        if table_free:
+            k, inv_odd = alu.mul_consts(to_mul, length)
+            src_split = (alu.div_src_split_tf if inverse
+                         else alu.mul_src_split_tf)
+
+            def body(xp, pid, lidx, L):
+                sp, sl, keep = src_split(xp, pid, lidx, L, to_mul, k,
+                                         inv_odd, in_out_start, carry_start,
+                                         length)
+                if controls:
+                    ok = alu.split_ctrl_match(xp, pid, lidx, L, controls,
+                                              perm_all)
+                    sp = xp.where(ok, sp, pid)
+                    sl = xp.where(ok, sl, lidx)
+                    keep = keep | ~ok
+                return sp, sl, keep
+
+            key = ("divwtf" if inverse else "mulwtf", to_mul, k,
+                   in_out_start, carry_start, length, controls)
+            return self._k_gather(None, split=(key, body, ()))
+        lo, hi, inv, k = alu.mul_tables(to_mul, length)
         src_split = alu.div_src_split if inverse else alu.mul_src_split
 
         def body(xp, pid, lidx, L, lo_t, hi_t, inv_t):

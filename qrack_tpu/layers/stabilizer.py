@@ -864,16 +864,40 @@ class QStabilizer(QInterface):
         return out["b"]
 
     def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
-        """Drop qubits that are Z eigenstates (the common post-measurement
-        path), one tableau-native DisposeZ each — exact at any width.
-        General separable (non-Z-basis) disposal still routes through
-        measurement first."""
+        """Drop qubits that are each single-basis separable (Z, X, or Y
+        eigenstates): non-Z qubits rotate to the Z basis first, then one
+        tableau-native DisposeZ each — exact at any width.  Disposal of
+        a span entangled within itself (but separable from the rest)
+        still routes through measurement first (reference disposes via
+        its Decompose machinery, src/qstabilizer.cpp)."""
+        states = self._separable_span_states(start, length)
+        if states is None:
+            raise NotImplementedError(
+                "tableau Dispose requires per-qubit separable (Z/X/Y "
+                "eigenstate) qubits; measure first")
+        self._dispose_separable_span(start, states)
+
+    def _separable_span_states(self, start: int, length: int):
+        """Per-qubit (basis, bit) for a span of single-basis-separable
+        qubits, or None if any qubit is entangled (incl. within-span)."""
+        states = []
         for q in range(start, start + length):
-            if not self.IsSeparableZ(q):
-                raise NotImplementedError(
-                    "tableau Dispose requires Z-eigenstate qubits; measure first"
-                )
-        for q in range(start + length - 1, start - 1, -1):
+            s = self._separable_1q_state(q)
+            if s is None:
+                return None
+            states.append(s)
+        return states
+
+    def _dispose_separable_span(self, start: int, states) -> None:
+        """Rotate each span qubit to Z per its recorded basis and
+        DisposeZ it, descending so indices stay valid."""
+        for q in range(start + len(states) - 1, start - 1, -1):
+            basis, _ = states[q - start]
+            if basis == "X":
+                self.H(q)
+            elif basis == "Y":
+                self.IS(q)
+                self.H(q)
             self.DisposeZ(q)
 
     def _separable_1q_state(self, q: int):
@@ -908,20 +932,10 @@ class QStabilizer(QInterface):
         synthesize `dest` as the product tableau — O(n) row ops per
         qubit at ANY width (no 2^n ket is ever formed)."""
         length = dest.qubit_count
-        states = []
-        for q in range(start, start + length):
-            s = self._separable_1q_state(q)
-            if s is None:
-                return False
-            states.append(s)
-        for q in range(start + length - 1, start - 1, -1):
-            basis, _ = states[q - start]
-            if basis == "X":
-                self.H(q)
-            elif basis == "Y":
-                self.IS(q)
-                self.H(q)
-            self.DisposeZ(q)
+        states = self._separable_span_states(start, length)
+        if states is None:
+            return False
+        self._dispose_separable_span(start, states)
         dest.SetPermutation(0, phase=1.0)
         for j, (basis, b) in enumerate(states):
             if b:

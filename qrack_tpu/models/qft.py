@@ -175,19 +175,45 @@ def _sharded_stage_phase(local, L, pairs):
     return gk.cmul(fre, fim, local)
 
 
-def make_sharded_qft_fn(mesh: Mesh, n: int, inverse: bool = False):
+def make_sharded_qft_fn(mesh: Mesh, n: int, inverse: bool = False,
+                        fast: bool | None = None):
     """One jitted program: full QFT over a ket sharded across the 'pages'
     mesh axis — in-page math per device, ppermute over ICI for paged
-    targets. Returns (fn, sharding)."""
+    targets. Returns (fn, sharding).  `fast` selects the O(n)-op
+    carried-fraction form (see qft_planes_fast); the recurrence reads
+    each stage's previous bit from the local index or the page id, so it
+    is mesh-shape agnostic like the unrolled form."""
     npg = mesh.devices.size
     g = npg.bit_length() - 1
     L = n - g
     assert (1 << g) == npg, "page count must be a power of two"
+    if fast is None:
+        fast = n >= FAST_COMPILE_QB
     sharding = NamedSharding(mesh, P(None, "pages"))
+
+    def _gbit(local, b: int):
+        if b < L:
+            return (gk.iota_for(local) >> b) & 1
+        return (jax.lax.axis_index("pages") >> (b - L)) & 1
 
     def body(local):
         hm = _h_mp(local.dtype)
         end = n - 1
+        if fast:
+            acc = jnp.float64 if local.dtype == jnp.float64 else jnp.float32
+            frac = jnp.zeros(local.shape[-1], dtype=acc)
+            for i in range(n):
+                h_bit = i if inverse else end - i
+                if i:
+                    prev = h_bit - 1 if inverse else h_bit + 1
+                    frac = (frac + _gbit(local, prev).astype(acc)) * acc(0.5)
+                    on = _gbit(local, h_bit).astype(acc)
+                    theta = (jnp.asarray(-math.pi if inverse else math.pi,
+                                         dtype=acc) * on * frac)
+                    local = gk.cmul(jnp.cos(theta).astype(local.dtype),
+                                    jnp.sin(theta).astype(local.dtype), local)
+                local = _sharded_h(local, hm, L, npg, h_bit)
+            return local
         if not inverse:
             for i in range(n):
                 h_bit = end - i

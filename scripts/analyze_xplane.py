@@ -1,0 +1,78 @@
+"""Roofline/MFU analysis of a QRACK_BENCH_PROFILE xplane dump.
+
+bench.py (QRACK_BENCH_PROFILE=dir) wraps only the timed region in a
+jax.profiler trace; this script walks the dumped .xplane.pb with
+jax.profiler.ProfileData (no tensorboard needed) and reports, per TPU
+device plane: total traced span, busy time (union of op events), and
+the top ops by self time.  Combined with bench.py's analytic
+bytes-moved model (implied_hbm_gbps / hbm_roofline_frac on each JSON
+line) this gives the SURVEY §5 tracing row's MFU-analogue for a
+bandwidth-bound workload: busy_frac * implied HBM / peak.
+
+Usage: python scripts/analyze_xplane.py bench_out/xplane
+"""
+
+import glob
+import json
+import os
+import sys
+
+
+def analyze(path: str) -> dict:
+    from jax.profiler import ProfileData
+
+    pbs = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                           recursive=True))
+    if not pbs:
+        raise SystemExit(f"no .xplane.pb under {path}")
+    out = {"file": pbs[-1], "devices": []}
+    p = ProfileData.from_file(pbs[-1])
+    planes = list(p.planes)
+    dev = [pl for pl in planes
+           if "TPU" in pl.name or pl.name.startswith("/device:")]
+    if not dev:  # CPU-XLA runs: the op timeline lives on the host plane
+        dev = [pl for pl in planes if pl.name == "/host:CPU"]
+    for plane in dev:
+        # xplane lines nest (an "XLA Modules" span covers its "XLA Ops"
+        # children), so summing across lines double-counts parents.
+        # Use the single line with the largest busy union as the leaf op
+        # timeline — durations within one line do not overlap.
+        best = None
+        for line in plane.lines:
+            events = sorted((ev.start_ns, ev.start_ns + ev.duration_ns,
+                             ev.name) for ev in line.events)
+            if not events:
+                continue
+            busy = 0.0
+            cur_s, cur_e = events[0][0], events[0][1]
+            for s, e, _ in events:
+                if s > cur_e:
+                    busy += cur_e - cur_s
+                    cur_s, cur_e = s, e
+                else:
+                    cur_e = max(cur_e, e)
+            busy += cur_e - cur_s
+            if best is None or busy > best[1]:
+                best = (line.name, busy, events)
+        if best is None:
+            continue
+        line_name, busy, events = best
+        span = events[-1][1] - min(e[0] for e in events)
+        per_op = {}
+        for s, e, nm in events:
+            per_op[nm] = per_op.get(nm, 0.0) + (e - s)
+        top = sorted(per_op.items(), key=lambda kv: -kv[1])[:8]
+        out["devices"].append({
+            "plane": plane.name,
+            "line": line_name,
+            "span_ms": round(span / 1e6, 3),
+            "busy_ms": round(busy / 1e6, 3),
+            "busy_frac": round(busy / span, 4) if span else None,
+            "top_ops_ms": {k: round(v / 1e6, 3) for k, v in top},
+        })
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(analyze(sys.argv[1] if len(sys.argv) > 1
+                             else "bench_out/xplane"), indent=1))

@@ -63,6 +63,24 @@ def test_sharded_qft_matches_oracle():
     np.testing.assert_allclose(gk.from_planes(jax.device_get(back)), psi, atol=5e-5)
 
 
+def test_sharded_fast_qft_matches_unrolled():
+    """Carried-fraction form inside shard_map: paged and local bits both
+    feed the recurrence; must equal the unrolled sharded program."""
+    n = 8
+    devs = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devs), ("pages",))
+    psi = rand_state(n, 17)
+    for inverse in (False, True):
+        fn_u, sharding = qftm.make_sharded_qft_fn(mesh, n, inverse=inverse,
+                                                  fast=False)
+        fn_f, _ = qftm.make_sharded_qft_fn(mesh, n, inverse=inverse,
+                                           fast=True)
+        ref = fn_u(jax.device_put(gk.to_planes(psi), sharding))
+        fast = fn_f(jax.device_put(gk.to_planes(psi), sharding))
+        np.testing.assert_allclose(np.asarray(jax.device_get(fast)),
+                                   np.asarray(jax.device_get(ref)), atol=2e-6)
+
+
 def test_fused_rcs_matches_gate_path():
     import jax
 

@@ -412,6 +412,41 @@ def test_dispose_z_native_parity_and_wide():
     assert st.qubit_count == 39
 
 
+def test_dispose_xy_basis_any_width():
+    """Dispose of X/Y-eigenstate qubits rotates to Z in-tableau — no
+    measurement detour, exact amplitudes, and width-generic."""
+    # |+> and |i> qubits interleaved with an entangled pair
+    st = QStabilizer(4, rng=QrackRandom(3), rand_global_phase=False)
+    o = QEngineCPU(4, rng=QrackRandom(3), rand_global_phase=False)
+    for eng in (st, o):
+        eng.H(1)                 # |+> on q1
+        eng.H(2); eng.S(2)       # |i> on q2
+        eng.H(0); eng.CNOT(0, 3) # Bell pair on (q0, q3)
+    st.Dispose(1, 2)
+    o.Dispose(1, 2, 0)           # oracle needs the separable-perm hint
+    assert st.qubit_count == 2
+    f = abs(np.vdot(st.GetQuantumState(), o.GetQuantumState()))
+    np.testing.assert_allclose(f, 1.0, atol=1e-7)
+
+    # wide: 40 qubits, dispose an X-basis qubit inside a cluster chain
+    w = QStabilizer(40, rng=QrackRandom(2))
+    for i in range(38):
+        w.CNOT(i, i + 1)
+    w.H(39)
+    w.Dispose(39, 1)
+    assert w.qubit_count == 39
+
+    # a span entangled WITHIN itself (Bell pair fully inside the span,
+    # separable from the remainder) still refuses — the carved-out case
+    e = QStabilizer(3, rng=QrackRandom(4))
+    e.H(0); e.CNOT(0, 1)
+    with pytest.raises(NotImplementedError):
+        e.Dispose(0, 2)
+    # and a qubit entangled with the outside refuses too
+    with pytest.raises(NotImplementedError):
+        e.Dispose(0, 1)
+
+
 def test_product_span_decompose_any_width():
     """Width-generic Decompose of single-basis-separable spans: exact
     rem (x) dest == original reconstruction, X/Y bases included, and a

@@ -197,6 +197,63 @@ def test_forcemparity_wide():
     assert_match(o, p)
 
 
+def test_mul_div_table_free(monkeypatch):
+    # the table-free uint32-limb form must match the oracle exactly —
+    # it is the path with NO host-RAM ceiling past QRACK_WIDE_MUL_TABLE_QB
+    monkeypatch.setenv("QRACK_WIDE_MUL_TABLE_FREE", "1")
+    for to_mul in (3, 6, 5, 7):
+        o, p = make_pair(8, n_pages=4)
+        for eng in (o, p):
+            eng.H(0)
+            eng.H(1)
+            eng.H(2)
+            eng.H(7)
+            eng.MUL(to_mul, 0, 4, 3)
+        assert_match(o, p)
+        for eng in (o, p):
+            eng.DIV(to_mul, 0, 4, 3)
+        assert_match(o, p)
+    o, p = make_pair(8, n_pages=4)
+    for eng in (o, p):
+        eng.H(0)
+        eng.H(7)
+        eng.CMUL(3, 0, 4, 3, (7,))
+        eng.CDIV(3, 0, 4, 3, (7,))
+    assert_match(o, p)
+
+
+def test_product_split_limbs_exact():
+    # uint32 limb arithmetic vs exact Python ints at the widths the
+    # tables can no longer reach (L up to 30)
+    from qrack_tpu.ops import alu_kernels as alu
+
+    rs = np.random.RandomState(7)
+    for length in (5, 16, 24, 29, 30):
+        mask = (1 << length) - 1
+        xs = rs.randint(0, 1 << length, size=64, dtype=np.int64)
+        for to_mul in (3, (1 << (length - 1)) + 5, (3 << length) | 9):
+            lo, hi = alu._product_split(np, xs, to_mul, length)
+            exact = xs.astype(object) * to_mul
+            np.testing.assert_array_equal(
+                lo.astype(np.int64), np.asarray([p & mask for p in exact]))
+            np.testing.assert_array_equal(
+                hi.astype(np.int64),
+                np.asarray([(p >> length) & mask for p in exact]))
+
+
+def test_mul_consts_inverse():
+    from qrack_tpu.ops import alu_kernels as alu
+
+    for to_mul, length in ((3, 8), (12, 10), (5, 30), (6, 29)):
+        k, inv_odd = alu.mul_consts(to_mul, length)
+        odd = to_mul >> k
+        assert (odd * inv_odd) % (1 << length) == 1
+    with pytest.raises(ValueError):
+        alu.mul_consts(16, 3)   # v2 > length
+    with pytest.raises(ValueError):
+        alu.mul_consts(0, 4)
+
+
 def test_mul_wide_rejects_overwide_pow2_factor():
     # v2(to_mul) > length: the truncated product map is not a bijection,
     # so the wide path refuses instead of silently corrupting the ket
