@@ -407,14 +407,13 @@ class QEngine(QInterface):
         table_free = (os.environ.get("QRACK_WIDE_MUL_TABLE_FREE") == "1"
                       or length > cap)
         if table_free:
-            k, inv_odd = alu.mul_consts(to_mul, length)
+            k, consts = alu.mul_consts(to_mul, length)
             src_split = (alu.div_src_split_tf if inverse
                          else alu.mul_src_split_tf)
 
-            def body(xp, pid, lidx, L):
-                sp, sl, keep = src_split(xp, pid, lidx, L, to_mul, k,
-                                         inv_odd, in_out_start, carry_start,
-                                         length)
+            def body(xp, pid, lidx, L, consts_op):
+                sp, sl, keep = src_split(xp, pid, lidx, L, consts_op, k,
+                                         in_out_start, carry_start, length)
                 if controls:
                     ok = alu.split_ctrl_match(xp, pid, lidx, L, controls,
                                               perm_all)
@@ -423,9 +422,12 @@ class QEngine(QInterface):
                     keep = keep | ~ok
                 return sp, sl, keep
 
-            key = ("divwtf" if inverse else "mulwtf", to_mul, k,
+            # to_mul rides the operand vector, NOT the cache key: every
+            # multiplier with the same 2-adic valuation k shares one
+            # compiled ring-gather program
+            key = ("divwtf" if inverse else "mulwtf", k,
                    in_out_start, carry_start, length, controls)
-            return self._k_gather(None, split=(key, body, ()))
+            return self._k_gather(None, split=(key, body, (consts,)))
         lo, hi, inv, k = alu.mul_tables(to_mul, length)
         src_split = alu.div_src_split if inverse else alu.mul_src_split
 

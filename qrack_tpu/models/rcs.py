@@ -68,15 +68,49 @@ def _iswap_layer(planes, n: int, pairs):
     return gk.cmul(re, im, out)
 
 
-def make_rcs_fn(n: int, depth: int, seed: int):
-    """Jittable single-chip whole-RCS program over (2, 2^n) planes."""
+def _cluster_mats(roots, k: int):
+    """Kron the layer's single-qubit roots into per-cluster 2^k x 2^k
+    matrices over CONTIGUOUS qubit spans (all roots in a layer act on
+    disjoint qubits, so grouping is exact).  np.kron(next, acc) keeps
+    the earlier qubit least significant, matching the index convention."""
+    out = []
+    for c0 in range(0, len(roots), k):
+        ms = [_ROOTS[g] for g in roots[c0:c0 + k]]
+        acc = ms[0]
+        for m in ms[1:]:
+            acc = np.kron(m, acc)
+        out.append((c0, len(ms), acc))
+    return out
+
+
+def resolve_fuse_qb(n: int, fuse_qb: int | None = None) -> int:
+    """Single source of truth for the root-cluster width (also used by
+    bench.py's HBM-pass model, so the two can never drift)."""
+    import os
+
+    if fuse_qb is None:
+        fuse_qb = int(os.environ.get("QRACK_RCS_FUSE_QB", "6"))
+    return max(1, min(fuse_qb, n))
+
+
+def make_rcs_fn(n: int, depth: int, seed: int, fuse_qb: int | None = None):
+    """Jittable single-chip whole-RCS program over (2, 2^n) planes.
+
+    Root layers fuse into 2^k-wide cluster contractions (one HBM pass
+    per cluster instead of per qubit; the reference dispatches one
+    kernel per gate, test/benchmarks.cpp:4141).  k defaults to
+    QRACK_RCS_FUSE_QB (6 -> 64-wide MXU matmuls); k=1 recovers the
+    per-gate program."""
+    fuse_qb = resolve_fuse_qb(n, fuse_qb)
     plan = rcs_layers(n, depth, seed)
+    baked = [(_cluster_mats(roots, fuse_qb), pairs)
+             for (roots, pairs) in plan]
 
     def fn(planes):
-        for (roots, pairs) in plan:
-            for q, g in enumerate(roots):
-                mp = gk.mtrx_planes(_ROOTS[g], planes.dtype)
-                planes = gk.apply_2x2(planes, mp, n, q)
+        for (clusters, pairs) in baked:
+            for (c0, w, m) in clusters:
+                mp = gk.mtrx_planes(m, planes.dtype)
+                planes = gk.apply_kxk(planes, mp, n, c0, w)
             if pairs:
                 planes = _iswap_layer(planes, n, pairs)
         return planes

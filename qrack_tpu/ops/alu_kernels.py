@@ -413,10 +413,15 @@ def mul_tables(to_mul: int, length: int):
 
 def mul_consts(to_mul: int, length: int):
     """Host constants for the table-free wide MUL/DIV: the 2-adic
-    valuation k and the modular inverse of the odd part mod 2^length
-    (exists because odd numbers are units mod a power of two).  Replaces
-    the three 2^L product tables of `mul_tables` with O(1) host state —
-    the per-lane products are recomputed in-kernel by `_product_split`."""
+    valuation k (static — it shapes the bit recovery) and a 3-vector of
+    RUNTIME uint32 operands [t_lo, t_hi, inv_odd] (low/high multiplier
+    halves mod 2^length and the odd part's modular inverse, a unit mod a
+    power of two).  Replaces the three 2^L product tables of
+    `mul_tables` with O(1) state; passing the operands at runtime keeps
+    the jit cache keyed only on (k, geometry) so different multipliers
+    share one compiled program."""
+    import numpy as np
+
     if to_mul <= 0:
         raise ValueError("MUL/DIV multiplier must be positive")
     k = (to_mul & -to_mul).bit_length() - 1
@@ -424,22 +429,27 @@ def mul_consts(to_mul: int, length: int):
         raise ValueError(
             "v2(to_mul) exceeds the register length: the carry-truncated "
             "product map is not a bijection")
-    inv_odd = pow((to_mul >> k) & ((1 << length) - 1), -1, 1 << length)
-    return k, inv_odd
+    mask = (1 << length) - 1
+    inv_odd = pow((to_mul >> k) & mask, -1, 1 << length)
+    consts = np.asarray([to_mul & mask, (to_mul >> length) & mask, inv_odd],
+                        dtype=np.uint32)
+    return k, consts
 
 
-def _mul64_limbs(xp, x, t: int):
-    """Exact 16-bit limbs of x * t for lanes x < 2^31 and host-int
-    t < 2^31: every partial product and carry fits uint32, so the same
-    code is exact under numpy and jnp (TPU has no int64 lanes)."""
+def _mul64_limbs(xp, x, t):
+    """Exact 16-bit limbs of x * t for lanes x < 2^31 and a uint32
+    scalar t < 2^31 (host int or traced operand): every partial product
+    and carry fits uint32, so the same code is exact under numpy and
+    jnp (TPU has no int64 lanes)."""
     xu = x.astype(xp.uint32)
     m16 = xp.uint32(0xFFFF)
+    tu = xp.uint32(t)
     x0, x1 = xu & m16, xu >> 16
-    t0, t1 = t & 0xFFFF, (t >> 16) & 0xFFFF
-    m0 = x0 * xp.uint32(t0)
-    m1a = x0 * xp.uint32(t1)
-    m1b = x1 * xp.uint32(t0)
-    m2 = x1 * xp.uint32(t1)
+    t0, t1 = tu & m16, tu >> 16
+    m0 = x0 * t0
+    m1a = x0 * t1
+    m1b = x1 * t0
+    m2 = x1 * t1
     l0 = m0 & m16
     s1 = (m0 >> 16) + (m1a & m16) + (m1b & m16)
     l1 = s1 & m16
@@ -449,33 +459,35 @@ def _mul64_limbs(xp, x, t: int):
     return l0, l1, l2, l3
 
 
-def _product_split(xp, x, to_mul: int, length: int):
-    """(lo, hi) = ((x*to_mul) & mask, ((x*to_mul) >> length) & mask) as
-    uint32 lanes, computed in-kernel — the table-free equivalent of the
-    `mul_tables` lo/hi lookups (reference width-generic mul/div,
-    qheader_alu.cl:~260)."""
+def _product_split(xp, x, t_lo, t_hi, length: int):
+    """(lo, hi) = ((x*t) & mask, ((x*t) >> length) & mask) for the
+    multiplier t = t_lo + t_hi*2^length, as uint32 lanes computed
+    in-kernel — the table-free equivalent of the `mul_tables` lo/hi
+    lookups (reference width-generic mul/div, qheader_alu.cl:~260).
+    t_lo/t_hi may be host ints or traced uint32 scalars."""
     mask = xp.uint32((1 << length) - 1)
-    l0, l1, l2, l3 = _mul64_limbs(xp, x, to_mul & ((1 << length) - 1))
+    l0, l1, l2, l3 = _mul64_limbs(xp, x, t_lo)
     w0 = l0 | (l1 << 16)            # product bits 0..31 (uint32 wrap)
     w1 = l2 | (l3 << 16)            # product bits 32..63
     lo = w0 & mask
-    # bits [length, length+31] of the product; masked to `length` bits
+    # bits [length, length+31] of the product; masked to `length` bits,
+    # plus the t_hi contribution to the carry half (mod 2^L; t_hi is
+    # often 0 — one fused multiply-add either way)
     hi = ((w0 >> length) | (w1 << (32 - length))) & mask
-    t_h = (to_mul >> length) & ((1 << length) - 1)
-    if t_h:
-        # to_mul >= 2^length contributes x*t_h to the carry half (mod 2^L)
-        hi = (hi + x.astype(xp.uint32) * xp.uint32(t_h)) & mask
+    hi = (hi + x.astype(xp.uint32) * xp.uint32(t_hi)) & mask
     return lo, hi
 
 
-def mul_src_split_tf(xp, pid, lidx, L, to_mul, k, inv_odd,
+def mul_src_split_tf(xp, pid, lidx, L, consts, k,
                      in_out_start, carry_start, length):
     """Table-free gather form of wide MUL: same map as `mul_src_split`
     but the candidate source x = u * odd^-1 mod 2^L and its product
     halves are computed per-lane instead of looked up, removing the
     2^L host-table RAM ceiling (QRACK_WIDE_MUL_TABLE_QB) entirely.
     The register length itself stays <= 31 bits (int32 lanes, enforced
-    by split_reg_get); the surrounding ket width is unbounded."""
+    by split_reg_get); the surrounding ket width is unbounded.
+    `consts` is the [t_lo, t_hi, inv_odd] operand vector."""
+    t_lo, t_hi, inv_odd = consts[0], consts[1], consts[2]
     o = split_reg_get(xp, pid, lidx, L, in_out_start, length)
     c = split_reg_get(xp, pid, lidx, L, carry_start, length)
     if k:
@@ -484,7 +496,7 @@ def mul_src_split_tf(xp, pid, lidx, L, to_mul, k, inv_odd,
         u = o
     mask = xp.uint32((1 << length) - 1)
     x = (u.astype(xp.uint32) * xp.uint32(inv_odd)) & mask
-    lo, hi = _product_split(xp, x, to_mul, length)
+    lo, hi = _product_split(xp, x, t_lo, t_hi, length)
     keep = (lo == o.astype(xp.uint32)) & (hi == c.astype(xp.uint32))
     xi = x.astype(o.dtype)
     sp, sl = split_reg_set(xp, pid, lidx, L, in_out_start, length, xi)
@@ -493,14 +505,15 @@ def mul_src_split_tf(xp, pid, lidx, L, to_mul, k, inv_odd,
     return sp, sl, keep
 
 
-def div_src_split_tf(xp, pid, lidx, L, to_mul, k, inv_odd,
+def div_src_split_tf(xp, pid, lidx, L, consts, k,
                      in_out_start, carry_start, length):
     """Table-free gather form of wide DIV (exact inverse of MUL);
-    `k`/`inv_odd` unused but keep one signature for both directions."""
+    `k` is unused but keeps one signature for both directions."""
+    t_lo, t_hi = consts[0], consts[1]
     x = split_reg_get(xp, pid, lidx, L, in_out_start, length)
     c = split_reg_get(xp, pid, lidx, L, carry_start, length)
     keep = c == 0
-    lo, hi = _product_split(xp, x, to_mul, length)
+    lo, hi = _product_split(xp, x, t_lo, t_hi, length)
     sp, sl = split_reg_set(xp, pid, lidx, L, in_out_start, length,
                            lo.astype(x.dtype))
     sp, sl = split_reg_set(xp, sp, sl, L, carry_start, length,

@@ -57,7 +57,7 @@ def analyze(path: str) -> dict:
         if best is None:
             continue
         line_name, busy, events = best
-        span = events[-1][1] - min(e[0] for e in events)
+        span = max(e[1] for e in events) - events[0][0]
         per_op = {}
         for s, e, nm in events:
             per_op[nm] = per_op.get(nm, 0.0) + (e - s)

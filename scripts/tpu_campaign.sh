@@ -46,6 +46,11 @@ LOG=bench_out/campaign_$(date +%d%H%M%S).log
   QRACK_BENCH=xeb QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
     QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
 
+  echo "=== 4b) rcs cluster-fusion A/B (w20, k=1 vs default k=6) ==="
+  QRACK_RCS_FUSE_QB=1 QRACK_BENCH_SUFFIX=_fuse1 QRACK_BENCH=rcs \
+    QRACK_BENCH_QB=20 QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 \
+    timeout 480 python bench.py
+
   echo "=== 5) pallas native A/B (w20) ==="
   QRACK_USE_PALLAS=0 QRACK_BENCH=qft QRACK_BENCH_QB=20 \
     QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py

@@ -101,6 +101,23 @@ def test_max_alloc_mb_enforced(monkeypatch):
         q2.GetQuantumState()      # flush forces the over-budget merges
 
 
+def test_quantum_volume_32q_through_ace_stack():
+    """BASELINE target 5: a 32-qubit quantum-volume circuit completes
+    through the QUnit + ACE stack (reference runs QV at 32-40q via its
+    approximate 4-subsystem mode, README.md:64) — bounded shard sizes,
+    sane fidelity accounting, and a measurable register at a width no
+    dense single ket in this container could represent."""
+    from qrack_tpu.models import algorithms as algo
+
+    n = 32
+    q = make(n, seed=21, ace=True, cap=8)
+    r = algo.quantum_volume(q, depth=4, rng=QrackRandom(22))
+    assert 0 <= r < (1 << n)
+    sizes = [s.unit.qubit_count for s in q.shards if s.unit is not None]
+    assert not sizes or max(sizes) <= 8
+    assert 0.0 < q.GetUnitaryFidelity() <= 1.0
+
+
 def test_ace_full_circuit_stays_bounded():
     # a deep circuit over 12 qubits with a 4-qubit cap never exceeds the
     # cap and keeps a sane normalized state

@@ -92,6 +92,12 @@ def test_fused_rcs_matches_gate_path():
     fn = jax.jit(rcsm.make_rcs_fn(n, depth, seed=7))
     planes = fn(gk.to_planes(np.eye(1, 1 << n, 0).ravel()))
     np.testing.assert_allclose(gk.from_planes(planes), expect, atol=3e-6)
+    # cluster-fused root layers (2^k-wide contractions) are the same
+    # circuit: k=1 per-gate, k=3 partial clusters, k=6 whole-register
+    for k in (1, 3, 6):
+        fk = jax.jit(rcsm.make_rcs_fn(n, depth, seed=7, fuse_qb=k))
+        pk = fk(gk.to_planes(np.eye(1, 1 << n, 0).ravel()))
+        np.testing.assert_allclose(gk.from_planes(pk), expect, atol=3e-6)
 
 
 def test_compiled_sharded_circuit_matches_oracle():

@@ -232,7 +232,8 @@ def test_product_split_limbs_exact():
         mask = (1 << length) - 1
         xs = rs.randint(0, 1 << length, size=64, dtype=np.int64)
         for to_mul in (3, (1 << (length - 1)) + 5, (3 << length) | 9):
-            lo, hi = alu._product_split(np, xs, to_mul, length)
+            lo, hi = alu._product_split(np, xs, to_mul & mask,
+                                        (to_mul >> length) & mask, length)
             exact = xs.astype(object) * to_mul
             np.testing.assert_array_equal(
                 lo.astype(np.int64), np.asarray([p & mask for p in exact]))
@@ -245,9 +246,10 @@ def test_mul_consts_inverse():
     from qrack_tpu.ops import alu_kernels as alu
 
     for to_mul, length in ((3, 8), (12, 10), (5, 30), (6, 29)):
-        k, inv_odd = alu.mul_consts(to_mul, length)
+        k, consts = alu.mul_consts(to_mul, length)
         odd = to_mul >> k
-        assert (odd * inv_odd) % (1 << length) == 1
+        assert (odd * int(consts[2])) % (1 << length) == 1
+        assert int(consts[0]) == to_mul & ((1 << length) - 1)
     with pytest.raises(ValueError):
         alu.mul_consts(16, 3)   # v2 > length
     with pytest.raises(ValueError):
