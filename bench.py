@@ -51,9 +51,9 @@ def _remaining() -> float:
 
 
 def _workload_key() -> str:
-    if WORKLOAD in ("qft", "qft_unit"):
-        return WORKLOAD
-    return f"{WORKLOAD}_d{DEPTH}"
+    if WORKLOAD in ("rcs", "xeb"):
+        return f"{WORKLOAD}_d{DEPTH}"   # depth only matters for these
+    return WORKLOAD
 
 
 def _baseline_key() -> str:
@@ -74,7 +74,7 @@ def _bench_dtype():
 def _make_fn(width: int):
     from qrack_tpu.models import qft as qftm
 
-    if WORKLOAD not in ("qft", "rcs", "xeb", "qft_unit"):
+    if WORKLOAD not in ("qft", "rcs", "xeb", "qft_unit", "grover"):
         raise ValueError(f"unknown QRACK_BENCH workload {WORKLOAD!r}")
     dt = _bench_dtype()
     if WORKLOAD in ("rcs", "xeb"):
@@ -82,6 +82,14 @@ def _make_fn(width: int):
 
         return (rcsm.make_rcs_fn(width, DEPTH, seed=7),
                 qftm.basis_planes(width, 0, dtype=dt))
+    if WORKLOAD == "grover":
+        from qrack_tpu.models import grover as grm
+
+        # target 3 mirrors the reference's test_grover oracle (which
+        # marks |3> via DEC/ZeroPhaseFlip/INC — same function, ALU-built;
+        # test/benchmarks.cpp:542-568)
+        fn, _ = grm.make_grover_fn(width, 3)
+        return fn, qftm.basis_planes(width, 0, dtype=dt)
     return (qftm.make_qft_fn(width),
             qftm.basis_planes(width, 12345 & ((1 << width) - 1), dtype=dt))
 
@@ -253,6 +261,12 @@ def _passes(width: int) -> int:
 
         k = resolve_fuse_qb(width)
         return DEPTH * (-(-width // k) + 2)
+    if WORKLOAD == "grover":
+        from qrack_tpu.models.grover import FUSE_QB, grover_iterations
+
+        # 2 H-ladders of ceil(n/FUSE_QB) cluster passes per iteration
+        # (the phase flips fuse into the neighbouring contractions)
+        return grover_iterations(width) * 2 * (-(-width // FUSE_QB))
     return 2 * width
 
 

@@ -118,6 +118,90 @@ def make_rcs_fn(n: int, depth: int, seed: int, fuse_qb: int | None = None):
     return fn
 
 
+def make_sharded_rcs_fn(mesh, n: int, depth: int, seed: int,
+                        fuse_qb: int | None = None):
+    """Whole-RCS program over a ket sharded across the 'pages' mesh axis
+    (BASELINE target 4's RCS counterpart to make_sharded_qft_fn).
+
+    Per brick-wall layer, the coupler set splits by geometry:
+      * pairs fully below the page boundary: in-page transpose + phase
+        (no communication, same as single-chip);
+      * the one pair straddling bit L-1/L: one `lax.ppermute` partner
+        exchange + an axis flip + select (the SWAP part) with the ISwap
+        i-phase on the moved half;
+      * pairs fully in page bits: a pure page permutation (ppermute)
+        plus a per-page scalar phase.
+    Root clusters apply per page on local axes; clusters are capped at
+    the local width so they never straddle the boundary."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    npg = mesh.devices.size
+    g = npg.bit_length() - 1
+    L = n - g
+    assert (1 << g) == npg, "page count must be a power of two"
+    assert L >= 1, "at least one local qubit per page"
+    k = min(resolve_fuse_qb(n, fuse_qb), L)
+    plan = rcs_layers(n, depth, seed)
+    sharding = NamedSharding(mesh, P(None, "pages"))
+
+    def body(local):
+        from ..ops import sharded as shb
+
+        pid = jax.lax.axis_index("pages")
+        dt = local.dtype
+        for (roots, pairs) in plan:
+            # roots: local spans cluster per page; a paged qubit's root
+            # rides the existing half-buffer pair exchange
+            for (c0, w, m) in _cluster_mats(roots[:L], k):
+                local = gk.apply_kxk(local, gk.mtrx_planes(m, dt), L, c0, w)
+            for q in range(L, n):
+                mp = gk.mtrx_planes(_ROOTS[roots[q]], dt)
+                local = shb.apply_global_2x2(local, mp, npg, q - L,
+                                             0, 0, 0, 0)
+            if not pairs:
+                continue
+            idx = gk.iota_for(local)
+            loc_pairs = [(a, b) for (a, b) in pairs if b < L]
+            straddle = [(a, b) for (a, b) in pairs if a < L <= b]
+            page_pairs = [(a, b) for (a, b) in pairs if a >= L]
+            if loc_pairs:
+                local = _iswap_layer(local, L, loc_pairs)
+            for (a, b) in straddle:   # a == L-1, b == L by construction
+                gpos = b - L
+                perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
+                partner = jax.lax.ppermute(local, "pages", perm)
+                pb = (pid >> gpos) & 1
+                bl = (idx >> a) & 1
+                flipped = jnp.flip(
+                    partner.reshape(2, 1 << (L - 1 - a), 2, 1 << a),
+                    axis=2).reshape(2, -1)
+                moved = gk.cmul(jnp.zeros((), dt), jnp.ones((), dt), flipped)
+                local = jnp.where(bl == pb, local, moved)
+            for (a, b) in page_pairs:
+                ga, gb = a - L, b - L
+                swap_map = []
+                for j in range(npg):
+                    ba, bb = (j >> ga) & 1, (j >> gb) & 1
+                    t = j & ~((1 << ga) | (1 << gb))
+                    swap_map.append((j, t | (bb << ga) | (ba << gb)))
+                local = jax.lax.ppermute(local, "pages", swap_map)
+                diff = ((pid >> ga) ^ (pid >> gb)) & 1
+                local = jnp.where(diff == 1,
+                                  gk.cmul(jnp.zeros((), dt), jnp.ones((), dt),
+                                          local),
+                                  local)
+        return local
+
+    fn = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P(None, "pages"),
+                      out_specs=P(None, "pages")),
+        donate_argnums=(0,),
+    )
+    return fn, sharding
+
+
 def reference_rcs_state(n: int, depth: int, seed: int, engine) -> np.ndarray:
     """Same plan through a gate-at-a-time engine (parity checking)."""
     plan = rcs_layers(n, depth, seed)

@@ -34,6 +34,10 @@ BASELINE_FILE = os.path.join(HERE, "bench_baseline.json")
 CASES = {
     "qft": ("test_qft_permutation_init", []),
     "rcs_d8": ("test_random_circuit_sampling_nn", ["--benchmark-depth", "8"]),
+    # whole-search wall-clock; the reference oracle marks |3> via
+    # DEC/ZeroPhaseFlip/INC (test/benchmarks.cpp:542-568) — functionally
+    # the phase oracle models/grover.py applies directly
+    "grover": ("test_grover", []),
 }
 
 SECTION_RE = re.compile(r"^#+ (.+?) #+$")
@@ -61,6 +65,7 @@ def main():
     ap.add_argument("--samples", type=int, default=3)
     ap.add_argument("--timeout", type=int, default=3600)
     ap.add_argument("--skip-rcs", action="store_true")
+    ap.add_argument("--only", help="run a single workload key from CASES")
     ap.add_argument("--single", action="store_true",
                     help="only the max width, not the full sweep")
     args = ap.parse_args()
@@ -76,6 +81,8 @@ def main():
             data = {}
 
     for wl, (case, extra) in CASES.items():
+        if args.only and wl != args.only:
+            continue
         if args.skip_rcs and wl.startswith("rcs"):
             continue
         cmd = [args.binary, case, "--proc-cpu", "-m", str(args.max_qubits),

@@ -31,6 +31,9 @@ for i in $(seq 1 200); do
       exit 0
     fi
   fi
-  sleep 330
+  # ~9.5 min between probes: a killed client can leave a half-claim on
+  # the server; probing too often may keep refreshing the wedge instead
+  # of letting the stale claim expire
+  sleep 570
 done
 exit 1

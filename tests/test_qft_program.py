@@ -100,6 +100,50 @@ def test_fused_rcs_matches_gate_path():
         np.testing.assert_allclose(gk.from_planes(pk), expect, atol=3e-6)
 
 
+def test_sharded_rcs_matches_single_chip():
+    """Sharded brick-wall RCS: local-pair transposes, the straddling
+    ppermute coupler, page-pair permutations, and paged single-qubit
+    roots must reproduce the single-chip fused program exactly."""
+    from qrack_tpu.models import rcs as rcsm
+
+    n, depth = 8, 5   # 5 local + 3 page bits; both brick offsets hit
+    devs = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devs), ("pages",))
+    ref = jax.jit(rcsm.make_rcs_fn(n, depth, seed=13))(
+        qftm.basis_planes(n, 0))
+    fn, sharding = rcsm.make_sharded_rcs_fn(mesh, n, depth, seed=13)
+    out = fn(qftm.basis_planes(n, 0, sharding=sharding))
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                               np.asarray(ref), atol=3e-6)
+
+
+def test_fused_grover_finds_target():
+    """lax.fori_loop Grover program: success probability matches the
+    analytic sin^2((2m+1) asin(1/sqrt(N))) and the engine-driven
+    algorithms.grover_search agrees on the winner."""
+    import math
+
+    from qrack_tpu.models import grover as grm
+    from qrack_tpu.models import algorithms as algo
+    from qrack_tpu import create_quantum_interface
+
+    n, target = 9, 137
+    fn, iters = grm.make_grover_fn(n, target)
+    out = jax.jit(fn)(qftm.basis_planes(n, 0))
+    p = grm.success_probability(np.asarray(out), target)
+    th = math.asin(1.0 / math.sqrt(1 << n))
+    expect = math.sin((2 * iters + 1) * th) ** 2
+    np.testing.assert_allclose(p, expect, atol=1e-4)
+    assert p > 0.99
+    # engine path agrees end-to-end
+    q = create_quantum_interface("optimal", n, rng=QrackRandom(6))
+    assert algo.grover_search(q, target) == target
+    # k=1 (no cluster fusion) is the same program
+    fn1, _ = grm.make_grover_fn(n, target, fuse_qb=1)
+    out1 = jax.jit(fn1)(qftm.basis_planes(n, 0))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out), atol=2e-5)
+
+
 def test_compiled_sharded_circuit_matches_oracle():
     from jax.sharding import Mesh
 
