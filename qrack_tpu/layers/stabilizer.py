@@ -157,14 +157,24 @@ class QStabilizer(QInterface):
         finally:
             self._phase_paused -= 1
 
-    def _amp_closure(self):
+    @classmethod
+    def _row_mul_into(cls, x, z, r, h, i) -> None:
+        """Phase-tracked CHP row multiply: row h *= row i (the single
+        source of the rowsum sign algebra for every elimination here)."""
+        phase = 2 * int(r[h]) + 2 * int(r[i]) + int(
+            cls._g_vec(x[i], z[i], x[h], z[h]).sum())
+        r[h] = 1 if (phase % 4) == 2 else 0
+        x[h] ^= x[i]
+        z[h] ^= z[i]
+
+    def _amp_closure(self, canon=None):
         """Single-amplitude oracle over the CURRENT state: perm -> the
         complex amplitude up to the (positive) norm factor, 0 outside
         the support. O(k*n) per query via the canonical form; the
         stabilizer group is abelian, so generator product order is
-        immaterial."""
+        immaterial.  `canon` reuses a precomputed _canonical_stab()."""
         n = self.qubit_count
-        x, z, r, k = self._canonical_stab()
+        x, z, r, k = self._canonical_stab() if canon is None else canon
         v0 = self._seed_state(x, z, r, k)
         pivots = [int(np.nonzero(x[j])[0][0]) for j in range(k)]
         po = self.phase_offset
@@ -591,11 +601,7 @@ class QStabilizer(QInterface):
             return x, z, r, x_rank
 
         def mul_into(h, i):
-            phase = 2 * int(r[h]) + 2 * int(r[i]) + int(
-                self._g_vec(x[i], z[i], x[h], z[h]).sum())
-            r[h] = 1 if (phase % 4) == 2 else 0
-            x[h] ^= x[i]
-            z[h] ^= z[i]
+            self._row_mul_into(x, z, r, h, i)
 
         row = 0
         for col in range(n):  # X part first
@@ -697,9 +703,13 @@ class QStabilizer(QInterface):
         return state
 
     def GetAmplitude(self, perm: int) -> complex:
-        # small tableaus: go through the ket (cached extraction is a
-        # round-2 optimization; reference caches gaussian elimination)
-        return complex(self.GetQuantumState()[perm])
+        """Width-generic single-amplitude query: the canonical-form
+        oracle (O(n^2) bit ops) times the 1/sqrt(2^k) support norm —
+        never materializes the 2^n ket (reference: GetAmplitude walks
+        its cached gaussian elimination, src/qstabilizer.cpp)."""
+        canon = self._canonical_stab()
+        return (complex(self._amp_closure(canon)(perm))
+                / math.sqrt(1 << canon[3]))
 
     def GetProbs(self) -> np.ndarray:
         s = self.GetQuantumState()
@@ -947,15 +957,147 @@ class QStabilizer(QInterface):
                 dest.S(j)
         return True
 
+    @staticmethod
+    def _symp(x1, z1, x2, z2) -> int:
+        """Symplectic product mod 2 (1 = the two Paulis anticommute)."""
+        return (int((x1 & z2).sum()) + int((z1 & x2).sum())) & 1
+
+    @classmethod
+    def _from_generators(cls, xs, zs, rs, rng=None):
+        """Tableau for the state stabilized by m independent commuting
+        generators on m qubits, built purely symplectically (no 2^m
+        object): destabilizers come from symplectic Gram-Schmidt over
+        the standard basis — pick D_i anticommuting with S_i, then fold
+        (S_i, D_i) out of every remaining candidate, multiplying later
+        generators by S_i (phase-tracked rowsum) when they anticommute
+        with D_i.  Destabilizer phase bits are bookkeeping and start 0."""
+        m = int(xs.shape[0])
+        sx, sz = xs.astype(np.uint8).copy(), zs.astype(np.uint8).copy()
+        sr = rs.astype(np.uint8).copy()
+        cand = []
+        for j in range(m):
+            ex = np.zeros(m, dtype=np.uint8)
+            ez = np.zeros(m, dtype=np.uint8)
+            ex[j] = 1
+            cand.append((ex, ez.copy()))
+            cand.append((ez.copy(), ex.copy()))  # (x=0,z=e_j)
+        dx = np.zeros((m, m), dtype=np.uint8)
+        dz = np.zeros((m, m), dtype=np.uint8)
+        for i in range(m):
+            pick = None
+            for ci, (cx, cz) in enumerate(cand):
+                if cls._symp(sx[i], sz[i], cx, cz):
+                    pick = ci
+                    break
+            if pick is None:
+                raise ValueError("generators are not independent")
+            dx[i], dz[i] = cand.pop(pick)
+            kept = []
+            for (cx, cz) in cand:
+                if cls._symp(cx, cz, dx[i], dz[i]):
+                    cx, cz = cx ^ sx[i], cz ^ sz[i]
+                if cls._symp(cx, cz, sx[i], sz[i]):
+                    cx, cz = cx ^ dx[i], cz ^ dz[i]
+                if cx.any() or cz.any():
+                    kept.append((cx, cz))
+            cand = kept
+            for j in range(i + 1, m):
+                if cls._symp(sx[j], sz[j], dx[i], dz[i]):
+                    cls._row_mul_into(sx, sz, sr, j, i)
+        out = cls(m, rng=rng)
+        out.x[:m], out.z[:m] = dx, dz
+        out.x[m:2 * m], out.z[m:2 * m] = sx, sz
+        out.r[:] = 0
+        out.r[m:2 * m] = sr
+        return out
+
+    def _extract_product_generators(self, start: int, length: int):
+        """Split the stabilizer group into span-only and rest-only
+        generator sets via phase-tracked Gaussian elimination over the
+        outside coordinates; None if the span is entangled with the
+        rest.  O(n^3) bit ops, no 2^n object — width-generic."""
+        n = self.qubit_count
+        x = self.x[n:2 * n].copy()
+        z = self.z[n:2 * n].copy()
+        r = self.r[n:2 * n].copy()
+
+        def mul_into(h, i):
+            self._row_mul_into(x, z, r, h, i)
+
+        def eliminate(rows_lo, coords):
+            """Row-reduce over (array, col) coords; returns next free row."""
+            row = rows_lo
+            for (arr, c) in coords:
+                piv = None
+                for i in range(row, n):
+                    if arr[i, c]:
+                        piv = i
+                        break
+                if piv is None:
+                    continue
+                if piv != row:
+                    for a in (x, z):
+                        a[[row, piv]] = a[[piv, row]]
+                    r[[row, piv]] = r[[piv, row]]
+                for i in range(n):
+                    if i != row and arr[i, c]:
+                        mul_into(i, row)
+                row += 1
+            return row
+
+        outside = [c for c in range(n)
+                   if not (start <= c < start + length)]
+        cut = eliminate(0, [(x, c) for c in outside]
+                        + [(z, c) for c in outside])
+        if n - cut != length:
+            return None
+        # rows [cut, n): no outside support -> span-only generators.
+        # Clean residual span support out of the outside rows using them.
+        span = [c for c in range(start, start + length)]
+        eliminate(cut, [(x, c) for c in span] + [(z, c) for c in span])
+        for i in range(cut):
+            if any(x[i, c] or z[i, c] for c in span):
+                return None  # genuinely entangled across the cut
+        rest_idx = np.asarray(outside)
+        span_idx = np.asarray(span)
+        return ((x[cut:, span_idx], z[cut:, span_idx], r[cut:]),
+                (x[:cut, rest_idx], z[:cut, rest_idx], r[:cut]))
+
     def Decompose(self, start: int, dest: "QStabilizer") -> None:
         length = dest.qubit_count
         n = self.qubit_count
         if self._decompose_product_span(start, dest):
             return
+        split = self._extract_product_generators(start, length)
+        if split is not None:
+            (gsx, gsz, gsr), (grx, grz, grr) = split
+            # exact global phase: one amplitude of the ORIGINAL state at
+            # a product support point, vs the factor tableaus' product
+            d_new = self._from_generators(gsx, gsz, gsr,
+                                          rng=self.rng.spawn())
+            rem = self._from_generators(grx, grz, grr,
+                                        rng=self.rng.spawn())
+            lo_mask = (1 << start) - 1
+            vd = d_new._seed_state(*d_new._canonical_stab())
+            vr = rem._seed_state(*rem._canonical_stab())
+            combined = ((vr & lo_mask) | (vd << start)
+                        | ((vr >> start) << (start + length)))
+            t = self.GetAmplitude(combined)
+            pn = (d_new.GetAmplitude(vd) * rem.GetAmplitude(vr))
+            if abs(t) > 1e-12 and abs(pn) > 1e-12:
+                rem.phase_offset *= (t / abs(t)) / (pn / abs(pn))
+            dest.x, dest.z, dest.r = d_new.x, d_new.z, d_new.r
+            dest.phase_offset = d_new.phase_offset
+            dest.qubit_count = length
+            self.x, self.z, self.r = rem.x, rem.z, rem.r
+            self.phase_offset = rem.phase_offset
+            self.qubit_count = n - length
+            return
         if n > 20:
             raise NotImplementedError(
-                "wide tableau decompose of an internally-entangled span "
-                "pending (product spans decompose at any width)")
+                "tableau Decompose of a span entangled ACROSS the cut is "
+                "undefined (reference raises too); spans separable from "
+                "the remainder decompose at any width")
         st = self.GetQuantumState()
         from ..engines.cpu import QEngineCPU
 
