@@ -311,12 +311,7 @@ class QStabilizer(QInterface):
 
     def _rowsum(self, h: int, i: int) -> None:
         """Row h *= row i (Pauli product with sign bookkeeping)."""
-        phase = 2 * int(self.r[h]) + 2 * int(self.r[i]) + int(
-            self._g_vec(self.x[i], self.z[i], self.x[h], self.z[h]).sum()
-        )
-        self.r[h] = 1 if (phase % 4) == 2 else 0
-        self.x[h] ^= self.x[i]
-        self.z[h] ^= self.z[i]
+        self._row_mul_into(self.x, self.z, self.r, h, i)
 
     # ------------------------------------------------------------------
     # QInterface primitive contract
@@ -1082,10 +1077,12 @@ class QStabilizer(QInterface):
             vr = rem._seed_state(*rem._canonical_stab())
             combined = ((vr & lo_mask) | (vd << start)
                         | ((vr >> start) << (start + length)))
+            # the factors' own amplitudes at their canonical seeds are
+            # +norm by construction (phase_offset == 1, see _amp_closure
+            # docstring), so the original's phase there IS the correction
             t = self.GetAmplitude(combined)
-            pn = (d_new.GetAmplitude(vd) * rem.GetAmplitude(vr))
-            if abs(t) > 1e-12 and abs(pn) > 1e-12:
-                rem.phase_offset *= (t / abs(t)) / (pn / abs(pn))
+            if abs(t) > 1e-12:
+                rem.phase_offset *= t / abs(t)
             dest.x, dest.z, dest.r = d_new.x, d_new.z, d_new.r
             dest.phase_offset = d_new.phase_offset
             dest.qubit_count = length

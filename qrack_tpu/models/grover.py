@@ -87,3 +87,60 @@ def make_grover_fn(n: int, target: int, iters: int | None = None,
 def success_probability(planes, target: int) -> float:
     p = planes[0] ** 2 + planes[1] ** 2
     return float(p[target] / p.sum())
+
+
+def make_sharded_grover_fn(mesh, n: int, target: int,
+                           iters: int | None = None, fuse_qb: int = FUSE_QB):
+    """Grover over a ket sharded across the 'pages' mesh axis: local
+    H-clusters per page, paged H bits via the half-buffer pair exchange,
+    phase flips from split (local, page) index reads — all inside the
+    same `lax.fori_loop` body, so the HLO stays constant-size and the
+    per-iteration collectives ride ICI.  Returns (fn, sharding, iters)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops import sharded as shb
+
+    npg = mesh.devices.size
+    g = npg.bit_length() - 1
+    L = n - g
+    assert (1 << g) == npg, "page count must be a power of two"
+    assert L >= 1
+    if iters is None:
+        iters = grover_iterations(n)
+    target &= (1 << n) - 1
+    t_lo, t_hi = target & ((1 << L) - 1), target >> L
+    k = max(1, min(fuse_qb, L))
+    hmp2 = gk.mtrx_planes(np.asarray(mat.H2))
+
+    def body(local):
+        pid = jax.lax.axis_index("pages")
+        dt = local.dtype
+        clusters = _h_clusters(L, k, dt)
+        idx = gk.iota_for(local)
+        is_t = (idx == t_lo) & (pid == t_hi)
+        oracle = jnp.where(is_t, -1.0, 1.0).astype(dt)
+        is_0 = (idx == 0) & (pid == 0)
+        zflip = jnp.where(is_0, -1.0, 1.0).astype(dt)
+
+        def h_all(p):
+            for (c0, w, mp) in clusters:
+                p = gk.apply_kxk(p, mp, L, c0, w)
+            for q in range(L, n):
+                p = shb.apply_global_2x2(p, hmp2.astype(dt), npg, q - L,
+                                         0, 0, 0, 0)
+            return p
+
+        def iteration(_, p):
+            p = p * oracle
+            p = h_all(p)
+            p = p * zflip
+            return h_all(p)
+
+        return jax.lax.fori_loop(0, iters, iteration, h_all(local))
+
+    fn = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=P(None, "pages"),
+                      out_specs=P(None, "pages")),
+        donate_argnums=(0,),
+    )
+    return fn, NamedSharding(mesh, P(None, "pages")), iters

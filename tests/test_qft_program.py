@@ -144,6 +144,23 @@ def test_fused_grover_finds_target():
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out), atol=2e-5)
 
 
+def test_sharded_grover_matches_single_chip():
+    from qrack_tpu.models import grover as grm
+
+    n, target = 8, 137   # paged bits in both the target and the ladders
+    devs = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devs), ("pages",))
+    ref_fn, iters = grm.make_grover_fn(n, target)
+    ref = jax.jit(ref_fn)(qftm.basis_planes(n, 0))
+    sfn, sharding, siters = grm.make_sharded_grover_fn(mesh, n, target)
+    assert siters == iters
+    out = sfn(qftm.basis_planes(n, 0, sharding=sharding))
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                               np.asarray(ref), atol=3e-5)
+    p = grm.success_probability(np.asarray(jax.device_get(out)), target)
+    assert p > 0.99
+
+
 def test_compiled_sharded_circuit_matches_oracle():
     from jax.sharding import Mesh
 
