@@ -110,11 +110,11 @@ def make_sharded_grover_fn(mesh, n: int, target: int,
     target &= (1 << n) - 1
     t_lo, t_hi = target & ((1 << L) - 1), target >> L
     k = max(1, min(fuse_qb, L))
-    hmp2 = gk.mtrx_planes(np.asarray(mat.H2))
 
     def body(local):
         pid = jax.lax.axis_index("pages")
         dt = local.dtype
+        hmp2 = gk.mtrx_planes(np.asarray(mat.H2), dt)
         clusters = _h_clusters(L, k, dt)
         idx = gk.iota_for(local)
         is_t = (idx == t_lo) & (pid == t_hi)
@@ -126,8 +126,7 @@ def make_sharded_grover_fn(mesh, n: int, target: int,
             for (c0, w, mp) in clusters:
                 p = gk.apply_kxk(p, mp, L, c0, w)
             for q in range(L, n):
-                p = shb.apply_global_2x2(p, hmp2.astype(dt), npg, q - L,
-                                         0, 0, 0, 0)
+                p = shb.apply_global_2x2(p, hmp2, npg, q - L, 0, 0, 0, 0)
             return p
 
         def iteration(_, p):

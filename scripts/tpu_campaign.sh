@@ -51,6 +51,10 @@ LOG=bench_out/campaign_$(date +%d%H%M%S).log
     QRACK_BENCH_QB=20 QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 \
     timeout 480 python bench.py
 
+  echo "=== 4c) grover w20 (fori_loop program; baseline rows w16-20) ==="
+  QRACK_BENCH=grover QRACK_BENCH_QB=20 QRACK_BENCH_QB_FIRST=16 \
+    QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+
   echo "=== 5) pallas native A/B (w20) ==="
   QRACK_USE_PALLAS=0 QRACK_BENCH=qft QRACK_BENCH_QB=20 \
     QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
