@@ -11,7 +11,7 @@ simulator through the public gate surface only, so any layer stack
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -71,13 +71,14 @@ def qaoa_maxcut_expectation(qsim_factory, edges: Sequence[Tuple[int, int]],
             q.CNOT(a, c)
         for i in range(n):
             q.RX(2.0 * b, i)
+    # every edge's <Z_a Z_b> from ONE full-state pass (per-edge ProbMask
+    # calls would each re-densify and rescan the 2^n amplitudes)
+    p = np.asarray(q.GetProbs())
+    idx = np.arange(p.size)
     total = 0.0
     for (a, c) in edges:
-        # <Z_a Z_b> from the 4 joint outcomes of the (a, c) marginal
-        p11 = q.ProbMask((1 << a) | (1 << c), (1 << a) | (1 << c))
-        p00 = q.ProbMask((1 << a) | (1 << c), 0)
-        zz = 2.0 * (p00 + p11) - 1.0
-        total += 0.5 * (1.0 - zz)
+        differ = ((idx >> a) ^ (idx >> c)) & 1
+        total += float(p[differ == 1].sum())
     return total
 
 
@@ -87,7 +88,9 @@ def qaoa_maxcut_grid(qsim_factory, edges, n: int, p: int = 1,
     optimizes classically too); returns (best expected cut, angles)."""
     grid = [math.pi * (k + 0.5) / resolution for k in range(resolution)]
     # greedy layer-by-layer extension keeps the search tiny (p=1 is
-    # simply one greedy layer = the exhaustive (gamma, beta) grid)
+    # simply one greedy layer = the exhaustive (gamma, beta) grid);
+    # the grid has no ~identity angles, so a deeper layer can only
+    # hurt — stop (and keep the shallower answer) when it does
     best, best_angles = -1.0, None
     gs: List[float] = []
     bs: List[float] = []
@@ -99,6 +102,8 @@ def qaoa_maxcut_grid(qsim_factory, edges, n: int, p: int = 1,
                     qsim_factory, edges, n, gs + [g], bs + [b])
                 if v > layer_best:
                     layer_best, pick = v, (g, b)
+        if layer_best <= best:
+            break
         gs.append(pick[0])
         bs.append(pick[1])
         best, best_angles = layer_best, (tuple(gs), tuple(bs))

@@ -36,6 +36,10 @@ LOG=bench_out/campaign_$(date +%d%H%M%S).log
   QRACK_BENCH=qft QRACK_BENCH_SWEEP=20:26 QRACK_BENCH_QB=26 \
     QRACK_BENCH_BUDGET=3000 timeout 3060 python bench.py
 
+  echo "=== 2b) wide single-chip qft (w28; carried-fraction program) ==="
+  QRACK_BENCH=qft QRACK_BENCH_QB=28 QRACK_BENCH_QB_FIRST=28 \
+    QRACK_BENCH_SAMPLES=3 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+
   echo "=== 3) bf16 w24 ==="
   QRACK_BENCH=qft QRACK_BENCH_DTYPE=bfloat16 QRACK_BENCH_QB=24 \
     QRACK_BENCH_QB_FIRST=24 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py

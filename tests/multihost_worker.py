@@ -50,6 +50,34 @@ def main() -> None:
     state = q.GetQuantumState()  # replicated collective fetch
     p3 = q.Prob(3)
     m = q.MAll()                 # collapse: identical draw everywhere
+
+    # 2) the flagship fused sharded programs over the SAME global mesh:
+    #    whole-circuit QFT / brick-wall RCS / fori_loop Grover running
+    #    with shards owned by different processes (gloo as the DCN
+    #    stand-in); reads go through a replicated-output fetch, the only
+    #    read pattern legal when no process addresses every shard
+    from jax.sharding import Mesh
+
+    from qrack_tpu.models import grover as grm
+    from qrack_tpu.models import qft as qftm
+    from qrack_tpu.models import rcs as rcsm
+    from qrack_tpu.parallel.cluster import replicate_program
+
+    mesh = Mesh(np.array(jax.devices()), ("pages",))
+    fetch = replicate_program(mesh, 1 << n)
+
+    qfn, qsh = qftm.make_sharded_qft_fn(mesh, n)
+    qout = qfn(qftm.basis_planes(n, 5, sharding=qsh))
+    qamps = np.asarray(jax.device_get(fetch(qout, 0)))
+
+    rfn, rsh = rcsm.make_sharded_rcs_fn(mesh, n, depth=4, seed=11)
+    rout = rfn(qftm.basis_planes(n, 0, sharding=rsh))
+    ramps = np.asarray(jax.device_get(fetch(rout, 0)))
+
+    gfn, gsh, _ = grm.make_sharded_grover_fn(mesh, n, target=3)
+    gout = gfn(qftm.basis_planes(n, 0, sharding=gsh))
+    gamps = np.asarray(jax.device_get(fetch(gout, 0)))
+
     print("RESULT " + json.dumps({
         "proc": process_index(),
         "procs": process_count(),
@@ -58,6 +86,10 @@ def main() -> None:
         "im": [float(x) for x in state.imag],
         "prob3": float(p3),
         "mall": int(m),
+        "qft_re": [float(x) for x in qamps[0]],
+        "qft_im": [float(x) for x in qamps[1]],
+        "rcs_norm": float((ramps[0] ** 2 + ramps[1] ** 2).sum()),
+        "grover_p_target": grm.success_probability(gamps, 3),
     }), flush=True)
 
 

@@ -82,11 +82,24 @@ def test_cluster_matches_oracle(n_procs):
         results.append(json.loads(lines[0][len("RESULT "):]))
 
     ref_state, ref_p3 = _oracle_state_and_prob()
+    # single-process references for the fused sharded programs
+    import jax
+
+    from qrack_tpu.models import qft as qftm
+    from qrack_tpu.ops import gatekernels as gk
+
+    ref_qft = gk.from_planes(
+        jax.jit(qftm.make_qft_fn(7))(qftm.basis_planes(7, 5)))
     for r in results:
         assert r["procs"] == n_procs
         assert r["n_global_devices"] == 8
         got = np.asarray(r["re"]) + 1j * np.asarray(r["im"])
         np.testing.assert_allclose(got, ref_state, atol=3e-5)
         assert abs(r["prob3"] - ref_p3) < 3e-5
+        # flagship fused programs ran over the multi-process mesh
+        got_qft = np.asarray(r["qft_re"]) + 1j * np.asarray(r["qft_im"])
+        np.testing.assert_allclose(got_qft, ref_qft, atol=3e-5)
+        assert abs(r["rcs_norm"] - 1.0) < 1e-3
+        assert r["grover_p_target"] > 0.9
     # host-side measurement draw must agree across processes
     assert len({r["mall"] for r in results}) == 1
