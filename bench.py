@@ -167,45 +167,42 @@ def _measure(width: int, samples: int):
     # On the axon-tunneled TPU, block_until_ready acks dispatch rather
     # than completion (measured: 235 us "wall" for a w22 QFT whose real
     # execution is far longer) — the only trustworthy sync is an actual
-    # device->host read.  So off-CPU we time K chained applications
-    # bracketed by a 1-amplitude device_get, subtract the empty-queue
-    # devget round-trip, and divide by K (validated by
-    # scripts/tpu_timing_probe.py's K=1-vs-K=8 agreement check).
+    # device->host read.  Off-CPU, the shared qrack_tpu.utils.timing
+    # methodology times K chained applications bracketed by a
+    # 1-amplitude device_get minus the empty-queue round trip
+    # (validated by scripts/tpu_timing_probe.py's K-agreement check).
+    from qrack_tpu.utils import timing
+
     sync_mode = os.environ.get(
         "QRACK_BENCH_SYNC", "block" if plat == "cpu" else "devget")
     chain = int(os.environ.get(
         "QRACK_BENCH_CHAIN", "1" if sync_mode == "block" else "4"))
 
-    def _sync(pl):
-        if sync_mode == "devget":
-            jax.device_get(pl[:, :1])
-        else:
-            pl.block_until_ready()
-
     body, planes = _make_fn(width)
     fn = jax.jit(body, donate_argnums=(0,))
     planes = fn(planes)
-    _sync(planes)
     sync_s = 0.0
     if sync_mode == "devget":
-        reps = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            _sync(planes)
-            reps.append(time.perf_counter() - t0)
-        sync_s = min(reps)
+        timing.devget_sync(planes)
+        sync_s = timing.empty_queue_sync_s(planes)
+    else:
+        planes.block_until_ready()
     prof_dir = os.environ.get("QRACK_BENCH_PROFILE")
     if prof_dir:
         # xplane dump for MFU/HBM analysis (SURVEY §5 tracing row);
         # wraps only the timed region so compile time stays out
         jax.profiler.start_trace(prof_dir)
-    times = []
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        for _ in range(chain):
-            planes = fn(planes)
-        _sync(planes)
-        times.append(max(time.perf_counter() - t0 - sync_s, 0.0) / chain)
+    if sync_mode == "devget":
+        times, planes = timing.time_chain(fn, planes, chain, samples,
+                                          sync_s)
+    else:
+        times = []
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            for _ in range(chain):
+                planes = fn(planes)
+            planes.block_until_ready()
+            times.append((time.perf_counter() - t0) / chain)
     if prof_dir:
         jax.profiler.stop_trace()
     st = _stats(times)

@@ -1,0 +1,50 @@
+"""Honest device timing over the axon relay — the shared methodology.
+
+`block_until_ready` through the relay acks dispatch, not completion
+(measured; docs/TPU_EVIDENCE.md), so every quotable wall-clock here is
+K chained applications bracketed by an actual 1-amplitude device read,
+with the empty-queue read's round trip subtracted.  Used by bench.py,
+scripts/tpu_timing_probe.py and scripts/microbench.py so the sync
+accounting can never diverge between them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Tuple
+
+
+def devget_sync(planes) -> None:
+    """Force completion of everything queued on `planes`' device via a
+    real device->host read (1 amplitude)."""
+    import jax
+    import numpy as np
+
+    np.asarray(jax.device_get(planes[:, :1]))
+
+
+def empty_queue_sync_s(planes, reps: int = 3) -> float:
+    """Round-trip cost of the sync read itself with an empty queue
+    (min over `reps` — the subtraction baseline)."""
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        devget_sync(planes)
+        out.append(time.perf_counter() - t0)
+    return min(out)
+
+
+def time_chain(fn: Callable, planes, chain: int, samples: int,
+               sync_s: float) -> Tuple[List[float], object]:
+    """Per-application walls: `samples` measurements of `chain` chained
+    fn applications each, devget-synced, minus `sync_s`, divided by
+    `chain`.  Returns (times, final_planes) — fn may donate its input,
+    so the caller must keep using the returned planes."""
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        for _ in range(chain):
+            planes = fn(planes)
+        devget_sync(planes)
+        times.append(max(time.perf_counter() - t0 - sync_s, 0.0) / chain)
+    return times, planes

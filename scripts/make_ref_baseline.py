@@ -40,6 +40,14 @@ CASES = {
     "grover": ("test_grover", []),
 }
 
+# per-gate kernel rows (scripts/microbench.py counterparts); the
+# reference only ships _single cases for these three
+GATE_CASES = {
+    "gate_x": "test_x_single",
+    "gate_cnot": "test_cnot_single",
+    "gate_swap": "test_swap_single",
+}
+
 SECTION_RE = re.compile(r"^#+ (.+?) #+$")
 ROW_RE = re.compile(r"^(\d+), ([0-9.e+-]+),")
 
@@ -66,6 +74,8 @@ def main():
     ap.add_argument("--timeout", type=int, default=3600)
     ap.add_argument("--skip-rcs", action="store_true")
     ap.add_argument("--only", help="run a single workload key from CASES")
+    ap.add_argument("--gates", action="store_true",
+                    help="also record the per-gate *_single kernel rows")
     ap.add_argument("--single", action="store_true",
                     help="only the max width, not the full sweep")
     args = ap.parse_args()
@@ -80,7 +90,10 @@ def main():
         except Exception:
             data = {}
 
-    for wl, (case, extra) in CASES.items():
+    cases = dict(CASES)
+    if args.gates:
+        cases.update({k: (v, []) for k, v in GATE_CASES.items()})
+    for wl, (case, extra) in cases.items():
         if args.only and wl != args.only:
             continue
         if args.skip_rcs and wl.startswith("rcs"):

@@ -65,6 +65,9 @@ LOG=bench_out/campaign_$(date +%d%H%M%S).log
   QRACK_USE_PALLAS=1 QRACK_BENCH=qft QRACK_BENCH_QB=20 \
     QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
 
+  echo "=== 5b) per-gate microbench (w22) ==="
+  timeout 480 python scripts/microbench.py 22 8
+
   echo "=== 6) device parity test ==="
   timeout 300 python -m pytest tests/test_tpu_device.py -q
 

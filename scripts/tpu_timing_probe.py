@@ -36,24 +36,23 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
     from qrack_tpu.models import qft as qftm
+    from qrack_tpu.utils import timing
 
     w = int(sys.argv[1]) if len(sys.argv) > 1 else 22
     fn = jax.jit(qftm.make_qft_fn(w), donate_argnums=(0,))
     planes = qftm.basis_planes(w, 12345 & ((1 << w) - 1))
 
-    def devget(pl):
-        return np.asarray(jax.device_get(pl[:, :1]))
-
     t0 = time.perf_counter()
     planes = fn(planes)
-    devget(planes)
+    timing.devget_sync(planes)
     print(f"warm ok w={w} t={time.perf_counter() - t0:.2f}s", flush=True)
 
-    # empty-queue sync cost (tunnel round trip for an 8-byte read)
+    # empty-queue sync cost (tunnel round trip for an 8-byte read);
+    # recompute the rep list locally for the jitter report below
     syncs = []
     for _ in range(3):
         t0 = time.perf_counter()
-        devget(planes)
+        timing.devget_sync(planes)
         syncs.append(time.perf_counter() - t0)
     t_sync = min(syncs)
     print(f"devget_empty_queue s={t_sync:.6f} (3 reps: "
@@ -61,14 +60,9 @@ def main() -> None:
 
     per_app = {}
     for k in (1, 8):
-        t0 = time.perf_counter()
-        for _ in range(k):
-            planes = fn(planes)
-        devget(planes)
-        tk = time.perf_counter() - t0
-        per_app[k] = max(tk - t_sync, 0.0) / k
-        print(f"chain{k}_devget total_s={tk:.6f} per_app_s={per_app[k]:.6f}",
-              flush=True)
+        ts, planes = timing.time_chain(fn, planes, k, 1, t_sync)
+        per_app[k] = ts[0]
+        print(f"chain{k}_devget per_app_s={per_app[k]:.6f}", flush=True)
 
     # legacy block_until_ready number, printed for comparison only
     t0 = time.perf_counter()
