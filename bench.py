@@ -211,12 +211,13 @@ def _measure(width: int, samples: int):
         st["chain"] = chain
         st["sync_overhead_s"] = round(sync_s, 6)
     if WORKLOAD == "qft":
-        # the sweep silently switches program forms at FAST_COMPILE_QB;
-        # record which one this width ran so scaling curves attribute
-        # any discontinuity to the form change, not the hardware
+        # the sweep silently switches program forms at FAST_COMPILE_QB
+        # (accelerators only); record which one this width ran so
+        # scaling curves attribute any discontinuity to the form
+        # change, not the hardware
         from qrack_tpu.models import qft as qftm
 
-        st["qft_form"] = ("fast" if width >= qftm.FAST_COMPILE_QB
+        st["qft_form"] = ("fast" if qftm.default_fast(width)
                           else "unrolled")
     if WORKLOAD == "xeb":
         st["xeb_fidelity"] = round(_xeb_from_planes(planes, width), 6)

@@ -91,8 +91,11 @@ def main():
             data = {}
 
     cases = dict(CASES)
-    if args.gates:
+    if args.gates or (args.only in GATE_CASES):
         cases.update({k: (v, []) for k, v in GATE_CASES.items()})
+    if args.only and args.only not in cases:
+        sys.exit(f"--only {args.only!r}: no such workload "
+                 f"(choose from {sorted(cases) + sorted(GATE_CASES)})")
     for wl, (case, extra) in cases.items():
         if args.only and wl != args.only:
             continue
