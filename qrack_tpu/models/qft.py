@@ -129,17 +129,15 @@ def default_fast(n: int) -> bool:
     compilation is expensive — accelerators behind the remote-compile
     tunnel — so CPU backends keep the unrolled form UNLESS the operator
     set QRACK_QFT_FAST_QB explicitly (an explicit threshold wins on
-    every backend; otherwise the knob would be dead on CPU)."""
-    if n < FAST_COMPILE_QB:
+    every backend; otherwise the knob would be dead on CPU).  The env
+    var is re-read here so a threshold set after import is honored."""
+    env = os.environ.get("QRACK_QFT_FAST_QB")
+    threshold = int(env) if env is not None else FAST_COMPILE_QB
+    if n < threshold:
         return False
-    if "QRACK_QFT_FAST_QB" in os.environ:
+    if env is not None:
         return True
-    try:
-        import jax
-
-        return jax.default_backend() != "cpu"
-    except Exception:
-        return True
+    return jax.default_backend() != "cpu"
 
 
 def make_qft_fn(n: int, inverse: bool = False, fast: bool | None = None):

@@ -95,7 +95,7 @@ def main():
         cases.update({k: (v, []) for k, v in GATE_CASES.items()})
     if args.only and args.only not in cases:
         sys.exit(f"--only {args.only!r}: no such workload "
-                 f"(choose from {sorted(cases) + sorted(GATE_CASES)})")
+                 f"(choose from {sorted(set(cases) | set(GATE_CASES))})")
     for wl, (case, extra) in cases.items():
         if args.only and wl != args.only:
             continue

@@ -1,0 +1,78 @@
+"""Seeded API fuzzer: random interleavings of the whole public op
+vocabulary (gates, rotations, registers, ALU, swaps, parity, measures)
+on the oracle vs the optimal layer stack, asserting state parity after
+every trial.  The conformance battery runs fixed circuits per engine;
+this hunts interaction bugs between op families and the QUnit shard /
+fusion machinery (reference analogue: the randomized sections of
+test/tests.cpp).
+"""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU, create_quantum_interface
+from qrack_tpu.utils.rng import QrackRandom
+
+N = 6
+
+
+def _ops(rng):
+    """One random op as (name, args) applied identically to both."""
+    q = lambda: int(rng.integers(0, N))
+    ang = lambda: float(rng.uniform(0, 2 * np.pi))
+
+    def two():
+        a = q()
+        b = (a + 1 + int(rng.integers(0, N - 1))) % N
+        return a, b
+
+    def reg():
+        start = int(rng.integers(0, N - 1))
+        length = int(rng.integers(1, N - start + 1))
+        return start, min(length, N - start)
+
+    choices = []
+    for g in ("H", "X", "Y", "Z", "S", "T"):
+        choices.append((g, lambda g=g: (g, (q(),))))
+    for g in ("RX", "RY", "RZ"):
+        choices.append((g, lambda g=g: (g, (ang(), q()))))
+    for g in ("CNOT", "CZ", "Swap", "ISwap"):
+        choices.append((g, lambda g=g: (g, two())))
+    choices.append(("CCNOT", lambda: ("CCNOT", (0, 1, 2 + q() % (N - 2)))))
+    choices.append(("INC", lambda: ("INC", (int(rng.integers(0, 8)),) + reg())))
+    choices.append(("ROL", lambda: ("ROL", (int(rng.integers(0, 3)),) + reg())))
+    choices.append(("XMask", lambda: ("XMask", (int(rng.integers(1, 1 << N)),))))
+    choices.append(("ZMask", lambda: ("ZMask", (int(rng.integers(1, 1 << N)),))))
+    choices.append(("PhaseFlipIfLess",
+                    lambda: ("PhaseFlipIfLess",
+                             (int(rng.integers(1, 4)),) + reg())))
+    choices.append(("SetBit", lambda: ("SetBit", (q(), bool(rng.integers(0, 2))))))
+    name, make = choices[int(rng.integers(0, len(choices)))]
+    return make()
+
+
+@pytest.mark.parametrize("trial", range(12))
+def test_random_api_interleavings_match_oracle(trial):
+    rng = np.random.Generator(np.random.PCG64(1000 + trial))
+    o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
+    s = create_quantum_interface("optimal", N, rng=QrackRandom(trial),
+                                 rand_global_phase=False)
+    for step in range(30):
+        name, args = _ops(rng)
+        getattr(o, name)(*args)
+        getattr(s, name)(*args)
+        if rng.integers(0, 10) == 0:     # occasional mid-stream reads
+            qb = int(rng.integers(0, N))
+            assert abs(o.Prob(qb) - s.Prob(qb)) < 3e-5, (trial, step, name)
+    a = np.asarray(o.GetQuantumState())
+    b = np.asarray(s.GetQuantumState())
+    f = abs(np.vdot(a, b)) ** 2
+    assert f > 1 - 1e-6, (trial, f)
+    # and a forced measurement keeps both in the same collapsed state
+    o.rng = s.rng = QrackRandom(5000 + trial)
+    qb = trial % N
+    r = o.M(qb)
+    assert s.ForceM(qb, r) == r
+    f = abs(np.vdot(np.asarray(o.GetQuantumState()),
+                    np.asarray(s.GetQuantumState()))) ** 2
+    assert f > 1 - 1e-6, (trial, f)
