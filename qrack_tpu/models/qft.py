@@ -160,18 +160,14 @@ def make_qft_fn(n: int, inverse: bool = False, fast: bool | None = None):
 
 def _sharded_h(local, hm, L, npg, target):
     """H inside the shard_map body: local target applies per page; paged
-    target rides one ppermute pair exchange."""
+    target rides the pager's half-buffer pair exchange (each ppermute
+    payload is half a page — never ship a whole page; reference
+    discipline: ShuffleBuffers, src/qpager.cpp:400-447)."""
     if target < L:
         return gk.apply_2x2(local, hm, L, target)
-    gpos = target - L
-    perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
-    pid = jax.lax.axis_index("pages")
-    b = (pid >> gpos) & 1
-    other = jax.lax.ppermute(local, "pages", perm)
-    s = 1.0 / math.sqrt(2.0)
-    # H is real: diag entry s or -s by b; off-diag always s
-    dd = jnp.where(b == 0, s, -s)
-    return local * dd + other * s
+    from ..ops import sharded as shb
+
+    return shb.apply_global_2x2(local, hm, npg, target - L, 0, 0, 0, 0)
 
 
 def _sharded_stage_phase(local, L, pairs):
