@@ -9,8 +9,6 @@ light-cone circuit buffering — composed into runtime-configurable
 stacks by a factory.
 """
 
-import os as _os
-
 import jax as _jax
 
 # Amplitudes live in float32 planes, but TPU's DEFAULT dot/einsum
@@ -18,10 +16,9 @@ import jax as _jax
 # that decays a w22 QFT's norm to 0.918 after 18 applications.  Gate
 # contractions are 2-4 wide, so full precision is effectively free;
 # make it the package default (override: QRACK_MATMUL_PRECISION).
-_jax.config.update(
-    "jax_default_matmul_precision",
-    _os.environ.get("QRACK_MATMUL_PRECISION", "highest"),
-)
+from ._precision import matmul_precision_setting as _matmul_precision_setting
+
+_jax.config.update("jax_default_matmul_precision", _matmul_precision_setting())
 
 from .interface import QInterface  # noqa: F401
 from .engines import QEngine, QEngineCPU, QEngineSparse  # noqa: F401

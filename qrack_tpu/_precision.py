@@ -1,0 +1,31 @@
+"""Shared QRACK_MATMUL_PRECISION parsing.
+
+One helper so the package-level ``jax_default_matmul_precision`` update
+and the per-einsum ``precision=`` overrides (ops/gatekernels.py) can
+never disagree: '' and unset both mean the package default ('highest'),
+and 'default'/'high'/'highest' map to the matching jax.lax.Precision.
+Invalid non-empty values are passed through to jax.config.update, which
+raises at import with jax's own error message.
+"""
+
+import os
+
+
+def matmul_precision_setting() -> str:
+    """Normalized QRACK_MATMUL_PRECISION string ('' / unset -> 'highest')."""
+    return os.environ.get("QRACK_MATMUL_PRECISION", "").strip() or "highest"
+
+
+def matmul_precision():
+    """Per-einsum jax.lax.Precision matching the global setting.
+
+    None for 'default' (defer to the global default, which the same
+    setting controls) — so an env override affects both layers equally.
+    """
+    import jax
+
+    return {
+        "default": None,
+        "high": jax.lax.Precision.HIGH,
+        "highest": jax.lax.Precision.HIGHEST,
+    }.get(matmul_precision_setting())

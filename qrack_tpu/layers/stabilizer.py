@@ -871,10 +871,10 @@ class QStabilizer(QInterface):
     def Dispose(self, start: int, length: int, disposed_perm: Optional[int] = None) -> None:
         """Drop qubits that are each single-basis separable (Z, X, or Y
         eigenstates): non-Z qubits rotate to the Z basis first, then one
-        tableau-native DisposeZ each — exact at any width.  Disposal of
-        a span entangled within itself (but separable from the rest)
-        still routes through measurement first (reference disposes via
-        its Decompose machinery, src/qstabilizer.cpp)."""
+        tableau-native DisposeZ each — exact at any width.  A span
+        entangled within itself (even if separable from the rest) raises
+        NotImplementedError; callers must measure first (reference
+        disposes via its Decompose machinery, src/qstabilizer.cpp)."""
         states = self._separable_span_states(start, length)
         if states is None:
             raise NotImplementedError(
@@ -1053,8 +1053,8 @@ class QStabilizer(QInterface):
         for i in range(cut):
             if any(x[i, c] or z[i, c] for c in span):
                 return None  # genuinely entangled across the cut
-        rest_idx = np.asarray(outside)
-        span_idx = np.asarray(span)
+        rest_idx = np.asarray(outside, dtype=np.intp)
+        span_idx = np.asarray(span, dtype=np.intp)
         return ((x[cut:, span_idx], z[cut:, span_idx], r[cut:]),
                 (x[:cut, rest_idx], z[:cut, rest_idx], r[:cut]))
 

@@ -42,13 +42,11 @@ IDX_DTYPE = jnp.int32
 # while TPU DEFAULT precision truncates f32 operands to bf16 and visibly
 # decays the norm over deep circuits (measured: w22 QFT x18 -> |psi|^2 =
 # 0.918).  Explicit here as defense in depth — the package also sets
-# jax_default_matmul_precision at import — but honoring the same
-# QRACK_MATMUL_PRECISION override (None defers to the global default).
-import os as _os
+# jax_default_matmul_precision at import — with the per-einsum value
+# derived from the SAME env parse so the two layers cannot disagree.
+from .._precision import matmul_precision
 
-PREC = (None if _os.environ.get("QRACK_MATMUL_PRECISION", "highest")
-        in ("default", "")
-        else jax.lax.Precision.HIGHEST)
+PREC = matmul_precision()
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,14 @@ LOG=bench_out/campaign_$(date +%d%H%M%S).log
   QRACK_BENCH=qft QRACK_BENCH_QB=28 QRACK_BENCH_QB_FIRST=28 \
     QRACK_BENCH_SAMPLES=3 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
 
+  echo "=== 2c) hbm-limit single-chip qft (w30; 8.6 GB ket, roofline regime) ==="
+  QRACK_BENCH=qft QRACK_BENCH_QB=30 QRACK_BENCH_QB_FIRST=30 \
+    QRACK_BENCH_SAMPLES=3 QRACK_BENCH_BUDGET=900 timeout 960 python bench.py
+
+  echo "=== 2d) wide rcs (w28) ==="
+  QRACK_BENCH=rcs QRACK_BENCH_QB=28 QRACK_BENCH_QB_FIRST=28 \
+    QRACK_BENCH_SAMPLES=3 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+
   echo "=== 3) bf16 w24 ==="
   QRACK_BENCH=qft QRACK_BENCH_DTYPE=bfloat16 QRACK_BENCH_QB=24 \
     QRACK_BENCH_QB_FIRST=24 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
@@ -59,11 +67,15 @@ LOG=bench_out/campaign_$(date +%d%H%M%S).log
   QRACK_BENCH=grover QRACK_BENCH_QB=20 QRACK_BENCH_QB_FIRST=16 \
     QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
 
-  echo "=== 5) pallas native A/B (w20) ==="
-  QRACK_USE_PALLAS=0 QRACK_BENCH=qft QRACK_BENCH_QB=20 \
-    QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
-  QRACK_USE_PALLAS=1 QRACK_BENCH=qft QRACK_BENCH_QB=20 \
-    QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
+  echo "=== 5) pallas native A/B (w22, then w26 — the widths where HBM traffic dominates) ==="
+  QRACK_USE_PALLAS=0 QRACK_BENCH_SUFFIX=_xla QRACK_BENCH=qft QRACK_BENCH_QB=22 \
+    QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
+  QRACK_USE_PALLAS=1 QRACK_BENCH_SUFFIX=_pallas QRACK_BENCH=qft QRACK_BENCH_QB=22 \
+    QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
+  QRACK_USE_PALLAS=0 QRACK_BENCH_SUFFIX=_xla QRACK_BENCH=qft QRACK_BENCH_QB=26 \
+    QRACK_BENCH_QB_FIRST=26 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
+  QRACK_USE_PALLAS=1 QRACK_BENCH_SUFFIX=_pallas QRACK_BENCH=qft QRACK_BENCH_QB=26 \
+    QRACK_BENCH_QB_FIRST=26 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
 
   echo "=== 5b) per-gate microbench (w22) ==="
   timeout 480 python scripts/microbench.py 22 8

@@ -579,3 +579,34 @@ def test_entangled_span_decompose_symplectic():
     dest2 = QStabilizer(2, rng=QrackRandom(9))
     with pytest.raises(NotImplementedError):
         st2.Decompose(4, dest2)
+
+
+def test_full_width_decompose():
+    """Decompose with dest.qubit_count == qubit_count (empty remainder):
+    regression — the generator-splitting path used to build a float64
+    empty index array and raise IndexError (ADVICE r3)."""
+    st = QStabilizer(3, rng=QrackRandom(1), rand_global_phase=False)
+    st.H(0)
+    st.CNOT(0, 1)
+    st.CNOT(1, 2)
+    full = st.GetQuantumState()
+    dest = QStabilizer(3, rng=QrackRandom(2), rand_global_phase=False)
+    st.Decompose(0, dest)
+    assert st.qubit_count == 0 and dest.qubit_count == 3
+    rem = st.GetQuantumState()          # scalar amplitude of the empty register
+    np.testing.assert_allclose(rem, [1.0 + 0.0j], atol=1e-9)
+    np.testing.assert_allclose(dest.GetQuantumState(), full, atol=1e-9)
+
+    # width-generic: >20 qubits forces the generator path (no ket fallback)
+    st = QStabilizer(25, rng=QrackRandom(3))
+    st.H(0)
+    st.CNOT(0, 24)
+    dest = QStabilizer(25, rng=QrackRandom(4))
+    st.Decompose(0, dest)
+    assert st.qubit_count == 0 and dest.qubit_count == 25
+    # the Bell pair must survive the transfer: perfectly correlated,
+    # each marginal unbiased
+    assert abs(dest.Prob(0) - 0.5) < 1e-9
+    assert abs(dest.Prob(24) - 0.5) < 1e-9
+    m = dest.M(0)
+    assert dest.M(24) == m
