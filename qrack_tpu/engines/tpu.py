@@ -52,6 +52,7 @@ _j_normalize = jax.jit(gk.normalize, donate_argnums=(0,))
 _j_probs = jax.jit(gk.probs)
 _j_sum_sqr_diff = jax.jit(gk.sum_sqr_diff)
 _j_sample = jax.jit(gk.sample)
+_j_multishot = jax.jit(gk.multishot_mask_keys)
 _j_uc_2x2 = jax.jit(gk.uc_2x2, static_argnums=(2, 3, 4), donate_argnums=(0,))
 
 
@@ -179,22 +180,18 @@ class QEngineTPU(QEngine):
         return result
 
     def MultiShotMeasureMask(self, q_powers, shots: int) -> dict:
+        """Batched sampling with device-side bit compaction: the draw,
+        the masked-bit gather, and the key packing are one jitted
+        program; only (shots,) small ints reach the host, which then
+        histograms them with one np.unique (no per-shot Python loop)."""
         from ..utils.bits import log2
 
         u = jnp.asarray(self.rng.uniform(shots), dtype=self.dtype)
-        p = gk.probs(self._state)
-        cdf = jnp.cumsum(p)
-        draws = np.asarray(jnp.searchsorted(cdf, u * cdf[-1], side="right"))
-        bits = [log2(int(pw)) for pw in q_powers]
-        out: dict = {}
-        for d in draws:
-            d = int(min(d, self._state.shape[-1] - 1))
-            key = 0
-            for j, b in enumerate(bits):
-                if (d >> b) & 1:
-                    key |= 1 << j
-            out[key] = out.get(key, 0) + 1
-        return out
+        bits = jnp.asarray([log2(int(pw)) for pw in q_powers],
+                           dtype=gk.IDX_DTYPE)
+        keys = np.asarray(_j_multishot(self._state, u, bits))
+        vals, counts = np.unique(keys, return_counts=True)
+        return {int(v): int(c) for v, c in zip(vals, counts)}
 
     def _k_compose(self, other, start) -> None:
         other_planes = gk.to_planes(other.GetQuantumState(), self.dtype)

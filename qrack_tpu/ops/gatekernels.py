@@ -278,6 +278,21 @@ def sample(planes, u):
     return jnp.minimum(idx, p.shape[0] - 1)
 
 
+def multishot_mask_keys(planes, u, bits):
+    """Batched categorical draws + masked-bit compaction, all on device
+    (reference: the bulk MultiShotMeasureMask op,
+    src/qinterface/qinterface.cpp:807).  `u` is (shots,) uniforms,
+    `bits` a (k,) int array of qubit indices; returns (shots,) ints
+    whose bit j is drawn-index bit bits[j] — only the k-bit keys cross
+    to the host, never the 2^n probability vector."""
+    p = planes[0] ** 2 + planes[1] ** 2
+    cdf = jnp.cumsum(p)
+    draws = jnp.searchsorted(cdf, u * cdf[-1], side="right")
+    draws = jnp.minimum(draws, p.shape[0] - 1)
+    hit = (draws[:, None] >> bits[None, :]) & 1
+    return jnp.sum(hit << jnp.arange(bits.shape[0], dtype=draws.dtype), axis=1)
+
+
 def allocate(planes, n: int, start: int, length: int):
     """Insert |0> qubits at `start` as zero-pad + reshape."""
     high = 1 << (n - start)
