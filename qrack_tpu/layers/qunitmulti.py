@@ -1,50 +1,136 @@
-"""QUnitMulti: QUnit with per-subsystem device placement.
+"""QUnitMulti: QUnit with capability-aware per-subsystem device placement.
 
 Re-design of the reference layer (reference: include/qunitmulti.hpp:66;
 src/qunitmulti.cpp — each separable subsystem is a whole engine placed
 on one device; RedistributeQEngines greedily re-packs the biggest
 subsystems onto the most capable devices after every entangle/separate
-event :138-166,217; device table DeviceInfo :55; env
-QRACK_QUNITMULTI_DEVICES :72-117).
+event :138-166,217; device capability table DeviceInfo
+include/qunitmulti.hpp:55; per-device max-alloc guard
+src/common/oclengine.cpp:388; env QRACK_QUNITMULTI_DEVICES
+src/qunitmulti.cpp:72-117).
 
 Here a "device" is a JAX device id (meaningful when units are
-QEngineTPU/QHybrid-backed; the CPU oracle ignores placement). All
-devices are one chip class, so capability weighting is uniform and
-redistribution is size-greedy round-robin."""
+QEngineTPU/QHybrid-backed; the CPU oracle ignores placement).  Each
+device carries a DeviceInfo row: a ket-byte budget (discovered from the
+runtime's memory stats when available, else QRACK_QUNITMULTI_MAX_QB /
+QRACK_MAX_ALLOC_MB) and a capability weight.  Redistribution is greedy
+best-fit: subsystems size-descending onto the device with the most
+remaining weighted capacity, with per-device byte accounting — two
+large subsystems land on different chips, and a subsystem no device can
+hold raises MemoryError up front instead of letting the runtime OOM
+mid-gate (the reference's alloc-guard behavior)."""
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
 
 from .qunit import QUnit
+
+# The reference caps one ket at device-global/3 (OclMemDenom,
+# include/qengine_opencl.hpp:279): gate application transiently holds
+# input + output + workspace.  XLA donation usually keeps us at ~2
+# copies, but 3 is the honest planning number for compose/decompose.
+MEM_DENOM = 3
+
+
+@dataclass
+class DeviceInfo:
+    """Capability row (reference: include/qunitmulti.hpp:55)."""
+
+    device_id: int
+    capacity_bytes: int = 0      # ket budget; 0 = unguarded
+    weight: float = 1.0          # relative throughput (uniform on one chip class)
+    used_bytes: int = 0          # accounted ket bytes currently placed here
+
+    def free_bytes(self) -> float:
+        if self.capacity_bytes <= 0:
+            return float("inf")
+        return self.capacity_bytes - self.used_bytes
+
+
+def _discover_capacity(dev) -> int:
+    """Per-device ket budget in bytes: runtime memory stats when the
+    backend exposes them (TPU PJRT does), else env, else unguarded."""
+    try:
+        stats = dev.memory_stats()
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit // MEM_DENOM
+    except Exception:
+        pass
+    max_qb = int(os.environ.get("QRACK_QUNITMULTI_MAX_QB", "0"))
+    if max_qb > 0:
+        return 2 * (1 << max_qb) * 4  # f32 planes
+    max_mb = int(os.environ.get("QRACK_MAX_ALLOC_MB", "0"))
+    if max_mb > 0:
+        return max_mb << 20
+    return 0
+
+
+def _unit_bytes(unit) -> int:
+    """Steady-state ket bytes of one subsystem engine."""
+    n = unit.qubit_count
+    dtype = getattr(unit, "dtype", None)
+    if dtype is not None:
+        return 2 * (1 << n) * dtype.itemsize  # split real/imag planes
+    return (1 << n) * 16  # complex128 oracle
 
 
 class QUnitMulti(QUnit):
     def __init__(self, qubit_count: int, init_state: int = 0,
-                 device_ids: Optional[Sequence[int]] = None, **kwargs):
+                 device_ids: Optional[Sequence[int]] = None,
+                 device_table: Optional[Sequence[DeviceInfo]] = None,
+                 **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
-        if device_ids is None:
-            try:
-                import jax
+        if device_table is not None:
+            self.devices = list(device_table)
+        else:
+            self.devices = self._build_device_table(device_ids)
 
-                device_ids = [d.id for d in jax.devices()]
-            except Exception:
-                device_ids = [0]
-        self.device_ids = list(device_ids)
-        self._next_dev = 0
+    @staticmethod
+    def _build_device_table(device_ids: Optional[Sequence[int]]) -> List[DeviceInfo]:
+        env_ids = os.environ.get("QRACK_QUNITMULTI_DEVICES", "")
+        if device_ids is None and env_ids:
+            device_ids = [int(t) for t in env_ids.split(",") if t.strip()]
+        try:
+            import jax
+
+            jdevs = {d.id: d for d in jax.devices()}
+        except Exception:
+            jdevs = {}
+        if device_ids is None:
+            device_ids = sorted(jdevs) if jdevs else [0]
+        return [
+            DeviceInfo(device_id=i,
+                       capacity_bytes=_discover_capacity(jdevs[i]) if i in jdevs else 0)
+            for i in device_ids
+        ]
+
+    # -- device table surface (reference: SetDeviceList/GetDeviceList) --
 
     def SetDeviceList(self, device_ids: Sequence[int]) -> None:
-        self.device_ids = list(device_ids)
+        self.devices = self._build_device_table(list(device_ids))
+        self.RedistributeQEngines()
 
     def GetDeviceList(self) -> List[int]:
-        return list(self.device_ids)
+        return [d.device_id for d in self.devices]
+
+    # backwards-compatible alias used by earlier callers/tests
+    @property
+    def device_ids(self) -> List[int]:
+        return self.GetDeviceList()
+
+    # -- placement ------------------------------------------------------
 
     def _to_unit(self, q: int):
         fresh = self.shards[q].unit is None
         unit = super()._to_unit(q)
         if fresh and hasattr(unit, "SetDevice"):
-            unit.SetDevice(self.device_ids[self._next_dev % len(self.device_ids)])
-            self._next_dev += 1
+            dev = self._best_device(_unit_bytes(unit))
+            dev.used_bytes += _unit_bytes(unit)
+            unit.SetDevice(dev.device_id)
         return unit
 
     def _merge(self, qubits):
@@ -56,9 +142,40 @@ class QUnitMulti(QUnit):
         super()._detach_raw(q, collapsed_val, base_vec)
         self.RedistributeQEngines()
 
+    def _capability_order(self) -> List[DeviceInfo]:
+        """Devices most-capable-first: weight, then budget (unguarded
+        sorts as largest)."""
+        return sorted(
+            self.devices,
+            key=lambda d: (-d.weight,
+                           -(d.capacity_bytes if d.capacity_bytes > 0
+                             else 2 ** 62)))
+
+    def _best_device(self, need_bytes: int) -> DeviceInfo:
+        """Most free capacity (weight-preferred) among devices that can
+        hold `need_bytes`; MemoryError if none can (the alloc guard).
+        Used for fresh single-qubit units, where spread matters more
+        than capability."""
+        fits = [d for d in self.devices
+                if d.capacity_bytes <= 0 or d.free_bytes() >= need_bytes]
+        if not fits:
+            self._raise_no_fit(need_bytes)
+        return max(fits, key=lambda d: (d.free_bytes(), d.weight))
+
+    def _raise_no_fit(self, need_bytes: int) -> None:
+        cap = max((d.capacity_bytes for d in self.devices), default=0)
+        raise MemoryError(
+            f"no device can hold a {need_bytes}-byte subsystem ket "
+            f"(largest per-device budget {cap} bytes; "
+            "QRACK_QUNITMULTI_MAX_QB / QRACK_MAX_ALLOC_MB)")
+
     def RedistributeQEngines(self) -> None:
-        """Greedy size-descending placement across the device list
-        (reference: src/qunitmulti.cpp:217)."""
+        """Pairwise greedy re-pack: subsystems size-descending onto
+        devices most-capable-first with wraparound, skipping devices
+        whose byte budget the subsystem exceeds (reference:
+        src/qunitmulti.cpp:217 sorts engines by size and devices by
+        capability and re-packs biggest-onto-most-capable; the byte
+        accounting here also guards allocation up front)."""
         units = []
         seen = set()
         for s in self.shards:
@@ -66,6 +183,17 @@ class QUnitMulti(QUnit):
                 seen.add(id(s.unit))
                 units.append(s.unit)
         units.sort(key=lambda u: -u.qubit_count)
+        order = self._capability_order()
+        for d in self.devices:
+            d.used_bytes = 0
         for i, u in enumerate(units):
-            if hasattr(u, "SetDevice"):
-                u.SetDevice(self.device_ids[i % len(self.device_ids)])
+            need = _unit_bytes(u)
+            for k in range(len(order)):
+                d = order[(i + k) % len(order)]
+                if d.capacity_bytes <= 0 or d.free_bytes() >= need:
+                    d.used_bytes += need
+                    if hasattr(u, "SetDevice"):
+                        u.SetDevice(d.device_id)
+                    break
+            else:
+                self._raise_no_fit(need)

@@ -384,3 +384,93 @@ def test_noisy_xeb_fidelity_sweep():
     reference_rcs_state(n, depth, seed, q)
     est = q.GetUnitaryFidelity()
     assert 0.2 < fids[1] / est < 2.5, (fids[1], est)
+
+
+# ---------------- QUnitMulti device accounting ----------------
+
+class _RecordingEngine(QEngineCPU):
+    """CPU oracle + SetDevice recorder, standing in for QEngineTPU
+    placement in the virtual-device tests."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.device_id = None
+
+    def SetDevice(self, device_id):
+        self.device_id = device_id
+
+
+def _rec_factory(n, **kw):
+    kw.setdefault("rand_global_phase", False)
+    return _RecordingEngine(n, **kw)
+
+
+def test_qunitmulti_packs_large_units_apart():
+    """Two large subsystems must land on DIFFERENT devices when one
+    device cannot hold both (reference: capability-aware
+    RedistributeQEngines, src/qunitmulti.cpp:217)."""
+    from qrack_tpu.layers.qunitmulti import DeviceInfo
+
+    # each device holds exactly one 3-qubit c128 ket (128 bytes)
+    table = [DeviceInfo(device_id=0, capacity_bytes=128),
+             DeviceInfo(device_id=1, capacity_bytes=128)]
+    q = QUnitMulti(6, unit_factory=_rec_factory, rng=QrackRandom(5),
+                   device_table=table, rand_global_phase=False)
+    # two 3-qubit entangled clumps
+    q.H(0); q.CNOT(0, 1); q.CNOT(1, 2)
+    q.H(3); q.CNOT(3, 4); q.CNOT(4, 5)
+    units = {id(s.unit): s.unit for s in q.shards if s.unit is not None}
+    assert len(units) == 2
+    devs = sorted(u.device_id for u in units.values())
+    assert devs == [0, 1]
+    # accounting matches placement
+    assert sorted(d.used_bytes for d in q.devices) == [128, 128]
+
+
+def test_qunitmulti_over_allocation_rejected():
+    """A subsystem no device can hold triggers the alloc guard
+    (reference: src/common/oclengine.cpp:388); QUnit's machinery then
+    either fails fast (fidelity guard active) or degrades to ACE
+    elision instead of letting the runtime OOM (reference: README
+    ACE-on-bad_alloc behavior)."""
+    from qrack_tpu.layers.qunitmulti import DeviceInfo
+
+    def build():
+        table = [DeviceInfo(device_id=0, capacity_bytes=128),
+                 DeviceInfo(device_id=1, capacity_bytes=128)]
+        q = QUnitMulti(6, unit_factory=_rec_factory, rng=QrackRandom(6),
+                       device_table=table, rand_global_phase=False)
+        q.H(0); q.CNOT(0, 1); q.CNOT(1, 2)
+        return q
+
+    # guard active: entangling across clumps would need a 4-qubit unit
+    # (256 bytes) exceeding every per-device budget -> fail fast
+    q = build()
+    with pytest.raises(RuntimeError, match="ACE"):
+        q.CNOT(2, 3)
+
+    # guard disabled: same pressure degrades to ACE elision, fidelity
+    # drops below 1 but the program keeps running
+    q2 = build()
+    q2.is_ace = True
+    q2.CNOT(2, 3)
+    assert q2.GetUnitaryFidelity() < 1.0
+
+
+def test_qunitmulti_weighted_preference():
+    """Capability weights steer placement: the heavier device gets the
+    bigger subsystem when both fit everywhere."""
+    from qrack_tpu.layers.qunitmulti import DeviceInfo
+
+    table = [DeviceInfo(device_id=0, capacity_bytes=1 << 20, weight=1.0),
+             DeviceInfo(device_id=1, capacity_bytes=1 << 20, weight=4.0)]
+    q = QUnitMulti(5, unit_factory=_rec_factory, rng=QrackRandom(7),
+                   device_table=table, rand_global_phase=False)
+    # FSim is non-diagonal 2-qubit: forces real unit merges (CNOT chains
+    # alone stay in the commuting link bag and never materialize units)
+    q.FSim(0.3, 0.2, 0, 1); q.FSim(0.3, 0.2, 1, 2)   # 3-qubit clump
+    q.FSim(0.3, 0.2, 3, 4)                            # 2-qubit clump
+    units = {id(s.unit): s.unit for s in q.shards if s.unit is not None}
+    sizes = {u.qubit_count: u.device_id for u in units.values()}
+    assert sizes[3] == 1     # biggest subsystem -> most capable device
+    assert sizes[2] == 0     # next one spreads to the other device
