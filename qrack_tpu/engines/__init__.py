@@ -1,3 +1,4 @@
 from .qengine import QEngine  # noqa: F401
 from .cpu import QEngineCPU  # noqa: F401
 from .sparse import QEngineSparse  # noqa: F401
+from .turboquant import QEngineTurboQuant  # noqa: F401

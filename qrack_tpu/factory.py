@@ -18,6 +18,7 @@ QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
   "tpu"                QEngineTPU single-device dense engine
   "cpu"                QEngineCPU host oracle
   "sparse"             QEngineSparse map-style sparse state vector
+  "turboquant"         QEngineTurboQuant block-compressed resident ket
 
 create_quantum_interface(layers, n) composes them top-down; OPTIMAL is
 ["unit", "stabilizer_hybrid", "hybrid"] — the reference's production
@@ -31,7 +32,7 @@ OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
 OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
 _TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt",
-             "unit_clifford", "sparse"}
+             "unit_clifford", "sparse", "turboquant"}
 
 
 def _terminal_factory(name: str, **opts) -> Callable:
@@ -63,6 +64,10 @@ def _terminal_factory(name: str, **opts) -> Callable:
         from .engines.sparse import QEngineSparse
 
         return lambda n, **kw: QEngineSparse(n, **{**opts, **kw})
+    if name == "turboquant":
+        from .engines.turboquant import QEngineTurboQuant
+
+        return lambda n, **kw: QEngineTurboQuant(n, **{**opts, **kw})
     if name == "unit_clifford":
         from .layers.qunitclifford import QUnitClifford
 

@@ -1635,7 +1635,7 @@ class QUnit(QInterface):
             meta.append({"qubits": [int(x) for x in qs], "n": int(n)})
             idx += 1
         arrays["meta"] = np.frombuffer(
-            json.dumps({"format": "qunit-turboquant-v1", "bits": bits,
+            json.dumps({"format": "qunit-turboquant-v2", "bits": bits,
                         "qubit_count": self.qubit_count,
                         "factors": meta}).encode(), dtype=np.uint8)
         np.savez_compressed(path, **arrays)
@@ -1651,7 +1651,7 @@ class QUnit(QInterface):
                 self.SetQuantumState(lossy_load(path))  # whole-ket fallback
                 return
             meta = json.loads(bytes(z["meta"]).decode())
-            if meta.get("format") != "qunit-turboquant-v1":
+            if meta.get("format") != "qunit-turboquant-v2":
                 self.SetQuantumState(lossy_load(path))
                 return
             if meta["qubit_count"] != self.qubit_count:

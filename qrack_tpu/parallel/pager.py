@@ -778,7 +778,7 @@ class QPager(QEngine):
             arrays[f"scales_{p}"] = scales
             arrays[f"codes_{p}"] = codes
         arrays["meta"] = np.frombuffer(json.dumps({
-            "format": "qpager-turboquant-v1", "bits": bits,
+            "format": "qpager-turboquant-v2", "bits": bits,
             "qubit_count": self.qubit_count, "n_pages": self.n_pages,
             "page_len": 1 << L, "device_ids": self.GetDeviceList(),
         }).encode(), dtype=np.uint8)
@@ -795,7 +795,7 @@ class QPager(QEngine):
                 self.SetQuantumState(lossy_load(path))  # whole-ket fallback
                 return
             meta = json.loads(bytes(z["meta"]).decode())
-            if meta.get("format") != "qpager-turboquant-v1":
+            if meta.get("format") != "qpager-turboquant-v2":
                 self.SetQuantumState(lossy_load(path))
                 return
             if meta["qubit_count"] != self.qubit_count:
