@@ -1,9 +1,11 @@
-"""QBdt: binary-decision-diagram compressed state vector.
+"""QBdt: binary-decision-diagram compressed state vector, with optional
+attached dense-engine leaves.
 
 Re-design of the reference's QBdt layer (reference: include/qbdt.hpp:37
 — DDSIM-inspired shared-subtree ket, nodes with scale + 2 branches,
 include/qbdt_node_interface.hpp:19-60; traversal GetTraversal/
-SetTraversal include/qbdt.hpp:52-70; branch rounding
+SetTraversal include/qbdt.hpp:52-70; attached dense-engine leaves under
+the tree, include/qbdt.hpp:52-70 Attach machinery; branch rounding
 QRACK_QBDT_SEPARABILITY_THRESHOLD README.md:110).
 
 Implementation: immutable hash-consed nodes (w0, c0, w1, c1) with
@@ -13,6 +15,16 @@ parallel node mutation (_par_for_qbdt) is replaced by pure-functional
 rebuild with per-operation memo tables — idiomatic for a host-side
 combinatorial structure in this framework (the dense math lives on the
 TPU; QBdt is the low-entanglement escape hatch).
+
+Attached leaves (`attached_qubits=k`): the tree covers qubits
+[0, n-k) (index LSBs) and terminates in DENSE 2^k-amplitude leaf
+vectors covering qubits [n-k, n) — the reference's tree-top/ket-bottom
+hybridization inside ONE representation, where QBdtHybrid can only
+switch the whole state between forms.  Leaf vectors are canonicalized
+(divided by their largest-magnitude element) and interned exactly like
+tree nodes, so branches over a shared dense factor store it once.
+`ToEngine`/`FromEngine` traverse to/from a dense engine (reference:
+GetTraversal/SetTraversal).
 
 Depth d of the tree branches on qubit d (root = qubit 0, the index LSB).
 """
@@ -29,15 +41,43 @@ from ..interface import QInterface
 _ROUND = 12  # weight rounding for canonical interning
 
 
+class _EngLeaf:
+    """Interned dense leaf: canonical 2^k complex vector (largest-
+    magnitude element exactly 1) covering the attached qubits."""
+
+    __slots__ = ("vec",)
+
+    def __init__(self, vec: np.ndarray):
+        self.vec = vec
+
+
+def _dense_2x2(vec: np.ndarray, m: np.ndarray, t: int,
+               cmask: int, cval: int) -> np.ndarray:
+    """2x2 gate on local qubit t of a dense little-endian vector, with
+    an optional local control mask."""
+    L = vec.shape[0]
+    low = 1 << t
+    v = vec.reshape(-1, 2, low)
+    n0 = m[0, 0] * v[:, 0, :] + m[0, 1] * v[:, 1, :]
+    n1 = m[1, 0] * v[:, 0, :] + m[1, 1] * v[:, 1, :]
+    out = np.stack([n0, n1], axis=1).reshape(L)
+    if cmask:
+        idx = np.arange(L)
+        keep = (idx & cmask) == cval
+        out = np.where(keep, out, vec)
+    return out
+
+
 class _Tree:
     """Unique-table context for one QBdt instance family."""
 
-    __slots__ = ("table",)
+    __slots__ = ("table", "leaves")
 
     LEAF = ("leaf",)
 
     def __init__(self):
         self.table: Dict[tuple, tuple] = {}
+        self.leaves: Dict[tuple, _EngLeaf] = {}
 
     def node(self, w0: complex, c0, w1: complex, c1) -> Tuple[complex, tuple]:
         """Make a canonical node; returns (norm_weight, node). The
@@ -60,21 +100,52 @@ class _Tree:
             self.table[key] = node
         return c, node
 
+    def eng_leaf(self, vec: np.ndarray) -> Tuple[complex, Optional[_EngLeaf]]:
+        """Canonicalize + intern a dense leaf vector; returns
+        (norm_weight, leaf)."""
+        vec = np.asarray(vec, dtype=np.complex128).reshape(-1)
+        k = int(np.argmax(np.abs(vec)))
+        c = vec[k]
+        if abs(c) <= 1e-14:
+            return 0j, None
+        canon = vec / c
+        key = (vec.shape[0], np.round(canon, _ROUND).tobytes())
+        leaf = self.leaves.get(key)
+        if leaf is None:
+            leaf = _EngLeaf(canon)
+            self.leaves[key] = leaf
+        return c, leaf
+
+
+def _is_term(node) -> bool:
+    return node is _Tree.LEAF or isinstance(node, _EngLeaf)
+
 
 class QBdt(QInterface):
-    def __init__(self, qubit_count: int, init_state: int = 0, **kwargs):
+    def __init__(self, qubit_count: int, init_state: int = 0,
+                 attached_qubits: int = 0, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
+        self.attached_qubits = min(int(attached_qubits), qubit_count)
         self._t = _Tree()
         self.scale: complex = 1.0 + 0j
         self.root = self._basis_node(init_state, 0)
+
+    @property
+    def tree_qubits(self) -> int:
+        return self.qubit_count - self.attached_qubits
 
     # ------------------------------------------------------------------
     # construction helpers
     # ------------------------------------------------------------------
 
     def _basis_node(self, perm: int, depth: int):
-        if depth == self.qubit_count:
-            return _Tree.LEAF
+        if depth == self.tree_qubits:
+            if not self.attached_qubits:
+                return _Tree.LEAF
+            vec = np.zeros(1 << self.attached_qubits, dtype=np.complex128)
+            vec[perm >> self.tree_qubits] = 1.0
+            _, leaf = self._t.eng_leaf(vec)
+            return leaf
         child = self._basis_node(perm, depth + 1)
         if (perm >> depth) & 1:
             _, node = self._t.node(0j, None, 1.0 + 0j, child)
@@ -86,7 +157,7 @@ class QBdt(QInterface):
         seen = set()
 
         def walk(n):
-            if n is None or n is _Tree.LEAF or id(n) in seen:
+            if n is None or _is_term(n) or id(n) in seen:
                 return
             seen.add(id(n))
             walk(n[1])
@@ -94,6 +165,28 @@ class QBdt(QInterface):
 
         walk(self.root)
         return len(seen)
+
+    def footprint_amps(self) -> int:
+        """Stored-amplitude estimate: 2 weights per distinct tree node
+        plus each distinct dense leaf's length — the memory-compression
+        figure of merit for picking a representation."""
+        nodes = set()
+        leaf_sizes: Dict[int, int] = {}
+
+        def walk(n):
+            if n is None or n is _Tree.LEAF:
+                return
+            if isinstance(n, _EngLeaf):
+                leaf_sizes[id(n)] = n.vec.shape[0]
+                return
+            if id(n) in nodes:
+                return
+            nodes.add(id(n))
+            walk(n[1])
+            walk(n[3])
+
+        walk(self.root)
+        return 2 * len(nodes) + sum(leaf_sizes.values())
 
     # ------------------------------------------------------------------
     # core tree algebra
@@ -107,6 +200,8 @@ class QBdt(QInterface):
             return wa, a
         if a is _Tree.LEAF:
             return wa + wb, _Tree.LEAF
+        if isinstance(a, _EngLeaf):
+            return self._t.eng_leaf(wa * a.vec + wb * b.vec)
         key = (id(a), round(wa.real, _ROUND), round(wa.imag, _ROUND),
                id(b), round(wb.real, _ROUND), round(wb.imag, _ROUND))
         hit = memo.get(key)
@@ -118,12 +213,32 @@ class QBdt(QInterface):
         memo[key] = out
         return out
 
+    def _leaf_mask(self, constraints: dict) -> Tuple[int, int]:
+        """Split {depth -> bit} constraints into a leaf-local mask for
+        depths in the attached region."""
+        tq = self.tree_qubits
+        cmask = cval = 0
+        for d, b in constraints.items():
+            if d >= tq:
+                cmask |= 1 << (d - tq)
+                cval |= b << (d - tq)
+        return cmask, cval
+
     def _project_set(self, node, depth: int, constraints: dict, memo) -> Tuple[complex, tuple]:
-        """Project a subtree onto {depth d -> required bit} constraints."""
+        """Project a subtree onto {depth d -> required bit} constraints
+        (constraints may include attached-region depths, applied as a
+        leaf mask)."""
         if node is None:
             return 0j, None
         if node is _Tree.LEAF:
             return 1.0 + 0j, _Tree.LEAF
+        if isinstance(node, _EngLeaf):
+            cmask, cval = self._leaf_mask(constraints)
+            if not cmask:
+                return 1.0 + 0j, node
+            idx = np.arange(node.vec.shape[0])
+            keep = (idx & cmask) == cval
+            return self._t.eng_leaf(np.where(keep, node.vec, 0.0))
         if not any(d >= depth for d in constraints):
             return 1.0 + 0j, node
         key = (id(node), depth)
@@ -148,13 +263,14 @@ class QBdt(QInterface):
 
     def _apply(self, node, depth: int, target: int, m: np.ndarray,
                ctrl_above: dict, ctrl_below: dict, memo) -> Tuple[complex, tuple]:
-        """Apply a 2x2 at `target`; ctrl_above maps control depth (<
-        target) -> required bit; ctrl_below maps control depth (> target)
-        -> required bit (handled by restricted subtree mixing)."""
+        """Apply a 2x2 at tree-region `target`; ctrl_above maps control
+        depth (< target) -> required bit; ctrl_below maps control depth
+        (> target, possibly attached-region) -> required bit (handled by
+        restricted subtree mixing)."""
         if node is None:
             return 0j, None
-        if node is _Tree.LEAF:
-            return 1.0 + 0j, _Tree.LEAF
+        if _is_term(node):
+            return 1.0 + 0j, node
         key = (id(node), depth)
         hit = memo.get(key)
         if hit is not None:
@@ -193,12 +309,58 @@ class QBdt(QInterface):
         memo[key] = out
         return out
 
+    def _apply_leafgate(self, node, depth: int, m: np.ndarray, leaf_target: int,
+                        tree_ctrl: dict, leaf_cmask: int, leaf_cval: int,
+                        memo) -> Tuple[complex, tuple]:
+        """Apply a 2x2 whose target lives in the attached region: walk
+        the tree (respecting tree-region controls), then run the dense
+        kernel inside each reached leaf."""
+        if node is None:
+            return 0j, None
+        if isinstance(node, _EngLeaf):
+            key = (id(node), "leaf")
+            hit = memo.get(key)
+            if hit is None:
+                hit = self._t.eng_leaf(
+                    _dense_2x2(node.vec, m, leaf_target, leaf_cmask, leaf_cval))
+                memo[key] = hit
+            return hit
+        key = (id(node), depth)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        w0, c0, w1, c1 = node
+        if depth in tree_ctrl:
+            want = tree_ctrl[depth]
+            if want == 1:
+                nw1, nn1 = self._apply_leafgate(c1, depth + 1, m, leaf_target,
+                                                tree_ctrl, leaf_cmask, leaf_cval, memo)
+                out = self._t.node(w0, c0, w1 * nw1, nn1)
+            else:
+                nw0, nn0 = self._apply_leafgate(c0, depth + 1, m, leaf_target,
+                                                tree_ctrl, leaf_cmask, leaf_cval, memo)
+                out = self._t.node(w0 * nw0, nn0, w1, c1)
+        else:
+            nw0, nn0 = self._apply_leafgate(c0, depth + 1, m, leaf_target,
+                                            tree_ctrl, leaf_cmask, leaf_cval, memo)
+            nw1, nn1 = self._apply_leafgate(c1, depth + 1, m, leaf_target,
+                                            tree_ctrl, leaf_cmask, leaf_cval, memo)
+            out = self._t.node(w0 * nw0, nn0, w1 * nw1, nn1)
+        memo[key] = out
+        return out
+
     def _prob_node(self, node, memo) -> float:
-        """Squared norm of a subtree (children assumed normalized)."""
+        """Squared norm of a subtree (children assumed canonical)."""
         if node is None:
             return 0.0
         if node is _Tree.LEAF:
             return 1.0
+        if isinstance(node, _EngLeaf):
+            hit = memo.get(id(node))
+            if hit is None:
+                hit = float(np.sum(np.abs(node.vec) ** 2))
+                memo[id(node)] = hit
+            return hit
         hit = memo.get(id(node))
         if hit is not None:
             return hit
@@ -214,6 +376,12 @@ class QBdt(QInterface):
             return 0.0, 0.0
         if node is _Tree.LEAF:
             return 1.0, 0.0  # unreachable for valid target
+        if isinstance(node, _EngLeaf):
+            lt = target - self.tree_qubits
+            idx = np.arange(node.vec.shape[0])
+            p = np.abs(node.vec) ** 2
+            bit = (idx >> lt) & 1
+            return float(p[bit == 0].sum()), float(p[bit == 1].sum())
         key = (id(node), depth)
         hit = memo.get(key)
         if hit is not None:
@@ -235,6 +403,11 @@ class QBdt(QInterface):
             return 0j, None
         if node is _Tree.LEAF:
             return 1.0 + 0j, _Tree.LEAF
+        if isinstance(node, _EngLeaf):
+            lt = target - self.tree_qubits
+            idx = np.arange(node.vec.shape[0])
+            match = ((idx >> lt) & 1) == keep
+            return self._t.eng_leaf(np.where(match, node.vec, 0.0))
         key = (id(node), depth)
         hit = memo.get(key)
         if hit is not None:
@@ -259,12 +432,29 @@ class QBdt(QInterface):
     def MCMtrxPerm(self, controls, mtrx, target, perm) -> None:
         self._check_qubit(target)
         m = np.asarray(mtrx, dtype=np.complex128).reshape(2, 2)
-        ctrl_above = {}
-        ctrl_below = {}
+        tq = self.tree_qubits
+        tree_ctrl = {}
+        leaf_cmask = leaf_cval = 0
         for j, c in enumerate(controls):
             self._check_qubit(c)
-            (ctrl_above if c < target else ctrl_below)[c] = (perm >> j) & 1
-        w, root = self._apply(self.root, 0, target, m, ctrl_above, ctrl_below, {})
+            bit = (perm >> j) & 1
+            if c < tq:
+                tree_ctrl[c] = bit
+            else:
+                leaf_cmask |= 1 << (c - tq)
+                leaf_cval |= bit << (c - tq)
+        if target >= tq:
+            w, root = self._apply_leafgate(self.root, 0, m, target - tq,
+                                           tree_ctrl, leaf_cmask, leaf_cval, {})
+        else:
+            ctrl_above = {d: b for d, b in tree_ctrl.items() if d < target}
+            ctrl_below = {d: b for d, b in tree_ctrl.items() if d > target}
+            # attached-region controls are always "below" any tree target
+            for lb in range(self.attached_qubits):
+                if (leaf_cmask >> lb) & 1:
+                    ctrl_below[tq + lb] = (leaf_cval >> lb) & 1
+            w, root = self._apply(self.root, 0, target, m, ctrl_above,
+                                  ctrl_below, {})
         self.scale *= w
         self.root = root
         self._maybe_gc()
@@ -281,7 +471,43 @@ class QBdt(QInterface):
 
     def Prob(self, q: int) -> float:
         self._check_qubit(q)
+        tq = self.tree_qubits
+        if q >= tq:
+            # weight-average the per-leaf marginals over tree paths
+            return self._prob_leaf_qubit(q)
         p0, p1 = self._prob_target(self.root, 0, q, {}, {})
+        tot = p0 + p1
+        return p1 / tot if tot > 0 else 0.0
+
+    def _prob_leaf_qubit(self, q: int) -> float:
+        lt = q - self.tree_qubits
+        memo_w: Dict[int, Tuple[float, float]] = {}
+
+        def split(node) -> Tuple[float, float]:
+            """(P(bit=0), P(bit=1)) contribution of a canonical subtree."""
+            if node is None:
+                return 0.0, 0.0
+            if isinstance(node, _EngLeaf):
+                hit = memo_w.get(id(node))
+                if hit is None:
+                    idx = np.arange(node.vec.shape[0])
+                    p = np.abs(node.vec) ** 2
+                    bit = (idx >> lt) & 1
+                    hit = (float(p[bit == 0].sum()), float(p[bit == 1].sum()))
+                    memo_w[id(node)] = hit
+                return hit
+            hit = memo_w.get(id(node))
+            if hit is not None:
+                return hit
+            w0, c0, w1, c1 = node
+            a = split(c0)
+            b = split(c1)
+            out = ((abs(w0) ** 2) * a[0] + (abs(w1) ** 2) * b[0],
+                   (abs(w0) ** 2) * a[1] + (abs(w1) ** 2) * b[1])
+            memo_w[id(node)] = out
+            return out
+
+        p0, p1 = split(self.root)
         tot = p0 + p1
         return p1 / tot if tot > 0 else 0.0
 
@@ -311,17 +537,22 @@ class QBdt(QInterface):
         amp = self.scale
         node = self.root
         depth = 0
-        while node is not _Tree.LEAF:
+        while not _is_term(node):
             if node is None:
                 return 0j
             bit = (perm >> depth) & 1
             amp *= node[2] if bit else node[0]
             node = node[3] if bit else node[1]
             depth += 1
+        if node is None:
+            return 0j
+        if isinstance(node, _EngLeaf):
+            amp *= node.vec[perm >> self.tree_qubits]
         return complex(amp)
 
     def GetQuantumState(self) -> np.ndarray:
         n = self.qubit_count
+        tq = self.tree_qubits
         out = np.zeros(1 << n, dtype=np.complex128)
 
         def walk(node, depth, idx, amp):
@@ -329,6 +560,10 @@ class QBdt(QInterface):
                 return
             if node is _Tree.LEAF:
                 out[idx] = amp
+                return
+            if isinstance(node, _EngLeaf):
+                L = node.vec.shape[0]
+                out[idx + (np.arange(L) << tq)] += amp * node.vec
                 return
             walk(node[1], depth + 1, idx, amp * node[0])
             walk(node[3], depth + 1, idx | (1 << depth), amp * node[2])
@@ -341,19 +576,22 @@ class QBdt(QInterface):
         if state.shape[0] != (1 << self.qubit_count):
             raise ValueError("state length mismatch")
         self._t = _Tree()
+        tq = self.tree_qubits
 
-        def build(vec):
-            """Bottom-up: vec indexed little-endian over remaining qubits."""
-            if vec.shape[0] == 1:
-                a = complex(vec[0])
-                return (a, _Tree.LEAF) if abs(a) > 1e-14 else (0j, None)
-            half = vec.shape[0] // 2
-            # qubit at this depth is the LSB of the index
-            w0, c0 = build(vec[0::2])
-            w1, c1 = build(vec[1::2])
+        def build(vec, depth):
+            """Top-down split on the qubit at `depth` (index LSB of the
+            remaining strided view); dense leaf once the attached region
+            is reached."""
+            if depth == tq:
+                if not self.attached_qubits:
+                    a = complex(vec[0])
+                    return (a, _Tree.LEAF) if abs(a) > 1e-14 else (0j, None)
+                return self._t.eng_leaf(vec)
+            w0, c0 = build(vec[0::2], depth + 1)
+            w1, c1 = build(vec[1::2], depth + 1)
             return self._t.node(w0, c0, w1, c1)
 
-        w, root = build(state)
+        w, root = build(state, 0)
         self.scale = w
         self.root = root
 
@@ -368,14 +606,42 @@ class QBdt(QInterface):
         self.scale = ph
         self.root = self._basis_node(perm, 0)
 
+    # ------------------------------------------------------------------
+    # traversal to/from dense engines (reference: GetTraversal/
+    # SetTraversal, include/qbdt.hpp:52-70)
+    # ------------------------------------------------------------------
+
+    def ToEngine(self, engine_factory=None):
+        """Materialize the tree(+leaves) into a dense engine; defaults
+        to the TPU engine."""
+        if engine_factory is None:
+            from ..engines.tpu import QEngineTPU
+
+            def engine_factory(n, **kw):
+                return QEngineTPU(n, **kw)
+
+        eng = engine_factory(self.qubit_count, rng=self.rng.spawn(),
+                             rand_global_phase=False)
+        eng.SetQuantumState(self.GetQuantumState())
+        return eng
+
+    @classmethod
+    def FromEngine(cls, eng, attached_qubits: int = 0, **kwargs):
+        """Build a (tree-top, dense-bottom) representation from any
+        engine's ket."""
+        q = cls(eng.GetQubitCount(), attached_qubits=attached_qubits,
+                **kwargs)
+        q.SetQuantumState(np.asarray(eng.GetQuantumState()))
+        return q
+
     def Compose(self, other: "QBdt", start=None) -> int:
         if start is None:
             start = self.qubit_count
         if start != self.qubit_count:
             raise NotImplementedError("mid-insertion Compose on QBdt")
-        # graft: replace every LEAF of self with other's root
         o = other if isinstance(other, QBdt) else None
-        if o is not None:
+        if o is not None and not self.attached_qubits and not o.attached_qubits:
+            # graft: replace every LEAF of self with other's root
             graft_scale, graft_root = self._graft_import(o)
             memo = {}
 
@@ -394,13 +660,12 @@ class QBdt(QInterface):
 
             self.root = splice(self.root)
             self.scale *= graft_scale
-        else:
-            other_state = np.asarray(other.GetQuantumState())
-            combined = np.kron(other_state, self.GetQuantumState())
-            self.qubit_count += int(np.log2(len(other_state)))
-            self.SetQuantumState(combined)
+            self.qubit_count += other.qubit_count
             return start
-        self.qubit_count += other.qubit_count
+        other_state = np.asarray(other.GetQuantumState())
+        combined = np.kron(other_state, self.GetQuantumState())
+        self.qubit_count += int(np.log2(len(other_state)))
+        self.SetQuantumState(combined)
         return start
 
     def _graft_import(self, other: "QBdt"):
@@ -410,6 +675,9 @@ class QBdt(QInterface):
         def imp(node):
             if node is None or node is _Tree.LEAF:
                 return node
+            if isinstance(node, _EngLeaf):
+                _, out = self._t.eng_leaf(node.vec)
+                return out
             hit = memo.get(id(node))
             if hit is not None:
                 return hit
@@ -431,6 +699,7 @@ class QBdt(QInterface):
         tmp_dest = QEngineCPU(length, rng=self.rng.spawn(), rand_global_phase=False)
         tmp.Decompose(start, tmp_dest)
         self.qubit_count = n - length
+        self.attached_qubits = min(self.attached_qubits, self.qubit_count)
         self.SetQuantumState(tmp.GetQuantumState())
         dest.SetQuantumState(tmp_dest.GetQuantumState())
 
@@ -442,6 +711,7 @@ class QBdt(QInterface):
         tmp.SetQuantumState(self.GetQuantumState())
         tmp.Dispose(start, length, disposed_perm)
         self.qubit_count = n - length
+        self.attached_qubits = min(self.attached_qubits, self.qubit_count)
         self.SetQuantumState(tmp.GetQuantumState())
 
     def Allocate(self, start: int, length: int = 1) -> int:
@@ -452,7 +722,8 @@ class QBdt(QInterface):
         return start
 
     def Clone(self) -> "QBdt":
-        c = QBdt(self.qubit_count, rng=self.rng.spawn(),
+        c = QBdt(self.qubit_count, attached_qubits=self.attached_qubits,
+                 rng=self.rng.spawn(),
                  rand_global_phase=self.rand_global_phase)
         c._t = self._t  # shared unique table: trees are immutable
         c.scale = self.scale
@@ -474,13 +745,16 @@ class QBdt(QInterface):
 
     def _maybe_gc(self) -> None:
         # periodically rebuild the unique table to drop unreachable nodes
-        if len(self._t.table) > 1 << 18:
+        if len(self._t.table) + len(self._t.leaves) > 1 << 18:
             fresh = _Tree()
             memo = {}
 
             def rebuild(node):
                 if node is None or node is _Tree.LEAF:
                     return node
+                if isinstance(node, _EngLeaf):
+                    _, out = fresh.eng_leaf(node.vec)
+                    return out
                 hit = memo.get(id(node))
                 if hit is not None:
                     return hit

@@ -135,3 +135,120 @@ def test_bdt_through_factory():
     q = create_quantum_interface(["bdt_hybrid", "cpu"], 3, rng=QrackRandom(7))
     before, after = algo.teleport(q, prepare=lambda s: s.U(0, 0.8, 0.3, -0.5))
     assert abs(after - before) < 1e-5
+
+
+# ---------------- attached dense-engine leaves ----------------
+# (reference: tree-top over dense-engine leaves inside one ket,
+#  include/qbdt.hpp:52-70 GetTraversal/SetTraversal + Attach)
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_attached_leaves_random_circuits(seed):
+    """Same random battery, tree-top + dense-bottom representation."""
+    n, att = 6, 3
+    o = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+    q = QBdt(n, attached_qubits=att, rng=QrackRandom(seed),
+             rand_global_phase=False)
+    random_circuit(o, QrackRandom(300 + seed), 40, n)
+    random_circuit(q, QrackRandom(300 + seed), 40, n)
+    got = align_phase(q.GetQuantumState(), o.GetQuantumState())
+    np.testing.assert_allclose(got, o.GetQuantumState(), atol=1e-8)
+
+
+def test_attached_leaves_cross_region_gates():
+    """Every control/target placement across the tree/leaf boundary."""
+    n, att = 5, 2   # tree qubits 0-2, leaf qubits 3-4
+    for ctrl, tgt in [(0, 4), (4, 0), (3, 4), (4, 3), (1, 2), (2, 3)]:
+        o = QEngineCPU(n, rng=QrackRandom(7), rand_global_phase=False)
+        q = QBdt(n, attached_qubits=att, rng=QrackRandom(7),
+                 rand_global_phase=False)
+        for e in (o, q):
+            for i in range(n):
+                e.H(i)
+            e.T(ctrl)
+            e.CNOT(ctrl, tgt)
+            e.CZ(ctrl, tgt)
+            e.RY(0.7, tgt)
+        got = align_phase(q.GetQuantumState(), o.GetQuantumState())
+        np.testing.assert_allclose(got, o.GetQuantumState(), atol=1e-8,
+                                   err_msg=f"ctrl={ctrl} tgt={tgt}")
+
+
+def test_attached_leaves_measurement():
+    n, att = 6, 3
+    q = QBdt(n, attached_qubits=att, rng=QrackRandom(11),
+             rand_global_phase=False)
+    q.H(0)
+    q.CNOT(0, 5)      # entangle tree qubit with leaf qubit
+    assert q.Prob(5) == pytest.approx(0.5, abs=1e-9)
+    r = q.ForceM(5, True)
+    assert r is True
+    assert q.Prob(0) == pytest.approx(1.0, abs=1e-9)
+    # leaf-region measurement collapsed the tree side too
+    assert q.Prob(5) == pytest.approx(1.0, abs=1e-9)
+
+
+def test_attached_beats_both_pure_forms():
+    """GHZ over the low qubits tensor a RANDOM dense factor on the high
+    qubits.  Tree-top + dense-bottom beats the pure dense ket on
+    FOOTPRINT (a handful of nodes + one shared 2^k leaf vs 2^n
+    amplitudes) and beats the pure tree on GATE TIME in the dense
+    region (one vectorized kernel on the shared leaf vs a per-node
+    Python recursion over ~2^k weight nodes) — the reason the reference
+    hybridizes inside one representation instead of switching wholesale
+    (include/qbdt.hpp:37-70)."""
+    import time
+
+    rng = np.random.Generator(np.random.PCG64(42))
+    k, low = 8, 4
+    n = low + k
+    dense = rng.standard_normal(1 << k) + 1j * rng.standard_normal(1 << k)
+    dense /= np.linalg.norm(dense)
+    ghz = np.zeros(1 << low, np.complex128)
+    ghz[0] = ghz[-1] = 1 / np.sqrt(2)
+    full = np.kron(dense, ghz)   # high bits = dense factor
+
+    hybrid = QBdt(n, attached_qubits=k, rng=QrackRandom(1),
+                  rand_global_phase=False)
+    hybrid.SetQuantumState(full)
+    pure_tree = QBdt(n, rng=QrackRandom(2), rand_global_phase=False)
+    pure_tree.SetQuantumState(full)
+
+    # footprint: far below the dense ket's 2^n amplitudes
+    assert hybrid.footprint_amps() < (1 << n) / 8
+    # the dense factor is ONE shared leaf across both GHZ branches
+    assert len({id(l) for l in hybrid._t.leaves.values()}) == 1
+
+    def burst(q):
+        t0 = time.perf_counter()
+        for rep in range(3):
+            for tq in range(low, n):     # gates in the dense region
+                q.RY(0.1 + 0.01 * tq, tq)
+                q.T(tq)
+        return time.perf_counter() - t0
+
+    t_tree = burst(pure_tree)
+    t_hybrid = burst(hybrid)
+    # vectorized leaf kernels vs per-node recursion: demand a clear win
+    # (observed ~10x+; 2x margin keeps the test robust on loaded CI)
+    assert t_hybrid < t_tree / 2, (t_hybrid, t_tree)
+
+    # and both are still exact
+    got = align_phase(hybrid.GetQuantumState(), pure_tree.GetQuantumState())
+    np.testing.assert_allclose(got, pure_tree.GetQuantumState(), atol=1e-8)
+
+
+def test_traversal_to_from_engine():
+    """ToEngine/FromEngine roundtrip through the dense TPU engine
+    (reference: GetTraversal/SetTraversal)."""
+    n, att = 6, 2
+    q = QBdt(n, attached_qubits=att, rng=QrackRandom(13),
+             rand_global_phase=False)
+    random_circuit(q, QrackRandom(14), 25, n)
+    ref = q.GetQuantumState()
+    eng = q.ToEngine()
+    assert type(eng).__name__ == "QEngineTPU"
+    back = QBdt.FromEngine(eng, attached_qubits=att, rng=QrackRandom(15),
+                           rand_global_phase=False)
+    got = align_phase(np.asarray(back.GetQuantumState()), ref)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
