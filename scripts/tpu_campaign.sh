@@ -1,13 +1,22 @@
 #!/bin/bash
-# One-shot TPU evidence campaign. Run when scripts/tpu_probe.py passes.
-# Every stage is a watchdogged child; output accumulates in bench_out/.
-# Order matters: timing honesty first (nothing else is quotable until
-# it passes), then sweeps, then mode A/Bs, then threshold tuning.
+# Checkpointed TPU evidence campaign, engineered for SHORT healthy
+# windows (observed: ~5 min, docs/TPU_EVIDENCE.md):
+#   * the fastest quotable number runs FIRST (w20/w22 stage-fused QFT,
+#     devget sync) — no long honesty/tuning stage may eat the window
+#   * every stage appends its JSON evidence to docs/tpu_results.jsonl
+#     and git-commits it IMMEDIATELY, so a mid-window wedge keeps every
+#     result already produced
+#   * all children share the persistent XLA compile cache (.xla_cache),
+#     so re-entering the campaign in a later window skips recompiles
+#   * two consecutive evidence-free stages abort the run (the window
+#     closed) and hand control back to the watcher's probe loop
+# Invoked by scripts/tpu_watch.sh on the first healthy probe; prints its
+# log path on stdout (the watcher greps it for CAMPAIGN DONE +
+# TIMING_PROBE_OK).
 set -u
 cd "$(dirname "$0")/.."
-# Resolve an interpreter that actually has jax (container images differ),
-# then shim it onto PATH so every `python` below (incl. under `timeout`)
-# resolves to it.
+# Resolve an interpreter that actually has jax, then shim it onto PATH
+# so every `python` below resolves to it.
 PY="${PYTHON:-}"
 if [ -z "$PY" ]; then
   for cand in python /opt/venv/bin/python python3; do
@@ -17,80 +26,174 @@ if [ -z "$PY" ]; then
   done
 fi
 [ -n "$PY" ] || { echo "no python with jax found" >&2; exit 1; }
-PY="$(command -v "$PY")"   # absolute path — a bare name would make the
-                           # shim symlink below self-referential
+PY="$(command -v "$PY")"
+# exec wrapper, NOT a symlink: a symlinked venv python loses its
+# pyvenv.cfg-relative prefix and cannot import jax (verified — the
+# round-3/4 campaign would have crashed at the probe on a healthy
+# window because of this)
 SHIM="$(mktemp -d)"
-ln -s "$PY" "$SHIM/python"
+printf '#!/bin/sh\nexec "%s" "$@"\n' "$PY" > "$SHIM/python"
+chmod +x "$SHIM/python"
 export PATH="$SHIM:$PATH"
-mkdir -p bench_out
-LOG=bench_out/campaign_$(date +%d%H%M%S).log
+
+mkdir -p bench_out docs
+STAMP=$(date +%d%H%M%S)
+LOG=bench_out/campaign_${STAMP}.log
+EVID=docs/tpu_results.jsonl
+ELOG=docs/tpu_campaign_log.txt
+FAILS=0
+
+note() { echo "[$(date +%H:%M:%S)] $*" >> "$LOG"; }
+
+commit_evidence() {
+  # path-limited commit: never sweeps up the builder's working tree
+  git add -- "$EVID" "$ELOG" >> "$LOG" 2>&1 || true
+  git commit -q -m "TPU evidence: $1" -- "$EVID" "$ELOG" >> "$LOG" 2>&1 \
+    || note "commit for $1: nothing new"
+}
+
+append_evidence() {  # stage_name stage_out_file
+  # stamp each bench JSON line with ts+stage and append to the committed
+  # evidence file (plain-python helper; PYTHONPATH stripped so the axon
+  # sitecustomize can never hang a bookkeeping step)
+  env -u PYTHONPATH "$PY" - "$1" "$2" >> "$EVID" <<'EOF'
+import json, sys
+from datetime import datetime, timezone
+name, out = sys.argv[1], sys.argv[2]
+ts = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+for ln in open(out, errors="replace"):
+    ln = ln.strip()
+    if ln.startswith('{"metric"'):
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        d["ts"], d["stage"] = ts, name
+        print(json.dumps(d))
+EOF
+}
+
+run_stage() {  # name timeout_s command...
+  local name=$1 tmo=$2; shift 2
+  [ "$FAILS" -ge 2 ] && { note "skip $name (window closed)"; return 1; }
+  note "=== stage $name (timeout ${tmo}s) ==="
+  local out=bench_out/stage_${STAMP}_${name}.out
+  timeout --signal=TERM --kill-after=20 "$tmo" "$@" > "$out" 2>&1
+  local rc=$?
+  cat "$out" >> "$LOG"
+  {
+    echo "### stage $name @ $(date -u +%FT%TZ) rc=$rc"
+    grep -E '^\{"metric"|_OK$|^HONEST|^devget_empty|^chain|^one_apply|^total_prob|^k1_|^warm ok|passed|^THRESH|^GATE' "$out"
+  } >> "$ELOG"
+  append_evidence "$name" "$out"
+  # success = real evidence lines, or an all-green pytest stage (rc==0
+  # guards against 'N failed, M passed' matching on the substring)
+  if grep -qE '^\{"metric"|_OK$' "$out" \
+      || { [ "$rc" -eq 0 ] && grep -q ' passed' "$out" \
+           && ! grep -q 'failed' "$out"; }; then
+    FAILS=0
+    commit_evidence "$name"
+    note "stage $name OK (rc=$rc)"
+    return 0
+  fi
+  FAILS=$((FAILS + 1))
+  commit_evidence "$name (no evidence, rc=$rc)"
+  note "stage $name produced no evidence (rc=$rc, fails=$FAILS)"
+  return 1
+}
+
 {
-  echo "=== 0) health ==="
-  timeout 120 python scripts/tpu_probe.py || exit 1
+  echo "campaign $STAMP start $(date -u +%FT%TZ)"
+} >> "$LOG"
 
-  echo "=== 1) timing honesty (w20, w22) ==="
-  timeout 900 python scripts/tpu_timing_probe.py 20
-  timeout 900 python scripts/tpu_timing_probe.py 22
+# 0) health (cheap; the watcher already probed, this guards stale fires)
+if ! timeout --signal=TERM --kill-after=15 90 python scripts/tpu_probe.py \
+    >> "$LOG" 2>&1; then
+  note "probe failed — aborting"
+  echo "$LOG"
+  exit 1
+fi
 
-  echo "=== 2) qft sweep 20:26 (stage-fused programs) ==="
-  QRACK_BENCH=qft QRACK_BENCH_SWEEP=20:26 QRACK_BENCH_QB=26 \
-    QRACK_BENCH_BUDGET=3000 timeout 3060 python bench.py
+# ---- minutes 0-5: the quotable numbers ----------------------------------
+run_stage qft_w20 300 env QRACK_BENCH=qft QRACK_BENCH_QB=20 \
+  QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=280 python bench.py
+run_stage qft_w22 300 env QRACK_BENCH=qft QRACK_BENCH_QB=22 \
+  QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=280 python bench.py
 
-  echo "=== 2b) wide single-chip qft (w28; carried-fraction program) ==="
-  QRACK_BENCH=qft QRACK_BENCH_QB=28 QRACK_BENCH_QB_FIRST=28 \
-    QRACK_BENCH_SAMPLES=3 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+# ---- timing honesty (validates the devget methodology on-chip; cache
+#      already warm for w22 from the stage above) -------------------------
+run_stage timing_w22 260 python scripts/tpu_timing_probe.py 22
 
-  echo "=== 2c) hbm-limit single-chip qft (w30; 8.6 GB ket, roofline regime) ==="
-  QRACK_BENCH=qft QRACK_BENCH_QB=30 QRACK_BENCH_QB_FIRST=30 \
-    QRACK_BENCH_SAMPLES=3 QRACK_BENCH_BUDGET=900 timeout 960 python bench.py
+# ---- width sweep upward; each width is its own checkpoint ---------------
+run_stage qft_w24 360 env QRACK_BENCH=qft QRACK_BENCH_QB=24 \
+  QRACK_BENCH_QB_FIRST=24 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=330 python bench.py
+run_stage qft_w26 360 env QRACK_BENCH=qft QRACK_BENCH_QB=26 \
+  QRACK_BENCH_QB_FIRST=26 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=330 python bench.py
+run_stage rcs_w22 360 env QRACK_BENCH=rcs QRACK_BENCH_QB=22 \
+  QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=330 python bench.py
+run_stage qft_w28 430 env QRACK_BENCH=qft QRACK_BENCH_QB=28 \
+  QRACK_BENCH_QB_FIRST=28 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=400 python bench.py
+run_stage bf16_w24 300 env QRACK_BENCH=qft QRACK_BENCH_DTYPE=bfloat16 \
+  QRACK_BENCH_QB=24 QRACK_BENCH_QB_FIRST=24 QRACK_BENCH_SAMPLES=3 \
+  QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=280 python bench.py
 
-  echo "=== 2d) wide rcs (w28) ==="
-  QRACK_BENCH=rcs QRACK_BENCH_QB=28 QRACK_BENCH_QB_FIRST=28 \
-    QRACK_BENCH_SAMPLES=3 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+# ---- A/Bs and depth (each still a separate committed checkpoint) --------
+run_stage pallas_xla_w22 300 env QRACK_USE_PALLAS=0 QRACK_BENCH_SUFFIX=_xla \
+  QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=280 \
+  python bench.py
+run_stage pallas_on_w22 300 env QRACK_USE_PALLAS=1 QRACK_BENCH_SUFFIX=_pallas \
+  QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=280 \
+  python bench.py
+run_stage pallas_xla_w26 300 env QRACK_USE_PALLAS=0 QRACK_BENCH_SUFFIX=_xla \
+  QRACK_BENCH=qft QRACK_BENCH_QB=26 QRACK_BENCH_QB_FIRST=26 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=280 \
+  python bench.py
+run_stage pallas_on_w26 300 env QRACK_USE_PALLAS=1 QRACK_BENCH_SUFFIX=_pallas \
+  QRACK_BENCH=qft QRACK_BENCH_QB=26 QRACK_BENCH_QB_FIRST=26 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=280 \
+  python bench.py
+run_stage grover_w20 360 env QRACK_BENCH=grover QRACK_BENCH_QB=20 \
+  QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=330 python bench.py
+run_stage xeb_w22 300 env QRACK_BENCH=xeb QRACK_BENCH_QB=22 \
+  QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=280 python bench.py
 
-  echo "=== 3) bf16 w24 ==="
-  QRACK_BENCH=qft QRACK_BENCH_DTYPE=bfloat16 QRACK_BENCH_QB=24 \
-    QRACK_BENCH_QB_FIRST=24 QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+# ---- per-gate microbench + hbm-limit width ------------------------------
+run_stage microbench_w22 480 python scripts/microbench.py 22 8
+run_stage qft_w30 620 env QRACK_BENCH=qft QRACK_BENCH_QB=30 \
+  QRACK_BENCH_QB_FIRST=30 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=580 python bench.py
 
-  echo "=== 4) rcs + xeb w22 ==="
-  QRACK_BENCH=rcs QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=20 \
-    QRACK_BENCH_BUDGET=900 timeout 960 python bench.py
-  QRACK_BENCH=xeb QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
-    QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
+# ---- tuning, trace, parity (long tail; all prior evidence is committed) -
+run_stage tuner 900 python scripts/tune_threshold.py
+run_stage profile_w22 480 env QRACK_BENCH_PROFILE=bench_out/xplane \
+  QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=420 \
+  python bench.py
+if [ -d bench_out/xplane ]; then
+  { echo "### xplane analysis @ $(date -u +%FT%TZ)";
+    timeout 240 env -u PYTHONPATH JAX_PLATFORMS=cpu \
+      "$PY" scripts/analyze_xplane.py bench_out/xplane; } >> "$ELOG" 2>&1
+  commit_evidence "xplane analysis"
+fi
+run_stage parity_test 300 python -m pytest tests/test_tpu_device.py -q
 
-  echo "=== 4b) rcs cluster-fusion A/B (w20, k=1 vs default k=6) ==="
-  QRACK_RCS_FUSE_QB=1 QRACK_BENCH_SUFFIX=_fuse1 QRACK_BENCH=rcs \
-    QRACK_BENCH_QB=20 QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_BUDGET=420 \
-    timeout 480 python bench.py
-
-  echo "=== 4c) grover w20 (fori_loop program; baseline rows w16-20) ==="
-  QRACK_BENCH=grover QRACK_BENCH_QB=20 QRACK_BENCH_QB_FIRST=16 \
-    QRACK_BENCH_BUDGET=600 timeout 660 python bench.py
-
-  echo "=== 5) pallas native A/B (w22, then w26 — the widths where HBM traffic dominates) ==="
-  QRACK_USE_PALLAS=0 QRACK_BENCH_SUFFIX=_xla QRACK_BENCH=qft QRACK_BENCH_QB=22 \
-    QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
-  QRACK_USE_PALLAS=1 QRACK_BENCH_SUFFIX=_pallas QRACK_BENCH=qft QRACK_BENCH_QB=22 \
-    QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
-  QRACK_USE_PALLAS=0 QRACK_BENCH_SUFFIX=_xla QRACK_BENCH=qft QRACK_BENCH_QB=26 \
-    QRACK_BENCH_QB_FIRST=26 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
-  QRACK_USE_PALLAS=1 QRACK_BENCH_SUFFIX=_pallas QRACK_BENCH=qft QRACK_BENCH_QB=26 \
-    QRACK_BENCH_QB_FIRST=26 QRACK_BENCH_BUDGET=420 timeout 480 python bench.py
-
-  echo "=== 5b) per-gate microbench (w22) ==="
-  timeout 480 python scripts/microbench.py 22 8
-
-  echo "=== 6) device parity test ==="
-  timeout 300 python -m pytest tests/test_tpu_device.py -q
-
-  echo "=== 7) qhybrid threshold sweep ==="
-  timeout 900 python scripts/tune_threshold.py
-
-  echo "=== 8) profiler trace (w22) ==="
-  QRACK_BENCH_PROFILE=bench_out/xplane QRACK_BENCH=qft QRACK_BENCH_QB=22 \
-    QRACK_BENCH_PLATFORM="" QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_BUDGET=420 \
-    timeout 480 python bench.py
-
-  echo "=== CAMPAIGN DONE ==="
-} > "$LOG" 2>&1
+# a window-closed abort must NOT print the DONE marker: the watcher
+# greps for it to decide whether to exit permanently, and the skipped
+# stages deserve a retry in the next healthy window
+if [ "$FAILS" -ge 2 ]; then
+  note "campaign aborted with skipped stages (fails=$FAILS) — watcher continues"
+  echo "$LOG"
+  exit 1
+fi
+echo "=== CAMPAIGN DONE ===" >> "$LOG"
 echo "$LOG"
