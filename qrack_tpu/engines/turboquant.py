@@ -22,6 +22,13 @@ TPU-first mapping:
   decompress-operate-recompress, scaled to batches the MXU likes).
   Targets above the chunk boundary pair chunks the way QPager pairs
   pages (parallel/pager.py), mixing two decompressed chunks.
+* The chunk axis is a `lax.map` dimension INSIDE one cached jitted
+  program per gate family: a gate is O(1) dispatches and one in-place
+  donated update of the resident code array regardless of chunk count,
+  while the loop body keeps the decompressed working set at one (or
+  one pair of) chunk(s).  Index math inside the loop is split
+  (chunk_id, local_index) int32 pairs — exact past 31 qubits without
+  int64, the same scheme as QPager's (page, local) masks.
 * Normalization never touches codes: dequantization is linear in the
   per-block scales, so _k_normalize is a pure scale multiply.
 * Untouched chunks (failed high-bit control tests) keep their exact
@@ -53,21 +60,44 @@ from .tpu import QEngineTPU
 # module-level jitted programs (shape-polymorphic via jit cache)
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _j_dec_rows(codes, scales, rot_t, qmax):
-    """codes (B, 2D) -> original-space rows (B, 2D)."""
+# compiled chunked-gate programs, keyed on (kind, layout, gate statics) —
+# the same cached-builder discipline as parallel/pager.py's _PROGRAMS
+_PROGRAMS: dict = {}
+
+
+def _program(key, builder):
+    fn = _PROGRAMS.get(key)
+    if fn is None:
+        fn = builder()
+        _PROGRAMS[key] = fn
+    return fn
+
+
+def _dec_rows_f(codes, scales, rot_t, qmax):
+    """Decompress codes (B, 2D) -> original-space rows (trace-safe:
+    composes inside lax.map bodies as well as under plain jit)."""
     y = codes.astype(jnp.float32) * (scales / qmax)[:, None]
     return y @ rot_t
 
 
-@jax.jit
-def _j_comp_rows(rows, rot, qmax_i):
-    """original-space rows (B, 2D) -> (codes, scales)."""
+def _comp_rows_f(rows, rot, qmax, code_dtype):
+    """Recompress original-space rows (B, 2D) -> (codes, scales)."""
     y = rows @ rot
     scales = jnp.max(jnp.abs(y), axis=1)
     safe = jnp.where(scales > 0, scales, 1.0)
-    codes = jnp.round(y / safe[:, None] * qmax_i)
+    codes = jnp.round(y / safe[:, None] * qmax).astype(code_dtype)
     return codes, scales
+
+
+_j_dec_rows = jax.jit(_dec_rows_f)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _j_comp_full(rows, rot, qmax, code_dtype_name):
+    return _comp_rows_f(rows, rot, qmax, jnp.dtype(code_dtype_name))
 
 
 def _rows_to_planes(rows, block: int):
@@ -80,8 +110,7 @@ def _planes_to_rows(planes, block: int):
     return planes.reshape(2, b, block).transpose(1, 0, 2).reshape(b, 2 * block)
 
 
-@jax.jit
-def _j_pair_mix(a, b, mp, lo_cmask, lo_cval):
+def _pair_mix_f(a, b, mp, lo_cmask, lo_cval):
     """2x2 mix of two decompressed chunks (the cross-chunk gate pair,
     like QPager's half-buffer exchange): new_a = m00*a + m01*b,
     new_b = m10*a + m11*b, applied only where the low control test
@@ -100,21 +129,13 @@ def _j_pair_mix(a, b, mp, lo_cmask, lo_cval):
 
 
 @jax.jit
-def _j_chunk_probs(codes, scales, rot_t, qmax):
-    rows = _j_dec_rows(codes, scales, rot_t, qmax)
-    return jnp.sum(rows * rows)
-
-
-from functools import partial
-
-
-@partial(jax.jit, static_argnums=(7,))
-def _j_chunk_prob_mask(codes, scales, rot_t, qmax, base, mask, val, block):
-    rows = _j_dec_rows(codes, scales, rot_t, qmax)
-    pl = _rows_to_planes(rows, block)
-    idx = base + gk.iota_for(pl)
-    p = pl[0] ** 2 + pl[1] ** 2
-    return jnp.sum(jnp.where((idx & mask) == val, p, 0.0))
+def _j_chunk_masses(codes3, scales2, qmax):
+    """Per-chunk probability masses WITHOUT decompressing: the block
+    rotation is orthogonal, so row norms are invariant and each chunk's
+    mass is sum((codes * scale/qmax)^2) — one elementwise reduction
+    over the resident int codes, no matmul, no f32 ket."""
+    y = codes3.astype(jnp.float32) * (scales2 / qmax)[..., None]
+    return jnp.sum(y * y, axis=(1, 2))
 
 
 class QEngineTurboQuant(QEngineTPU):
@@ -169,8 +190,9 @@ class QEngineTurboQuant(QEngineTPU):
 
     def _compress_planes(self, planes):
         rows = _planes_to_rows(jnp.asarray(planes, jnp.float32), self._block)
-        codes, scales = _j_comp_rows(rows, self._rot, self._qmax)
-        self._codes = codes.astype(self._code_np)
+        codes, scales = _j_comp_full(rows, self._rot, self._qmax,
+                                     jnp.dtype(self._code_np).name)
+        self._codes = codes
         self._scales = scales
 
     def _decompress_planes(self):
@@ -223,126 +245,315 @@ class QEngineTurboQuant(QEngineTPU):
                            self._rot_t, self._qmax)
         return _rows_to_planes(rows, self._block)
 
-    def _comp_chunk(self, planes):
-        rows = _planes_to_rows(planes, self._block)
-        codes, scales = _j_comp_rows(rows, self._rot, self._qmax)
-        return codes.astype(self._code_np), scales
+    def _chunk3(self):
+        """Chunk-major views of the resident arrays: (C, cb, 2D), (C, cb)."""
+        C, cb = self._n_chunks(), self._chunk_blocks
+        return (self._codes.reshape(C, cb, -1), self._scales.reshape(C, cb))
 
-    def _scatter_chunks(self, updates) -> None:
-        """Write back {chunk_index: (codes, scales)} in one pass."""
-        if not updates:
-            return
-        cparts, sparts = [], []
-        for c in range(self._n_chunks()):
-            sl = self._chunk_slice(c)
-            if c in updates:
-                cc, ss = updates[c]
-                cparts.append(cc)
-                sparts.append(ss)
-            else:
-                cparts.append(self._codes[sl])
-                sparts.append(self._scales[sl])
-        self._codes = jnp.concatenate(cparts)
-        self._scales = jnp.concatenate(sparts)
+    def _store3(self, codes3, scales2) -> None:
+        self._codes = codes3.reshape(-1, codes3.shape[-1])
+        self._scales = scales2.reshape(-1)
+
+    def _layout_key(self):
+        return (self.qubit_count, self._tq_chunk_pow, self._tq_block_pow,
+                self._tq_bits)
 
     def _note_transient(self, n_chunks_live: int) -> None:
         self.peak_transient_amps = max(
             self.peak_transient_amps, n_chunks_live * self._chunk_amps)
 
     # ------------------------------------------------------------------
-    # chunked kernel overrides (the hot path)
+    # chunked kernel overrides (the hot path).  Each gate is ONE cached
+    # jitted program whose chunk axis is a lax.map dimension: O(1)
+    # dispatches and an in-place donated update of the code array, with
+    # the decompressed f32 working set still bounded by one (or a pair
+    # of) chunk(s).  Chunks whose high-control test fails — or whose
+    # diagonal factor is identically 1 — keep their EXACT codes via a
+    # per-chunk select, so requantization error accrues only where a
+    # gate acted (same exactness contract as the old host loop).
     # ------------------------------------------------------------------
+
+    def _p_gate_low(self, target: int):
+        ca, block = self._tq_chunk_pow, self._block
+        cdt, qmax = self._code_np, self._qmax
+
+        def build():
+            def run(codes3, scales2, rot, rot_t, mp,
+                    hi_cmask, hi_cval, lo_cmask, lo_cval):
+                def body(args):
+                    cid, cc, ss = args
+                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
+                                         block)
+                    out = gk.apply_2x2(pl, mp, ca, target, lo_cmask, lo_cval)
+                    nc, ns = _comp_rows_f(_planes_to_rows(out, block), rot,
+                                          qmax, cdt)
+                    sel = (cid & hi_cmask) == hi_cval
+                    return jnp.where(sel, nc, cc), jnp.where(sel, ns, ss)
+
+                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+                return jax.lax.map(body, (cids, codes3, scales2))
+
+            return jax.jit(run, donate_argnums=(0, 1))
+
+        return _program(("tq_low", self._layout_key(), target), build)
+
+    def _p_gate_pair(self, tb_pos: int):
+        ca, block = self._tq_chunk_pow, self._block
+        cdt, qmax = self._code_np, self._qmax
+
+        def build():
+            def run(codes3, scales2, rot, rot_t, mp,
+                    hi_cmask, hi_cval, lo_cmask, lo_cval):
+                C, cb, twoD = codes3.shape
+                lo_n = 1 << tb_pos
+                hi_n = C // (2 * lo_n)
+                # chunk id bits [hi | pair-bit | lo]: expose the pair
+                # axis, map over (hi, lo) pairs
+                c5 = (codes3.reshape(hi_n, 2, lo_n, cb, twoD)
+                      .transpose(1, 0, 2, 3, 4).reshape(2, C // 2, cb, twoD))
+                s4 = (scales2.reshape(hi_n, 2, lo_n, cb)
+                      .transpose(1, 0, 2, 3).reshape(2, C // 2, cb))
+
+                def body(args):
+                    pid, cca, ccb, ssa, ssb = args
+                    lpart = pid & (lo_n - 1)
+                    cid_a = ((pid >> tb_pos) << (tb_pos + 1)) | lpart
+                    a = _rows_to_planes(_dec_rows_f(cca, ssa, rot_t, qmax),
+                                        block)
+                    b = _rows_to_planes(_dec_rows_f(ccb, ssb, rot_t, qmax),
+                                        block)
+                    na, nb = _pair_mix_f(a, b, mp, lo_cmask, lo_cval)
+                    nca, nsa = _comp_rows_f(_planes_to_rows(na, block), rot,
+                                            qmax, cdt)
+                    ncb, nsb = _comp_rows_f(_planes_to_rows(nb, block), rot,
+                                            qmax, cdt)
+                    # controls never sit on the target bit, so the hi
+                    # test is identical for both pair halves
+                    sel = (cid_a & hi_cmask) == hi_cval
+                    return (jnp.where(sel, nca, cca),
+                            jnp.where(sel, ncb, ccb),
+                            jnp.where(sel, nsa, ssa),
+                            jnp.where(sel, nsb, ssb))
+
+                pids = jnp.arange(C // 2, dtype=gk.IDX_DTYPE)
+                nca, ncb, nsa, nsb = jax.lax.map(
+                    body, (pids, c5[0], c5[1], s4[0], s4[1]))
+                nc = (jnp.stack([nca, ncb]).reshape(2, hi_n, lo_n, cb, twoD)
+                      .transpose(1, 0, 2, 3, 4).reshape(C, cb, twoD))
+                ns = (jnp.stack([nsa, nsb]).reshape(2, hi_n, lo_n, cb)
+                      .transpose(1, 0, 2, 3).reshape(C, cb))
+                return nc, ns
+
+            return jax.jit(run, donate_argnums=(0, 1))
+
+        return _program(("tq_pair", self._layout_key(), tb_pos), build)
 
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:
         cmask, cval = self._cmask_cval(controls, perm)
         mp = gk.mtrx_planes(np.asarray(m2, dtype=np.complex128), jnp.float32)
         ca = self._tq_chunk_pow
         cs = self._chunk_amps
-        hi_cmask, hi_cval = cmask >> ca, cval >> ca
-        lo_cmask, lo_cval = cmask & (cs - 1), cval & (cs - 1)
-        updates = {}
         if target < ca:
             self._note_transient(1)
-            for c in range(self._n_chunks()):
-                if (c & hi_cmask) != hi_cval:
-                    continue
-                pl = gk.apply_2x2(self._dec_chunk(c), mp, ca, target,
-                                  lo_cmask, lo_cval)
-                updates[c] = self._comp_chunk(pl)
+            prog = self._p_gate_low(target)
         else:
             self._note_transient(2)
-            tb = 1 << (target - ca)
-            for c in range(self._n_chunks()):
-                if c & tb:
-                    continue
-                if (c & hi_cmask) != hi_cval:
-                    continue
-                a, b = self._dec_chunk(c), self._dec_chunk(c | tb)
-                na, nb = _j_pair_mix(a, b, mp, lo_cmask, lo_cval)
-                updates[c] = self._comp_chunk(na)
-                updates[c | tb] = self._comp_chunk(nb)
-        self._scatter_chunks(updates)
+            prog = self._p_gate_pair(target - ca)
+        c3, s2 = self._chunk3()
+        nc, ns = prog(c3, s2, self._rot, self._rot_t, mp,
+                      cmask >> ca, cval >> ca, cmask & (cs - 1),
+                      cval & (cs - 1))
+        self._store3(nc, ns)
+
+    def _p_diag(self):
+        ca, block = self._tq_chunk_pow, self._block
+        cdt, qmax = self._code_np, self._qmax
+
+        def build():
+            def run(codes3, scales2, rot, rot_t, d0re, d0im, d1re, d1im,
+                    tmask_lo, tb_hi, lo_cmask, lo_cval, hi_cmask, hi_cval):
+                def body(args):
+                    cid, cc, ss = args
+                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
+                                         block)
+                    lidx = gk.iota_for(pl)
+                    hi_bit = (cid & tb_hi) != 0
+                    bit = ((lidx & tmask_lo) != 0) | hi_bit
+                    fre = jnp.where(bit, d1re, d0re)
+                    fim = jnp.where(bit, d1im, d0im)
+                    active = (lidx & lo_cmask) == lo_cval
+                    fre = jnp.where(active, fre, 1.0)
+                    fim = jnp.where(active, fim, 0.0)
+                    out = gk.cmul(fre, fim, pl)
+                    nc, ns = _comp_rows_f(_planes_to_rows(out, block), rot,
+                                          qmax, cdt)
+                    # exactness: a chunk whose factor is constant 1
+                    # (target above the chunk selecting a unit diagonal,
+                    # no low controls) must keep its codes bit-for-bit
+                    cf_re = jnp.where(hi_bit, d1re, d0re)
+                    cf_im = jnp.where(hi_bit, d1im, d0im)
+                    ident = ((tmask_lo == 0) & (lo_cmask == 0)
+                             & (cf_re == 1.0) & (cf_im == 0.0))
+                    sel = ((cid & hi_cmask) == hi_cval) & ~ident
+                    return jnp.where(sel, nc, cc), jnp.where(sel, ns, ss)
+
+                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+                return jax.lax.map(body, (cids, codes3, scales2))
+
+            return jax.jit(run, donate_argnums=(0, 1))
+
+        return _program(("tq_diag", self._layout_key()), build)
 
     def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
         cmask, cval = self._cmask_cval(controls, perm)
         ca = self._tq_chunk_pow
         cs = self._chunk_amps
-        hi_cmask, hi_cval = cmask >> ca, cval >> ca
-        lo_cmask, lo_cval = cmask & (cs - 1), cval & (cs - 1)
-        updates = {}
+        d0, d1 = complex(d0), complex(d1)
+        tmask_lo = (1 << target) if target < ca else 0
+        tb_hi = 0 if target < ca else (1 << (target - ca))
         self._note_transient(1)
-        for c in range(self._n_chunks()):
-            if (c & hi_cmask) != hi_cval:
-                continue
-            if target >= ca:
-                # the whole chunk shares the target bit value
-                f = d1 if (c >> (target - ca)) & 1 else d0
-                if lo_cmask == 0 and f == 1.0:
-                    continue
-                pl = gk.apply_diag(self._dec_chunk(c), f.real, f.imag,
-                                   f.real, f.imag, ca, 0,
-                                   lo_cmask, lo_cval)
-            else:
-                pl = gk.apply_diag(self._dec_chunk(c),
-                                   complex(d0).real, complex(d0).imag,
-                                   complex(d1).real, complex(d1).imag,
-                                   ca, 1 << target, lo_cmask, lo_cval)
-            updates[c] = self._comp_chunk(pl)
-        self._scatter_chunks(updates)
+        c3, s2 = self._chunk3()
+        nc, ns = self._p_diag()(c3, s2, self._rot, self._rot_t,
+                                d0.real, d0.imag, d1.real, d1.imag,
+                                tmask_lo, tb_hi, cmask & (cs - 1),
+                                cval & (cs - 1), cmask >> ca, cval >> ca)
+        self._store3(nc, ns)
+
+    def _p_phase_split(self, key, body_fn, n_targs: int):
+        ca, block = self._tq_chunk_pow, self._block
+        cdt, qmax = self._code_np, self._qmax
+
+        def build():
+            def run(codes3, scales2, rot, rot_t, *targs):
+                def body(args):
+                    cid, cc, ss = args
+                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
+                                         block)
+                    lidx = gk.iota_for(pl)
+                    fre, fim = body_fn(jnp, cid, lidx, ca, *targs)
+                    out = gk.cmul(fre, fim, pl)
+                    return _comp_rows_f(_planes_to_rows(out, block), rot,
+                                        qmax, cdt)
+
+                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+                return jax.lax.map(body, (cids, codes3, scales2))
+
+            return jax.jit(run, donate_argnums=(0, 1))
+
+        if key is None:  # unkeyed generic fn: trace per call
+            return build()
+        return _program(("tq_phase", self._layout_key(), tuple(key)), build)
 
     def _k_phase_fn(self, fn, split=None) -> None:
-        cs = self._chunk_amps
-        updates = {}
         self._note_transient(1)
-        for c in range(self._n_chunks()):
-            pl = self._dec_chunk(c)
-            idx = jnp.asarray(c * cs, gk.IDX_DTYPE) + gk.iota_for(pl)
-            fre, fim = fn(jnp, idx)
-            updates[c] = self._comp_chunk(gk.cmul(fre, fim, pl))
-        self._scatter_chunks(updates)
+        if split is not None:
+            # split (chunk_id, local_idx) form: exact past 31 qubits,
+            # program cached on the op's split key
+            key, body, targs = split
+            prog = self._p_phase_split(key, body, len(targs))
+            c3, s2 = self._chunk3()
+            nc, ns = prog(c3, s2, self._rot, self._rot_t,
+                          *[jnp.asarray(t) for t in targs])
+        else:
+            if self.qubit_count > 31:
+                raise NotImplementedError(
+                    "this diagonal op lacks a split-index form for "
+                    ">31-qubit compressed kets (see the `split=` forms "
+                    "in engines/qengine.py)")
+            cs = self._chunk_amps
+
+            def body(xp, cid, lidx, L):
+                return fn(xp, cid * cs + lidx)
+
+            prog = self._p_phase_split(None, body, 0)
+            c3, s2 = self._chunk3()
+            nc, ns = prog(c3, s2, self._rot, self._rot_t)
+        self._store3(nc, ns)
+
+    def _p_prob_mask(self):
+        ca, block, qmax = self._tq_chunk_pow, self._block, self._qmax
+
+        def build():
+            def run(codes3, scales2, rot_t, mask_lo, val_lo, mask_hi, val_hi):
+                def body(args):
+                    cid, cc, ss = args
+                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
+                                         block)
+                    lidx = gk.iota_for(pl)
+                    ok = (((lidx & mask_lo) == val_lo)
+                          & ((cid & mask_hi) == val_hi))
+                    p = pl[0] ** 2 + pl[1] ** 2
+                    return jnp.sum(jnp.where(ok, p, 0.0))
+
+                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+                return jnp.sum(jax.lax.map(body, (cids, codes3, scales2)))
+
+            return jax.jit(run)
+
+        return _program(("tq_probmask", self._layout_key()), build)
 
     def _k_prob_mask(self, mask, perm) -> float:
-        cs = self._chunk_amps
-        total = 0.0
-        for c in range(self._n_chunks()):
-            sl = self._chunk_slice(c)
-            total += float(_j_chunk_prob_mask(
-                self._codes[sl], self._scales[sl], self._rot_t, self._qmax,
-                c * cs, mask, perm, int(self._block)))
+        ca, cs = self._tq_chunk_pow, self._chunk_amps
+        c3, s2 = self._chunk3()
+        total = float(self._p_prob_mask()(
+            c3, s2, self._rot_t, mask & (cs - 1), perm & (cs - 1),
+            mask >> ca, perm >> ca))
         return min(max(total, 0.0), 1.0)
 
+    def _p_collapse(self):
+        ca, block = self._tq_chunk_pow, self._block
+        cdt, qmax = self._code_np, self._qmax
+
+        def build():
+            def run(codes3, scales2, rot, rot_t, mask_lo, val_lo,
+                    mask_hi, val_hi, scale):
+                def body(args):
+                    cid, cc, ss = args
+                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
+                                         block)
+                    lidx = gk.iota_for(pl)
+                    keep = (((lidx & mask_lo) == val_lo)
+                            & ((cid & mask_hi) == val_hi))
+                    pl = jnp.where(keep, pl * scale,
+                                   jnp.zeros((), pl.dtype))
+                    return _comp_rows_f(_planes_to_rows(pl, block), rot,
+                                        qmax, cdt)
+
+                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+                return jax.lax.map(body, (cids, codes3, scales2))
+
+            return jax.jit(run, donate_argnums=(0, 1))
+
+        return _program(("tq_collapse", self._layout_key()), build)
+
+    def _p_collapse_scales(self):
+        def build():
+            def run(scales2, mask_hi, val_hi, scale):
+                cids = jnp.arange(scales2.shape[0], dtype=gk.IDX_DTYPE)
+                sel = (cids & mask_hi) == val_hi
+                return jnp.where(sel[:, None], scales2 * scale,
+                                 jnp.zeros((), scales2.dtype))
+
+            return jax.jit(run, donate_argnums=(0,))
+
+        return _program(("tq_collapse_s", self._layout_key()), build)
+
     def _k_collapse(self, mask, val, nrm_sq) -> None:
-        cs = self._chunk_amps
+        ca, cs = self._tq_chunk_pow, self._chunk_amps
         scale = 1.0 / math.sqrt(nrm_sq)
-        updates = {}
-        self._note_transient(1)
-        for c in range(self._n_chunks()):
-            pl = self._dec_chunk(c)
-            idx = jnp.asarray(c * cs, gk.IDX_DTYPE) + gk.iota_for(pl)
-            keep = (idx & mask) == val
-            pl = jnp.where(keep, pl * scale, jnp.zeros((), pl.dtype))
-            updates[c] = self._comp_chunk(pl)
-        self._scatter_chunks(updates)
+        c3, s2 = self._chunk3()
+        if (mask & (cs - 1)) == 0:
+            # chunk-aligned mask: collapse is a pure per-chunk scale
+            # update (match -> *scale, else -> 0); codes stay exact and
+            # nothing decompresses (the linear-in-scales property again)
+            nc, ns = c3, self._p_collapse_scales()(s2, mask >> ca,
+                                                   val >> ca, scale)
+        else:
+            self._note_transient(1)
+            nc, ns = self._p_collapse()(c3, s2, self._rot, self._rot_t,
+                                        mask & (cs - 1), val & (cs - 1),
+                                        mask >> ca, val >> ca, scale)
+        self._store3(nc, ns)
 
     def _k_normalize(self, nrm_sq) -> None:
         # dequantization is linear in scales: normalization never
@@ -351,14 +562,13 @@ class QEngineTurboQuant(QEngineTPU):
 
     def MAll(self) -> int:
         """Two-stage chunked sampling: categorical over per-chunk
-        probability masses, then within the drawn chunk — never
-        materializes more than one chunk."""
+        probability masses (computed WITHOUT decompressing — rotation
+        orthogonality preserves norms), then within the drawn chunk —
+        never materializes more than one chunk."""
         n_ch = self._n_chunks()
-        masses = np.asarray([
-            float(_j_chunk_probs(self._codes[self._chunk_slice(c)],
-                                 self._scales[self._chunk_slice(c)],
-                                 self._rot_t, self._qmax))
-            for c in range(n_ch)])
+        c3, s2 = self._chunk3()
+        masses = np.asarray(_j_chunk_masses(c3, s2, self._qmax),
+                            dtype=np.float64)
         tot = masses.sum()
         u = self.Rand() * tot
         acc = 0.0
