@@ -1643,7 +1643,8 @@ class QUnit(QInterface):
     def LossyLoadStateVector(self, path: str) -> None:
         import json
 
-        from ..storage.turboquant import dequantize_blocks, lossy_load
+        from ..storage.turboquant import (dequantize_blocks,
+                                          dequantize_blocks_v1, lossy_load)
 
         p = path if str(path).endswith(".npz") else str(path) + ".npz"
         with np.load(p) as z:
@@ -1651,15 +1652,22 @@ class QUnit(QInterface):
                 self.SetQuantumState(lossy_load(path))  # whole-ket fallback
                 return
             meta = json.loads(bytes(z["meta"]).decode())
-            if meta.get("format") != "qunit-turboquant-v2":
-                self.SetQuantumState(lossy_load(path))
-                return
+            fmt = meta.get("format")
+            if fmt == "qunit-turboquant-v1":
+                decode = dequantize_blocks_v1  # pre-rotation round-<=3 archive
+            elif fmt == "qunit-turboquant-v2":
+                decode = dequantize_blocks
+            else:
+                # a per-factor archive in an unknown format can never be
+                # decoded by the whole-ket fallback (no top-level codes/
+                # scales keys) — fail with the real reason
+                raise ValueError(f"unsupported QUnit checkpoint format {fmt!r}")
             if meta["qubit_count"] != self.qubit_count:
                 raise ValueError("checkpoint width mismatch")
             self.shards = [_Shard() for _ in range(self.qubit_count)]
             for i, fm in enumerate(meta["factors"]):
-                st = dequantize_blocks(z[f"scales_{i}"], z[f"codes_{i}"],
-                                       fm["n"], meta["bits"])
+                st = decode(z[f"scales_{i}"], z[f"codes_{i}"],
+                            fm["n"], meta["bits"])
                 qs = fm["qubits"]
                 if len(qs) == 1:
                     s = self.shards[qs[0]]

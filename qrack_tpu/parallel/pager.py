@@ -787,7 +787,8 @@ class QPager(QEngine):
     def LossyLoadStateVector(self, path: str) -> None:
         import json
 
-        from ..storage.turboquant import dequantize_blocks, lossy_load
+        from ..storage.turboquant import (dequantize_blocks,
+                                          dequantize_blocks_v1, lossy_load)
 
         p = path if str(path).endswith(".npz") else str(path) + ".npz"
         with np.load(p) as z:
@@ -795,9 +796,13 @@ class QPager(QEngine):
                 self.SetQuantumState(lossy_load(path))  # whole-ket fallback
                 return
             meta = json.loads(bytes(z["meta"]).decode())
-            if meta.get("format") != "qpager-turboquant-v2":
-                self.SetQuantumState(lossy_load(path))
-                return
+            fmt = meta.get("format")
+            if fmt == "qpager-turboquant-v1":
+                decode = dequantize_blocks_v1  # pre-rotation round-<=3 archive
+            elif fmt == "qpager-turboquant-v2":
+                decode = dequantize_blocks
+            else:
+                raise ValueError(f"unsupported QPager checkpoint format {fmt!r}")
             if meta["qubit_count"] != self.qubit_count:
                 raise ValueError("checkpoint width mismatch")
             plen = meta["page_len"]
@@ -809,8 +814,8 @@ class QPager(QEngine):
                 # page's weight, so only ONE global renormalization runs.
                 # Offsets are checkpoint-relative (i * plen), so a pager
                 # with a different page count loads the same ket.
-                page = dequantize_blocks(z[f"scales_{i}"], z[f"codes_{i}"],
-                                         plen, meta["bits"], normalize=False)
+                page = decode(z[f"scales_{i}"], z[f"codes_{i}"],
+                              plen, meta["bits"], normalize=False)
                 total += float(np.sum(np.abs(page) ** 2))
                 self.SetAmplitudePage(page, i * plen)
             if total > 0:

@@ -108,6 +108,24 @@ def dequantize_blocks(scales: np.ndarray, codes: np.ndarray, n: int,
     return out
 
 
+def dequantize_blocks_v1(scales: np.ndarray, codes: np.ndarray, n: int,
+                         bits: int = DEFAULT_BITS,
+                         normalize: bool = True) -> np.ndarray:
+    """Decode the round-<=3 pre-rotation block format: per-plane max-abs
+    int codes with (2, B) scales and no decorrelating rotation.  Kept so
+    v1 per-factor/per-page archives written before the rotated format
+    landed still load (same math as lossy_load's legacy branch)."""
+    q = qmax(bits)
+    planes = codes.astype(np.float32) * (scales[..., None] / q)
+    flat = planes.reshape(2, -1)
+    out = (flat[0] + 1j * flat[1]).astype(np.complex128)[:n]
+    if normalize:
+        nrm = np.linalg.norm(out)
+        if nrm > 0:
+            out = out / nrm
+    return out
+
+
 def lossy_save(state: np.ndarray, path: str, bits: int = DEFAULT_BITS,
                block_pow: int = 12, seed: int = DEFAULT_SEED) -> None:
     scales, codes, n = quantize_blocks(state, bits=bits,
@@ -123,9 +141,5 @@ def lossy_load(path: str) -> np.ndarray:
                                      int(z["bits"]), seed=int(z["seed"]))
         # pre-rotation checkpoint format (round <=3): per-plane max-abs
         # int codes with (2, B) scales, no decorrelating rotation
-        q = (1 << (int(z["bits"]) - 1)) - 1
-        planes = z["codes"].astype(np.float32) * (z["scales"][..., None] / q)
-        flat = planes.reshape(2, -1)
-        out = (flat[0] + 1j * flat[1]).astype(np.complex128)[: int(z["n"])]
-        nrm = np.linalg.norm(out)
-        return out / nrm if nrm > 0 else out
+        return dequantize_blocks_v1(z["scales"], z["codes"], int(z["n"]),
+                                    int(z["bits"]))

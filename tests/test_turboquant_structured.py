@@ -91,3 +91,93 @@ def test_whole_ket_fallback_compat(tmp_path):
               rand_global_phase=False)
     q.LossyLoadStateVector(path)
     assert fidelity(e.GetQuantumState(), q.GetQuantumState()) > 0.999
+
+
+# ---------------- round-<=3 (v1, pre-rotation) archive compat ----------------
+
+
+def _v1_quantize(state, bits=8, block_pow=12):
+    """The round-<=3 per-plane max-abs block format (no rotation)."""
+    state = np.asarray(state).reshape(-1)
+    n = state.shape[0]
+    block = min(1 << block_pow, n)
+    pad = (-n) % block
+    if pad:
+        state = np.concatenate([state, np.zeros(pad, dtype=state.dtype)])
+    planes = np.stack([state.real, state.imag]).astype(np.float32)
+    planes = planes.reshape(2, -1, block)
+    scales = np.max(np.abs(planes), axis=2, keepdims=True)
+    safe = np.where(scales > 0, scales, 1.0)
+    qmax = (1 << (bits - 1)) - 1
+    codes = np.round(planes / safe * qmax).astype(np.int8)
+    return scales.squeeze(-1).astype(np.float32), codes, n
+
+
+def test_qunit_v1_archive_loads(tmp_path):
+    """A per-factor archive written by the round-3 code must still load
+    (ADVICE r4 medium: the old fallback KeyError'd on v1 files)."""
+    import json
+
+    n = 4
+    q = QUnit(n, unit_factory=cpu_factory, rng=QrackRandom(21),
+              rand_global_phase=False)
+    q.H(0); q.CNOT(0, 1); q.T(1); q.RY(0.4, 2)
+    ref = q.GetQuantumState()
+    # write the v1 container by hand, exactly as round-3 did
+    q._flush_all()
+    arrays, meta = {}, []
+    for idx, (st, qs) in enumerate(q._factors()):
+        scales, codes, ln = _v1_quantize(st, bits=8)
+        arrays[f"scales_{idx}"] = scales
+        arrays[f"codes_{idx}"] = codes
+        meta.append({"qubits": [int(x) for x in qs], "n": int(ln)})
+    arrays["meta"] = np.frombuffer(json.dumps(
+        {"format": "qunit-turboquant-v1", "bits": 8,
+         "qubit_count": n, "factors": meta}).encode(), dtype=np.uint8)
+    path = str(tmp_path / "v1.qckpt.npz")
+    np.savez_compressed(path, **arrays)
+
+    q2 = QUnit(n, unit_factory=cpu_factory, rng=QrackRandom(22),
+               rand_global_phase=False)
+    q2.LossyLoadStateVector(path)
+    assert fidelity(ref, q2.GetQuantumState()) > 0.995
+
+
+def test_qpager_v1_archive_loads(tmp_path):
+    import json
+
+    n = 5
+    p = QPager(n, n_pages=4, rng=QrackRandom(23), rand_global_phase=False)
+    p.H(0); p.CNOT(0, 1); p.T(3); p.CNOT(3, 4)
+    ref = p.GetQuantumState()
+    L = p.local_bits
+    arrays = {}
+    for i in range(p.n_pages):
+        page = p.GetAmplitudePage(i << L, 1 << L)
+        scales, codes, ln = _v1_quantize(page, bits=8, block_pow=3)
+        arrays[f"scales_{i}"] = scales
+        arrays[f"codes_{i}"] = codes
+    arrays["meta"] = np.frombuffer(json.dumps(
+        {"format": "qpager-turboquant-v1", "bits": 8, "qubit_count": n,
+         "n_pages": p.n_pages, "page_len": 1 << L,
+         "device_ids": p.GetDeviceList()}).encode(), dtype=np.uint8)
+    path = str(tmp_path / "v1p.qckpt.npz")
+    np.savez_compressed(path, **arrays)
+
+    p2 = QPager(n, n_pages=4, rng=QrackRandom(24), rand_global_phase=False)
+    p2.LossyLoadStateVector(path)
+    assert fidelity(ref, p2.GetQuantumState()) > 0.995
+
+
+def test_unknown_format_raises(tmp_path):
+    import json
+
+    q = QUnit(3, unit_factory=cpu_factory, rng=QrackRandom(25),
+              rand_global_phase=False)
+    arrays = {"meta": np.frombuffer(json.dumps(
+        {"format": "qunit-turboquant-v99", "bits": 8, "qubit_count": 3,
+         "factors": []}).encode(), dtype=np.uint8)}
+    path = str(tmp_path / "bad.qckpt.npz")
+    np.savez_compressed(path, **arrays)
+    with pytest.raises(ValueError, match="unsupported"):
+        q.LossyLoadStateVector(path)
