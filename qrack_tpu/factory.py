@@ -13,6 +13,9 @@ QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
   "stabilizer"         bare CHP tableau (Clifford-only)
   "unit_clifford"      QUnit factoring over per-subsystem tableaus
   "bdt" / "bdt_hybrid" QBdt decision tree / auto-switching hybrid
+  "bdt_attached"       QBdt with dense leaf kets under the tree
+                       (attached_qubits kwarg; default n//2 or
+                       QRACK_QBDT_ATTACH_QB)
   "pager"              QPager sharded dense engine over the device mesh
   "hybrid"             QHybrid CPU<->TPU<->pager width switching
   "tpu"                QEngineTPU single-device dense engine
@@ -32,7 +35,7 @@ OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
 OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
 _TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt",
-             "unit_clifford", "sparse", "turboquant"}
+             "bdt_attached", "unit_clifford", "sparse", "turboquant"}
 
 
 def _terminal_factory(name: str, **opts) -> Callable:
@@ -60,6 +63,19 @@ def _terminal_factory(name: str, **opts) -> Callable:
         from .layers.qbdt import QBdt
 
         return lambda n, **kw: QBdt(n, **{**opts, **kw})
+    if name == "bdt_attached":
+        import os
+
+        from .layers.qbdt import QBdt
+
+        def mk_attached(n, **kw):
+            kw = {**opts, **kw}
+            if "attached_qubits" not in kw:
+                kw["attached_qubits"] = int(os.environ.get(
+                    "QRACK_QBDT_ATTACH_QB", str(n // 2)))
+            return QBdt(n, **kw)
+
+        return mk_attached
     if name == "sparse":
         from .engines.sparse import QEngineSparse
 

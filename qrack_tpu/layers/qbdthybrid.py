@@ -9,6 +9,7 @@ later-round refinement, as in the reference's one-way hysteresis)."""
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import numpy as np
@@ -26,13 +27,22 @@ def _default_engine_factory(n, **kw):
 class QBdtHybrid(QInterface):
     def __init__(self, qubit_count: int, init_state: int = 0,
                  engine_factory: Optional[Callable] = None,
-                 ratio_threshold: float = 0.25, **kwargs):
+                 ratio_threshold: float = 0.25,
+                 attached_qubits: Optional[int] = None, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
         self._factory = engine_factory or _default_engine_factory
         self._kw = {k: v for k, v in kwargs.items() if k != "rng"}
         self.ratio = ratio_threshold
-        self.bdt: Optional[QBdt] = QBdt(qubit_count, init_state=init_state,
-                                        rng=self.rng.spawn(), **self._kw)
+        # tree-top/dense-bottom form inside the tree half (reference:
+        # Attach under QBdt, include/qbdt.hpp:37-70): the `attached`
+        # high qubits terminate in dense leaf kets.  Default off; set
+        # explicitly or via QRACK_QBDT_ATTACH_QB.
+        if attached_qubits is None:
+            attached_qubits = int(os.environ.get("QRACK_QBDT_ATTACH_QB", "0"))
+        self.attached_qubits = min(max(int(attached_qubits), 0), qubit_count)
+        self.bdt: Optional[QBdt] = QBdt(
+            qubit_count, init_state=init_state, rng=self.rng.spawn(),
+            attached_qubits=self.attached_qubits, **self._kw)
         self.engine = None
 
     def _live(self):
@@ -90,7 +100,10 @@ class QBdtHybrid(QInterface):
         # reset returns to the compressed representation; phase (explicit
         # or random-global) must survive the rebuild
         self.engine = None
-        self.bdt = QBdt(self.qubit_count, rng=self.rng.spawn(), **self._kw)
+        self.bdt = QBdt(self.qubit_count, rng=self.rng.spawn(),
+                        attached_qubits=min(self.attached_qubits,
+                                            self.qubit_count),
+                        **self._kw)
         self.bdt.rand_global_phase = self.rand_global_phase
         self.bdt.SetPermutation(perm, phase)
 
@@ -120,7 +133,9 @@ class QBdtHybrid(QInterface):
 
     def Clone(self) -> "QBdtHybrid":
         c = QBdtHybrid(self.qubit_count, engine_factory=self._factory,
-                       ratio_threshold=self.ratio, rng=self.rng.spawn(), **self._kw)
+                       ratio_threshold=self.ratio,
+                       attached_qubits=self.attached_qubits,
+                       rng=self.rng.spawn(), **self._kw)
         if self.engine is not None:
             c.engine = self.engine.Clone()
             c.bdt = None

@@ -252,3 +252,212 @@ def test_traversal_to_from_engine():
                            rand_global_phase=False)
     got = align_phase(np.asarray(back.GetQuantumState()), ref)
     np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+# ---------------- tree-native separation ----------------
+# (reference: Decompose/Dispose operate on the tree without dense
+#  materialization, include/qbdt.hpp:37-70, src/qbdt/tree.cpp)
+
+
+def _product_halves(n, seed):
+    """Product state: independent circuits on [0, n/2) and [n/2, n)."""
+    q = QBdt(n, rng=QrackRandom(seed), rand_global_phase=False)
+    h = n // 2
+    q.H(0); q.T(0); q.CNOT(0, 1); q.RY(0.3, 2 % h)
+    q.H(h); q.CNOT(h, h + 1); q.T(h + 1); q.RZ(0.7, h + 2 if h + 2 < n else h)
+    return q
+
+
+def test_tree_decompose_no_materialization(monkeypatch):
+    """Decompose of a 24-qubit product state must stay on the tree:
+    no dense fallback, no GetQuantumState, peak transient 2^12 not
+    2^24 (the VERDICT r4 done-criterion)."""
+    n, h = 24, 12
+    q = _product_halves(n, seed=31)
+
+    def boom(*a, **k):
+        raise AssertionError("dense path used for a separable cut")
+
+    monkeypatch.setattr(QBdt, "_dense_split", boom)
+    monkeypatch.setattr(QBdt, "GetQuantumState", boom)
+    dest = QBdt(h, rng=QrackRandom(32), rand_global_phase=False)
+    q.Decompose(h, dest)
+    monkeypatch.undo()
+
+    assert q.qubit_count == h and dest.qubit_count == h
+    # both factors normalized and equal to the independently-built halves
+    a = QBdt(h, rng=QrackRandom(33), rand_global_phase=False)
+    a.H(0); a.T(0); a.CNOT(0, 1); a.RY(0.3, 2)
+    b = QBdt(h, rng=QrackRandom(34), rand_global_phase=False)
+    b.H(0); b.CNOT(0, 1); b.T(1); b.RZ(0.7, 2)
+    got_low = align_phase(q.GetQuantumState(), a.GetQuantumState())
+    np.testing.assert_allclose(got_low, a.GetQuantumState(), atol=1e-7)
+    got_high = align_phase(dest.GetQuantumState(), b.GetQuantumState())
+    np.testing.assert_allclose(got_high, b.GetQuantumState(), atol=1e-7)
+
+
+def test_tree_decompose_matches_dense(monkeypatch):
+    """Tree-native middle-range Decompose == QEngineCPU Decompose."""
+    n, start, length = 9, 3, 3
+    q = QBdt(n, rng=QrackRandom(41), rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(41), rand_global_phase=False)
+    for eng in (q, d):
+        eng.H(0); eng.CNOT(0, 1); eng.T(1)            # low block
+        eng.H(start); eng.CNOT(start, start + 1)      # middle block
+        eng.RY(0.4, start + 2)
+        eng.H(6); eng.CNOT(6, 7); eng.CNOT(7, 8)      # high block
+    monkeypatch.setattr(QBdt, "_dense_split", lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("dense path used for a separable cut")))
+    qd = QBdt(length, rng=QrackRandom(42), rand_global_phase=False)
+    q.Decompose(start, qd)
+    monkeypatch.undo()
+    dd = QEngineCPU(length, rng=QrackRandom(43), rand_global_phase=False)
+    d.Decompose(start, dd)
+    got = align_phase(qd.GetQuantumState(), dd.GetQuantumState())
+    np.testing.assert_allclose(got, dd.GetQuantumState(), atol=1e-6)
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
+
+
+def test_tree_dispose_separable(monkeypatch):
+    n, h = 8, 4
+    q = _product_halves(n, seed=51)
+    ref = QBdt(h, rng=QrackRandom(52), rand_global_phase=False)
+    ref.H(0); ref.T(0); ref.CNOT(0, 1); ref.RY(0.3, 2)
+    monkeypatch.setattr(QBdt, "_dense_split", lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("dense path used for a separable cut")))
+    q.Dispose(h, h)
+    monkeypatch.undo()
+    assert q.qubit_count == h
+    got = align_phase(q.GetQuantumState(), ref.GetQuantumState())
+    np.testing.assert_allclose(got, ref.GetQuantumState(), atol=1e-7)
+
+
+def test_dispose_perm_projects_exactly():
+    """Dispose with a known disposed permutation strips entangled-basis
+    registers exactly (projection + level strip, no separability)."""
+    n = 6
+    q = QBdt(n, rng=QrackRandom(61), rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(61), rand_global_phase=False)
+    for eng in (q, d):
+        eng.SetPermutation(0b101 << 2)   # qubits [2,5) = 0b101
+        eng.H(0); eng.CNOT(0, 1); eng.T(0)
+        eng.RY(0.9, 5)
+    q.Dispose(2, 3, 0b101)
+    d.Dispose(2, 3, 0b101)
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
+
+
+def test_dispose_perm_zero_amplitude_raises():
+    q = QBdt(4, rng=QrackRandom(62), rand_global_phase=False)
+    q.SetPermutation(0)  # qubits 1,2 are |00>
+    with pytest.raises(RuntimeError):
+        q.Dispose(1, 2, 0b11)
+
+
+def test_nonseparable_falls_back_dense():
+    """An entangled cut must still work (dense fallback, exact)."""
+    n = 6
+    q = QBdt(n, rng=QrackRandom(71), rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(71), rand_global_phase=False)
+    for eng in (q, d):
+        eng.H(0)
+        for i in range(n - 1):
+            eng.CNOT(i, i + 1)      # GHZ: no cut is separable
+        eng.M(2)                    # collapse -> separable again? no:
+        eng.H(3); eng.CNOT(3, 4)    # re-entangle across the cut
+    # Dispose of [0,2) after full collapse of the GHZ chain is fine
+    # dense; the point is no crash and state parity with the oracle
+    q.Dispose(0, 2)
+    d.Dispose(0, 2)
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
+
+
+def test_leaf_region_decompose(monkeypatch):
+    """Decompose of the ENTIRE attached region via the shared-leaf cut."""
+    n, att = 7, 3
+    tq = n - att
+    q = QBdt(n, attached_qubits=att, rng=QrackRandom(81),
+             rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(81), rand_global_phase=False)
+    for eng in (q, d):
+        eng.H(0); eng.CNOT(0, 1); eng.T(2)      # tree region
+        eng.H(tq); eng.CNOT(tq, tq + 1); eng.RY(0.5, tq + 2)  # leaf region
+    monkeypatch.setattr(QBdt, "_dense_split", lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("dense path used for a shared-leaf cut")))
+    qd = QBdt(att, rng=QrackRandom(82), rand_global_phase=False)
+    q.Decompose(tq, qd)
+    monkeypatch.undo()
+    assert q.attached_qubits == 0 and q.qubit_count == tq
+    dd = QEngineCPU(att, rng=QrackRandom(83), rand_global_phase=False)
+    d.Decompose(tq, dd)
+    got = align_phase(qd.GetQuantumState(), dd.GetQuantumState())
+    np.testing.assert_allclose(got, dd.GetQuantumState(), atol=1e-6)
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
+
+
+# ---------------- engine-backed (device) leaves ----------------
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_device_leaves_match_host(seed, monkeypatch):
+    """Device-resident leaf kets (XLA kernel path) == host-interned
+    leaves == dense oracle, including cross-region gates."""
+    monkeypatch.setenv("QRACK_QBDT_LEAF_DEVICE_QB", "1")
+    n, att = 6, 3
+    b = QBdt(n, attached_qubits=att, rng=QrackRandom(seed),
+             rand_global_phase=False)
+    assert b._leaf_on_device()
+    d = QEngineCPU(n, rng=QrackRandom(seed), rand_global_phase=False)
+    random_circuit(b, QrackRandom(1700 + seed), 30, n)
+    random_circuit(d, QrackRandom(1700 + seed), 30, n)
+    got = align_phase(b.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-5)
+    # measurement + probability paths exercise the device reductions
+    assert abs(b.Prob(n - 1) - d.Prob(n - 1)) < 1e-5
+
+
+def test_add_guard_mixed_depth():
+    """_add across inconsistent representations fails loudly (ADVICE r4)."""
+    from qrack_tpu.layers.qbdt import _EngLeaf, _Tree
+
+    t = QBdt(3, attached_qubits=1, rng=QrackRandom(91),
+             rand_global_phase=False)
+    node = t.root
+    while not isinstance(node, _EngLeaf):
+        node = node[1] if node[1] is not None else node[3]
+    with pytest.raises(ValueError):
+        t._add(node, 1.0 + 0j, _Tree.LEAF, 1.0 + 0j, {})
+
+
+# ---------------- attached form reachable from the stack ----------------
+
+
+def test_qbdthybrid_attached_wiring():
+    from qrack_tpu.layers.qbdthybrid import QBdtHybrid
+
+    q = QBdtHybrid(6, attached_qubits=3, rng=QrackRandom(95),
+                   rand_global_phase=False)
+    assert q.bdt.attached_qubits == 3
+    d = QEngineCPU(6, rng=QrackRandom(95), rand_global_phase=False)
+    for eng in (q, d):
+        eng.H(0); eng.CNOT(0, 3); eng.T(4); eng.CNOT(4, 5)
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
+    q.SetPermutation(5)
+    assert q.bdt.attached_qubits == 3   # survives the reset rebuild
+
+
+def test_factory_bdt_attached():
+    from qrack_tpu import create_quantum_interface
+
+    q = create_quantum_interface("bdt_attached", 6, rng=QrackRandom(96),
+                                 rand_global_phase=False)
+    assert q.attached_qubits == 3      # default n//2
+    q2 = create_quantum_interface("bdt_attached", 6, attached_qubits=2,
+                                  rng=QrackRandom(97),
+                                  rand_global_phase=False)
+    assert q2.attached_qubits == 2
