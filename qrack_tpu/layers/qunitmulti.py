@@ -102,11 +102,68 @@ class QUnitMulti(QUnit):
             jdevs = {}
         if device_ids is None:
             device_ids = sorted(jdevs) if jdevs else [0]
-        return [
+        # optional capability weights (relative throughput), e.g.
+        # QRACK_QUNITMULTI_WEIGHTS=1.0,4.0 — one per device id; on one
+        # chip class they stay uniform (MeasureDeviceWeights can derive
+        # them from a live probe instead)
+        wenv = os.environ.get("QRACK_QUNITMULTI_WEIGHTS", "")
+        weights = ([float(t) for t in wenv.split(",") if t.strip()]
+                   if wenv else [])
+        table = [
             DeviceInfo(device_id=i,
-                       capacity_bytes=_discover_capacity(jdevs[i]) if i in jdevs else 0)
-            for i in device_ids
+                       capacity_bytes=_discover_capacity(jdevs[i]) if i in jdevs else 0,
+                       weight=(weights[k] if k < len(weights) else 1.0))
+            for k, i in enumerate(device_ids)
         ]
+        unguarded = [d.device_id for d in table if d.capacity_bytes <= 0]
+        if unguarded:
+            import warnings
+
+            warnings.warn(
+                f"QUnitMulti devices {unguarded} have no discoverable "
+                "memory budget (no memory_stats, no QRACK_QUNITMULTI_MAX_QB"
+                "/QRACK_MAX_ALLOC_MB): the up-front allocation guard is "
+                "DISABLED for them and oversized subsystems will surface "
+                "as runtime OOM instead of MemoryError",
+                RuntimeWarning, stacklevel=3)
+        return table
+
+    def MeasureDeviceWeights(self, size: int = 1024, reps: int = 3) -> None:
+        """Derive capability weights from a live per-device throughput
+        probe (reference: the 'most capable device' ordering,
+        src/qunitmulti.cpp:217, where capability comes from the OpenCL
+        device query; here it is measured, not queried): time a small
+        matmul on each device and set weight ∝ 1/min-time."""
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        jdevs = {d.id: d for d in jax.devices()}
+        times = {}
+        # one jitted program reused for every device (computation
+        # follows input placement; a per-loop lambda would recompile)
+        f = jax.jit(lambda a: a @ a)
+        for info in self.devices:
+            dev = jdevs.get(info.device_id)
+            if dev is None:
+                continue
+            x = jax.device_put(jnp.ones((size, size), jnp.float32), dev)
+            f(x).block_until_ready()  # compile + warm
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                f(x).block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+            times[info.device_id] = best
+        if not times:
+            return
+        fastest = min(times.values())
+        for info in self.devices:
+            if info.device_id in times:
+                info.weight = fastest / times[info.device_id] \
+                    if times[info.device_id] > 0 else 1.0
+        self.RedistributeQEngines()
 
     # -- device table surface (reference: SetDeviceList/GetDeviceList) --
 
@@ -160,7 +217,11 @@ class QUnitMulti(QUnit):
                 if d.capacity_bytes <= 0 or d.free_bytes() >= need_bytes]
         if not fits:
             self._raise_no_fit(need_bytes)
-        return max(fits, key=lambda d: (d.free_bytes(), d.weight))
+        # ascending used_bytes breaks the tie among unguarded devices
+        # (free_bytes() == inf for all of them): fresh units still
+        # spread instead of piling onto device 0
+        return max(fits, key=lambda d: (d.free_bytes(), -d.used_bytes,
+                                        d.weight))
 
     def _raise_no_fit(self, need_bytes: int) -> None:
         cap = max((d.capacity_bytes for d in self.devices), default=0)

@@ -474,3 +474,39 @@ def test_qunitmulti_weighted_preference():
     sizes = {u.qubit_count: u.device_id for u in units.values()}
     assert sizes[3] == 1     # biggest subsystem -> most capable device
     assert sizes[2] == 0     # next one spreads to the other device
+
+
+def test_qunitmulti_unguarded_spread_and_warning():
+    """Unguarded devices (capacity 0) warn once and still SPREAD fresh
+    units by accounted bytes instead of piling onto device 0 (ADVICE r4:
+    the inf-free_bytes tie always picked the first device)."""
+    import warnings as _w
+
+    from qrack_tpu.layers.qunitmulti import DeviceInfo
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        QUnitMulti._build_device_table([0, 1])  # no env budget -> unguarded
+    assert any("allocation guard is DISABLED" in str(r.message) for r in rec)
+
+    table = [DeviceInfo(device_id=0, capacity_bytes=0),
+             DeviceInfo(device_id=1, capacity_bytes=0)]
+    q = QUnitMulti(4, unit_factory=_rec_factory, rng=QrackRandom(8),
+                   device_table=table, rand_global_phase=False)
+    q.FSim(0.3, 0.2, 0, 1)   # first 2-qubit unit
+    q.FSim(0.3, 0.2, 2, 3)   # second unit must land on the OTHER device
+    units = {id(s.unit): s.unit for s in q.shards if s.unit is not None}
+    assert sorted(u.device_id for u in units.values()) == [0, 1]
+
+
+def test_qunitmulti_measured_weights():
+    """MeasureDeviceWeights derives capability from a live throughput
+    probe; on one device class the weights stay ~uniform (documents the
+    single-chip-class restriction of the default table)."""
+    from qrack_tpu.layers.qunitmulti import DeviceInfo
+
+    table = [DeviceInfo(device_id=0, capacity_bytes=1 << 20)]
+    q = QUnitMulti(3, unit_factory=_rec_factory, rng=QrackRandom(9),
+                   device_table=table, rand_global_phase=False)
+    q.MeasureDeviceWeights(size=128, reps=2)
+    assert q.devices[0].weight == 1.0   # fastest device normalizes to 1
