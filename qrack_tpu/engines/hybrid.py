@@ -7,7 +7,13 @@ kets — SURVEY.md §7 "Tiny-state dispatch overhead"); above it the JAX
 engine; above `max_page_qubits` the sharded QPager. The wrapper forwards
 the entire QInterface surface to the active engine and re-materializes
 the ket across representations on width changes (the reference's
-CopyStateVec hand-off)."""
+CopyStateVec hand-off).
+
+Precision escalation: the dense halves honor the FPPOW policy
+(QRACK_TPU_FPPOW, config.py) and — with QRACK_TPU_AUTO_F64_DRIFT set —
+self-escalate their planes f32->f64 when running-norm drift exceeds the
+threshold (QEngineTPU._drift_tick), so deep circuits under QHybrid
+upgrade precision in place without a CPU round-trip."""
 
 from __future__ import annotations
 

@@ -61,11 +61,31 @@ class QEngineTPU(QEngine):
 
     _xp = jnp
 
-    def __init__(self, qubit_count: int, init_state: int = 0, dtype=jnp.float32,
+    def __init__(self, qubit_count: int, init_state: int = 0, dtype=None,
                  device_id: int = -1, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
         self._check_capacity(qubit_count)
-        self.dtype = jnp.dtype(dtype)  # plane dtype (float32 / bfloat16)
+        if dtype is None:
+            # FPPOW policy (config.py): float32 default; float64 / bf16 /
+            # f16 via QRACK_TPU_FPPOW (reference FPPOW,
+            # include/common/qrack_types.hpp:88-138)
+            from ..config import get_config
+
+            dtype = get_config().device_real_dtype()
+        self.dtype = jnp.dtype(dtype)  # plane dtype (f32/f64/bf16/f16)
+        if self.dtype == jnp.dtype("float64") and not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        # f32 norm-drift escalation: every K gates compute total
+        # probability; past the threshold, planes re-cast to float64 in
+        # place (the deep-circuit failure class the bf16 matmul finding
+        # proved matters on this hardware — docs/TPU_EVIDENCE.md:26-35)
+        import os as _os
+
+        self._drift_thresh = float(_os.environ.get(
+            "QRACK_TPU_AUTO_F64_DRIFT", "0"))
+        self._drift_check_every = max(1, int(_os.environ.get(
+            "QRACK_TPU_DRIFT_CHECK_GATES", "64")))
+        self._gate_count = 0
         self._device = jax.devices()[device_id] if device_id >= 0 else None
         self._device_id = device_id
         self._state = None  # (2, 2^n) planes
@@ -106,6 +126,41 @@ class QEngineTPU(QEngine):
     # kernel contract
     # ------------------------------------------------------------------
 
+    def _drift_tick(self) -> None:
+        """Opt-in f32->f64 precision escalation (QRACK_TPU_AUTO_F64_DRIFT):
+        every K gates read back total probability; unitary circuits keep
+        it at 1, so sustained drift means the f32 planes are rotting —
+        re-cast to float64 in place (QHybrid's dense halves inherit this,
+        which is its precision-escalation policy).  Ticked from every
+        MIXING kernel (2x2/invert/diag/4x4/uc); swaps and gathers are
+        exact permutations and cannot drift the norm."""
+        if self._drift_thresh <= 0 or self.dtype == jnp.dtype("float64"):
+            return
+        self._gate_count += 1
+        if self._gate_count % self._drift_check_every:
+            return
+        nrm = float(_j_prob_mask(self._state, 0, 0))  # total probability
+        if abs(1.0 - nrm) > self._drift_thresh:
+            self.EscalateToF64(nrm)
+
+    def EscalateToF64(self, observed_norm: float = None) -> None:
+        """Re-cast the resident planes to float64 (reference analogue:
+        rebuilding at a higher FPPOW, qrack_types.hpp:88-138 — here it
+        is a live dtype switch, no state round-trip)."""
+        import warnings
+
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        if self.dtype == jnp.dtype("float64"):
+            return
+        warnings.warn(
+            f"f32 norm drift {observed_norm!r} exceeded "
+            f"QRACK_TPU_AUTO_F64_DRIFT={self._drift_thresh}: escalating "
+            "amplitude planes to float64", RuntimeWarning)
+        self.dtype = jnp.dtype(jnp.float64)
+        if self._state is not None:
+            self._state = self._state.astype(jnp.float64)
+
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:
         cmask, cval = self._cmask_cval(controls, perm)
         if mat.is_invert(m2):
@@ -118,6 +173,7 @@ class QEngineTPU(QEngine):
         else:
             mp = gk.mtrx_planes(m2, self.dtype)
             self._state = _j_apply_2x2(self._state, mp, self.qubit_count, target, cmask, cval)
+        self._drift_tick()
 
     def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
         cmask, cval = self._cmask_cval(controls, perm)
@@ -126,10 +182,12 @@ class QEngineTPU(QEngine):
             self._state, d0.real, d0.imag, d1.real, d1.imag,
             self.qubit_count, 1 << target, cmask, cval,
         )
+        self._drift_tick()
 
     def _k_apply_4x4(self, m4, q1, q2) -> None:
         mp = gk.mtrx_planes(m4, self.dtype)
         self._state = _j_apply_4x4(self._state, mp, self.qubit_count, q1, q2)
+        self._drift_tick()
 
     def UCMtrx(self, controls, mtrxs, target, mtrx_skip_powers=(), mtrx_skip_value_mask=0) -> None:
         """Uniformly-controlled gate in one fused kernel (reference kernel
@@ -142,6 +200,7 @@ class QEngineTPU(QEngine):
             jnp.asarray(stack.imag, dtype=self.dtype),
         ])
         self._state = _j_uc_2x2(self._state, mps, self.qubit_count, target, tuple(controls))
+        self._drift_tick()
 
     def _k_gather(self, src_fn, split=None) -> None:
         src = src_fn(gk.iota_for(self._state))

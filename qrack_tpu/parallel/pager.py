@@ -82,8 +82,14 @@ class QPager(QEngine):
     _xp = jnp
 
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
-                 n_pages: Optional[int] = None, dtype=jnp.float32, **kwargs):
+                 n_pages: Optional[int] = None, dtype=None, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
+        if dtype is None:
+            # FPPOW policy (config.py device_real_dtype; enables x64
+            # for float64) — same default resolution as QEngineTPU
+            from ..config import get_config
+
+            dtype = get_config().device_real_dtype()
         if devices is None:
             devices = jax.devices()
         # power-of-two device prefix (reference: page-count policy,
