@@ -8,6 +8,9 @@ decompresses block-by-block; serialization stores the seed, not the
 matrices).  There it is a storage class under QEngineCPU; here it is an
 engine whose amplitudes live in HBM as b-bit integer codes, giving a
 4x (int8) or 2x (int16) wider single-device ket than float32 planes.
+The sharded composition (parallel/turboquant_pager.py QPagerTurboQuant)
+distributes the chunk axis over a pages mesh, so the beyond-HBM width
+story multiplies with the beyond-single-chip one.
 
 TPU-first mapping:
 
@@ -138,6 +141,174 @@ def _j_chunk_masses(codes3, scales2, qmax):
     return jnp.sum(y * y, axis=(1, 2))
 
 
+# ---------------------------------------------------------------------------
+# chunked-gate run bodies, shared by the single-device engine (plain jit,
+# cid0=0) and the sharded QPagerTurboQuant (shard_map, cid0=page offset).
+# Each returns a pure fn over chunk-major views (C, cb, 2D)/(C, cb); the
+# trailing cid0 operand is the GLOBAL id of local chunk 0.
+# ---------------------------------------------------------------------------
+
+
+def _mk_gate_low(ca, block, cdt, qmax, target):
+    def run(codes3, scales2, rot, rot_t, mp,
+            hi_cmask, hi_cval, lo_cmask, lo_cval, cid0):
+        def body(args):
+            cid, cc, ss = args
+            pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax), block)
+            out = gk.apply_2x2(pl, mp, ca, target, lo_cmask, lo_cval)
+            nc, ns = _comp_rows_f(_planes_to_rows(out, block), rot, qmax, cdt)
+            sel = (cid & hi_cmask) == hi_cval
+            return jnp.where(sel, nc, cc), jnp.where(sel, ns, ss)
+
+        cids = cid0 + jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+        return jax.lax.map(body, (cids, codes3, scales2))
+
+    return run
+
+
+def _mk_gate_pair(ca, block, cdt, qmax, tb_pos):
+    """Pair mixing for a target whose chunk bit is LOCAL to the shard
+    (tb_pos below the sharded page bits; cid0 is a multiple of the local
+    chunk count, so local pair structure equals global)."""
+
+    def run(codes3, scales2, rot, rot_t, mp,
+            hi_cmask, hi_cval, lo_cmask, lo_cval, cid0):
+        C, cb, twoD = codes3.shape
+        lo_n = 1 << tb_pos
+        hi_n = C // (2 * lo_n)
+        # chunk id bits [hi | pair-bit | lo]: expose the pair axis,
+        # map over (hi, lo) pairs
+        c5 = (codes3.reshape(hi_n, 2, lo_n, cb, twoD)
+              .transpose(1, 0, 2, 3, 4).reshape(2, C // 2, cb, twoD))
+        s4 = (scales2.reshape(hi_n, 2, lo_n, cb)
+              .transpose(1, 0, 2, 3).reshape(2, C // 2, cb))
+
+        def body(args):
+            pid, cca, ccb, ssa, ssb = args
+            lpart = pid & (lo_n - 1)
+            cid_a = cid0 + (((pid >> tb_pos) << (tb_pos + 1)) | lpart)
+            a = _rows_to_planes(_dec_rows_f(cca, ssa, rot_t, qmax), block)
+            b = _rows_to_planes(_dec_rows_f(ccb, ssb, rot_t, qmax), block)
+            na, nb = _pair_mix_f(a, b, mp, lo_cmask, lo_cval)
+            nca, nsa = _comp_rows_f(_planes_to_rows(na, block), rot,
+                                    qmax, cdt)
+            ncb, nsb = _comp_rows_f(_planes_to_rows(nb, block), rot,
+                                    qmax, cdt)
+            # controls never sit on the target bit, so the hi test is
+            # identical for both pair halves
+            sel = (cid_a & hi_cmask) == hi_cval
+            return (jnp.where(sel, nca, cca), jnp.where(sel, ncb, ccb),
+                    jnp.where(sel, nsa, ssa), jnp.where(sel, nsb, ssb))
+
+        pids = jnp.arange(C // 2, dtype=gk.IDX_DTYPE)
+        nca, ncb, nsa, nsb = jax.lax.map(
+            body, (pids, c5[0], c5[1], s4[0], s4[1]))
+        nc = (jnp.stack([nca, ncb]).reshape(2, hi_n, lo_n, cb, twoD)
+              .transpose(1, 0, 2, 3, 4).reshape(C, cb, twoD))
+        ns = (jnp.stack([nsa, nsb]).reshape(2, hi_n, lo_n, cb)
+              .transpose(1, 0, 2, 3).reshape(C, cb))
+        return nc, ns
+
+    return run
+
+
+def _mk_diag(ca, block, cdt, qmax):
+    def run(codes3, scales2, rot, rot_t, d0re, d0im, d1re, d1im,
+            tmask_lo, tb_hi, lo_cmask, lo_cval, hi_cmask, hi_cval, cid0):
+        def body(args):
+            cid, cc, ss = args
+            pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax), block)
+            lidx = gk.iota_for(pl)
+            hi_bit = (cid & tb_hi) != 0
+            bit = ((lidx & tmask_lo) != 0) | hi_bit
+            fre = jnp.where(bit, d1re, d0re)
+            fim = jnp.where(bit, d1im, d0im)
+            active = (lidx & lo_cmask) == lo_cval
+            fre = jnp.where(active, fre, 1.0)
+            fim = jnp.where(active, fim, 0.0)
+            out = gk.cmul(fre, fim, pl)
+            nc, ns = _comp_rows_f(_planes_to_rows(out, block), rot,
+                                  qmax, cdt)
+            # exactness: a chunk whose factor is constant 1 (target
+            # above the chunk selecting a unit diagonal, no low
+            # controls) must keep its codes bit-for-bit
+            cf_re = jnp.where(hi_bit, d1re, d0re)
+            cf_im = jnp.where(hi_bit, d1im, d0im)
+            ident = ((tmask_lo == 0) & (lo_cmask == 0)
+                     & (cf_re == 1.0) & (cf_im == 0.0))
+            sel = ((cid & hi_cmask) == hi_cval) & ~ident
+            return jnp.where(sel, nc, cc), jnp.where(sel, ns, ss)
+
+        cids = cid0 + jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+        return jax.lax.map(body, (cids, codes3, scales2))
+
+    return run
+
+
+def _mk_phase_split(ca, block, cdt, qmax, body_fn):
+    def run(codes3, scales2, rot, rot_t, cid0, *targs):
+        def body(args):
+            cid, cc, ss = args
+            pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax), block)
+            lidx = gk.iota_for(pl)
+            fre, fim = body_fn(jnp, cid, lidx, ca, *targs)
+            out = gk.cmul(fre, fim, pl)
+            return _comp_rows_f(_planes_to_rows(out, block), rot, qmax, cdt)
+
+        cids = cid0 + jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+        return jax.lax.map(body, (cids, codes3, scales2))
+
+    return run
+
+
+def _mk_prob_mask(ca, block, qmax):
+    def run(codes3, scales2, rot_t, mask_lo, val_lo, mask_hi, val_hi, cid0):
+        def body(args):
+            cid, cc, ss = args
+            pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax), block)
+            lidx = gk.iota_for(pl)
+            ok = (((lidx & mask_lo) == val_lo)
+                  & ((cid & mask_hi) == val_hi))
+            p = pl[0] ** 2 + pl[1] ** 2
+            return jnp.sum(jnp.where(ok, p, 0.0))
+
+        cids = cid0 + jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+        return jnp.sum(jax.lax.map(body, (cids, codes3, scales2)))
+
+    return run
+
+
+def _mk_collapse(ca, block, cdt, qmax):
+    def run(codes3, scales2, rot, rot_t, mask_lo, val_lo,
+            mask_hi, val_hi, scale, cid0):
+        def body(args):
+            cid, cc, ss = args
+            pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax), block)
+            lidx = gk.iota_for(pl)
+            keep = (((lidx & mask_lo) == val_lo)
+                    & ((cid & mask_hi) == val_hi))
+            pl = jnp.where(keep, pl * scale, jnp.zeros((), pl.dtype))
+            return _comp_rows_f(_planes_to_rows(pl, block), rot, qmax, cdt)
+
+        cids = cid0 + jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+        return jax.lax.map(body, (cids, codes3, scales2))
+
+    return run
+
+
+def _mk_collapse_scales():
+    def run(scales2, mask_hi, val_hi, scale, cid0):
+        cids = cid0 + jnp.arange(scales2.shape[0], dtype=gk.IDX_DTYPE)
+        sel = (cids & mask_hi) == val_hi
+        return jnp.where(sel[:, None], scales2 * scale,
+                         jnp.zeros((), scales2.dtype))
+
+    return run
+
+
+_ZERO = 0  # cid0 for the single-device engine (weak-typed int32 operand)
+
+
 class QEngineTurboQuant(QEngineTPU):
     """Dense ket resident as rotated b-bit block codes (lossy)."""
 
@@ -151,10 +322,11 @@ class QEngineTurboQuant(QEngineTPU):
         bp = int(block_pow if block_pow is not None
                  else os.environ.get("QRACK_TURBO_BLOCK_POW",
                                      tq.DEFAULT_BLOCK_POW))
-        self._tq_block_pow = min(bp, qubit_count)
+        self._tq_block_pow = min(bp, self._max_chunk_pow(qubit_count))
         cq = int(chunk_qb if chunk_qb is not None
                  else os.environ.get("QRACK_TURBOQUANT_CHUNK_QB", "20"))
-        self._tq_chunk_pow = max(self._tq_block_pow, min(cq, qubit_count))
+        self._tq_chunk_pow = max(self._tq_block_pow,
+                                 min(cq, self._max_chunk_pow(qubit_count)))
         self._tq_seed = seed_rot
         d = 1 << self._tq_block_pow
         self._rot = jnp.asarray(tq.rotation_matrix(2 * d, seed_rot))
@@ -169,6 +341,11 @@ class QEngineTurboQuant(QEngineTPU):
     # ------------------------------------------------------------------
     # compressed <-> planes
     # ------------------------------------------------------------------
+
+    def _max_chunk_pow(self, qubit_count: int) -> int:
+        """Largest legal chunk power at this width (the sharded subclass
+        subtracts its page bits so every page owns >= 1 chunk)."""
+        return qubit_count
 
     @property
     def _block(self) -> int:
@@ -216,16 +393,21 @@ class QEngineTurboQuant(QEngineTPU):
             self._scales = None
             return
         # width may have changed (compose/decompose/allocate funnel
-        # through the fallback): re-derive the block layout
+        # through the fallback): re-derive the block layout from the
+        # planes WITHOUT touching qubit_count — QEngine's structure ops
+        # adjust it themselves after the kernel, so mutating it here
+        # double-counted the width change (round-4 defect caught by the
+        # sharded Dispose regression test)
         n_amps = planes.shape[-1]
-        self.qubit_count = int(round(math.log2(n_amps)))
-        if self._tq_block_pow > self.qubit_count:
-            self._tq_block_pow = self.qubit_count
+        n_new = int(round(math.log2(n_amps)))
+        max_cp = self._max_chunk_pow(n_new)
+        if self._tq_block_pow > max_cp:
+            self._tq_block_pow = max_cp
             d = 1 << self._tq_block_pow
             self._rot = jnp.asarray(tq.rotation_matrix(2 * d, self._tq_seed))
             self._rot_t = self._rot.T
         self._tq_chunk_pow = max(self._tq_block_pow,
-                                 min(self._tq_chunk_pow, self.qubit_count))
+                                 min(self._tq_chunk_pow, max_cp))
         self._compress_planes(planes)
 
     # ------------------------------------------------------------------
@@ -274,77 +456,26 @@ class QEngineTurboQuant(QEngineTPU):
     # ------------------------------------------------------------------
 
     def _p_gate_low(self, target: int):
-        ca, block = self._tq_chunk_pow, self._block
-        cdt, qmax = self._code_np, self._qmax
+        run = _mk_gate_low(self._tq_chunk_pow, self._block, self._code_np,
+                           self._qmax, target)
 
         def build():
-            def run(codes3, scales2, rot, rot_t, mp,
-                    hi_cmask, hi_cval, lo_cmask, lo_cval):
-                def body(args):
-                    cid, cc, ss = args
-                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
-                                         block)
-                    out = gk.apply_2x2(pl, mp, ca, target, lo_cmask, lo_cval)
-                    nc, ns = _comp_rows_f(_planes_to_rows(out, block), rot,
-                                          qmax, cdt)
-                    sel = (cid & hi_cmask) == hi_cval
-                    return jnp.where(sel, nc, cc), jnp.where(sel, ns, ss)
-
-                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
-                return jax.lax.map(body, (cids, codes3, scales2))
-
-            return jax.jit(run, donate_argnums=(0, 1))
+            return jax.jit(
+                lambda c3, s2, rot, rot_t, mp, hm, hv, lm, lv:
+                run(c3, s2, rot, rot_t, mp, hm, hv, lm, lv, _ZERO),
+                donate_argnums=(0, 1))
 
         return _program(("tq_low", self._layout_key(), target), build)
 
     def _p_gate_pair(self, tb_pos: int):
-        ca, block = self._tq_chunk_pow, self._block
-        cdt, qmax = self._code_np, self._qmax
+        run = _mk_gate_pair(self._tq_chunk_pow, self._block, self._code_np,
+                            self._qmax, tb_pos)
 
         def build():
-            def run(codes3, scales2, rot, rot_t, mp,
-                    hi_cmask, hi_cval, lo_cmask, lo_cval):
-                C, cb, twoD = codes3.shape
-                lo_n = 1 << tb_pos
-                hi_n = C // (2 * lo_n)
-                # chunk id bits [hi | pair-bit | lo]: expose the pair
-                # axis, map over (hi, lo) pairs
-                c5 = (codes3.reshape(hi_n, 2, lo_n, cb, twoD)
-                      .transpose(1, 0, 2, 3, 4).reshape(2, C // 2, cb, twoD))
-                s4 = (scales2.reshape(hi_n, 2, lo_n, cb)
-                      .transpose(1, 0, 2, 3).reshape(2, C // 2, cb))
-
-                def body(args):
-                    pid, cca, ccb, ssa, ssb = args
-                    lpart = pid & (lo_n - 1)
-                    cid_a = ((pid >> tb_pos) << (tb_pos + 1)) | lpart
-                    a = _rows_to_planes(_dec_rows_f(cca, ssa, rot_t, qmax),
-                                        block)
-                    b = _rows_to_planes(_dec_rows_f(ccb, ssb, rot_t, qmax),
-                                        block)
-                    na, nb = _pair_mix_f(a, b, mp, lo_cmask, lo_cval)
-                    nca, nsa = _comp_rows_f(_planes_to_rows(na, block), rot,
-                                            qmax, cdt)
-                    ncb, nsb = _comp_rows_f(_planes_to_rows(nb, block), rot,
-                                            qmax, cdt)
-                    # controls never sit on the target bit, so the hi
-                    # test is identical for both pair halves
-                    sel = (cid_a & hi_cmask) == hi_cval
-                    return (jnp.where(sel, nca, cca),
-                            jnp.where(sel, ncb, ccb),
-                            jnp.where(sel, nsa, ssa),
-                            jnp.where(sel, nsb, ssb))
-
-                pids = jnp.arange(C // 2, dtype=gk.IDX_DTYPE)
-                nca, ncb, nsa, nsb = jax.lax.map(
-                    body, (pids, c5[0], c5[1], s4[0], s4[1]))
-                nc = (jnp.stack([nca, ncb]).reshape(2, hi_n, lo_n, cb, twoD)
-                      .transpose(1, 0, 2, 3, 4).reshape(C, cb, twoD))
-                ns = (jnp.stack([nsa, nsb]).reshape(2, hi_n, lo_n, cb)
-                      .transpose(1, 0, 2, 3).reshape(C, cb))
-                return nc, ns
-
-            return jax.jit(run, donate_argnums=(0, 1))
+            return jax.jit(
+                lambda c3, s2, rot, rot_t, mp, hm, hv, lm, lv:
+                run(c3, s2, rot, rot_t, mp, hm, hv, lm, lv, _ZERO),
+                donate_argnums=(0, 1))
 
         return _program(("tq_pair", self._layout_key(), tb_pos), build)
 
@@ -366,41 +497,14 @@ class QEngineTurboQuant(QEngineTPU):
         self._store3(nc, ns)
 
     def _p_diag(self):
-        ca, block = self._tq_chunk_pow, self._block
-        cdt, qmax = self._code_np, self._qmax
+        run = _mk_diag(self._tq_chunk_pow, self._block, self._code_np,
+                       self._qmax)
 
         def build():
-            def run(codes3, scales2, rot, rot_t, d0re, d0im, d1re, d1im,
-                    tmask_lo, tb_hi, lo_cmask, lo_cval, hi_cmask, hi_cval):
-                def body(args):
-                    cid, cc, ss = args
-                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
-                                         block)
-                    lidx = gk.iota_for(pl)
-                    hi_bit = (cid & tb_hi) != 0
-                    bit = ((lidx & tmask_lo) != 0) | hi_bit
-                    fre = jnp.where(bit, d1re, d0re)
-                    fim = jnp.where(bit, d1im, d0im)
-                    active = (lidx & lo_cmask) == lo_cval
-                    fre = jnp.where(active, fre, 1.0)
-                    fim = jnp.where(active, fim, 0.0)
-                    out = gk.cmul(fre, fim, pl)
-                    nc, ns = _comp_rows_f(_planes_to_rows(out, block), rot,
-                                          qmax, cdt)
-                    # exactness: a chunk whose factor is constant 1
-                    # (target above the chunk selecting a unit diagonal,
-                    # no low controls) must keep its codes bit-for-bit
-                    cf_re = jnp.where(hi_bit, d1re, d0re)
-                    cf_im = jnp.where(hi_bit, d1im, d0im)
-                    ident = ((tmask_lo == 0) & (lo_cmask == 0)
-                             & (cf_re == 1.0) & (cf_im == 0.0))
-                    sel = ((cid & hi_cmask) == hi_cval) & ~ident
-                    return jnp.where(sel, nc, cc), jnp.where(sel, ns, ss)
-
-                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
-                return jax.lax.map(body, (cids, codes3, scales2))
-
-            return jax.jit(run, donate_argnums=(0, 1))
+            return jax.jit(
+                lambda c3, s2, rot, rot_t, *sc:
+                run(c3, s2, rot, rot_t, *sc, _ZERO),
+                donate_argnums=(0, 1))
 
         return _program(("tq_diag", self._layout_key()), build)
 
@@ -420,25 +524,14 @@ class QEngineTurboQuant(QEngineTPU):
         self._store3(nc, ns)
 
     def _p_phase_split(self, key, body_fn, n_targs: int):
-        ca, block = self._tq_chunk_pow, self._block
-        cdt, qmax = self._code_np, self._qmax
+        run = _mk_phase_split(self._tq_chunk_pow, self._block, self._code_np,
+                              self._qmax, body_fn)
 
         def build():
-            def run(codes3, scales2, rot, rot_t, *targs):
-                def body(args):
-                    cid, cc, ss = args
-                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
-                                         block)
-                    lidx = gk.iota_for(pl)
-                    fre, fim = body_fn(jnp, cid, lidx, ca, *targs)
-                    out = gk.cmul(fre, fim, pl)
-                    return _comp_rows_f(_planes_to_rows(out, block), rot,
-                                        qmax, cdt)
-
-                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
-                return jax.lax.map(body, (cids, codes3, scales2))
-
-            return jax.jit(run, donate_argnums=(0, 1))
+            return jax.jit(
+                lambda c3, s2, rot, rot_t, *targs:
+                run(c3, s2, rot, rot_t, _ZERO, *targs),
+                donate_argnums=(0, 1))
 
         if key is None:  # unkeyed generic fn: trace per call
             return build()
@@ -471,24 +564,11 @@ class QEngineTurboQuant(QEngineTPU):
         self._store3(nc, ns)
 
     def _p_prob_mask(self):
-        ca, block, qmax = self._tq_chunk_pow, self._block, self._qmax
+        run = _mk_prob_mask(self._tq_chunk_pow, self._block, self._qmax)
 
         def build():
-            def run(codes3, scales2, rot_t, mask_lo, val_lo, mask_hi, val_hi):
-                def body(args):
-                    cid, cc, ss = args
-                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
-                                         block)
-                    lidx = gk.iota_for(pl)
-                    ok = (((lidx & mask_lo) == val_lo)
-                          & ((cid & mask_hi) == val_hi))
-                    p = pl[0] ** 2 + pl[1] ** 2
-                    return jnp.sum(jnp.where(ok, p, 0.0))
-
-                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
-                return jnp.sum(jax.lax.map(body, (cids, codes3, scales2)))
-
-            return jax.jit(run)
+            return jax.jit(lambda c3, s2, rot_t, ml, vl, mh, vh:
+                           run(c3, s2, rot_t, ml, vl, mh, vh, _ZERO))
 
         return _program(("tq_probmask", self._layout_key()), build)
 
@@ -501,40 +581,23 @@ class QEngineTurboQuant(QEngineTPU):
         return min(max(total, 0.0), 1.0)
 
     def _p_collapse(self):
-        ca, block = self._tq_chunk_pow, self._block
-        cdt, qmax = self._code_np, self._qmax
+        run = _mk_collapse(self._tq_chunk_pow, self._block, self._code_np,
+                           self._qmax)
 
         def build():
-            def run(codes3, scales2, rot, rot_t, mask_lo, val_lo,
-                    mask_hi, val_hi, scale):
-                def body(args):
-                    cid, cc, ss = args
-                    pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax),
-                                         block)
-                    lidx = gk.iota_for(pl)
-                    keep = (((lidx & mask_lo) == val_lo)
-                            & ((cid & mask_hi) == val_hi))
-                    pl = jnp.where(keep, pl * scale,
-                                   jnp.zeros((), pl.dtype))
-                    return _comp_rows_f(_planes_to_rows(pl, block), rot,
-                                        qmax, cdt)
-
-                cids = jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
-                return jax.lax.map(body, (cids, codes3, scales2))
-
-            return jax.jit(run, donate_argnums=(0, 1))
+            return jax.jit(
+                lambda c3, s2, rot, rot_t, ml, vl, mh, vh, sc:
+                run(c3, s2, rot, rot_t, ml, vl, mh, vh, sc, _ZERO),
+                donate_argnums=(0, 1))
 
         return _program(("tq_collapse", self._layout_key()), build)
 
     def _p_collapse_scales(self):
-        def build():
-            def run(scales2, mask_hi, val_hi, scale):
-                cids = jnp.arange(scales2.shape[0], dtype=gk.IDX_DTYPE)
-                sel = (cids & mask_hi) == val_hi
-                return jnp.where(sel[:, None], scales2 * scale,
-                                 jnp.zeros((), scales2.dtype))
+        run = _mk_collapse_scales()
 
-            return jax.jit(run, donate_argnums=(0,))
+        def build():
+            return jax.jit(lambda s2, mh, vh, sc: run(s2, mh, vh, sc, _ZERO),
+                           donate_argnums=(0,))
 
         return _program(("tq_collapse_s", self._layout_key()), build)
 

@@ -22,6 +22,8 @@ QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
   "cpu"                QEngineCPU host oracle
   "sparse"             QEngineSparse map-style sparse state vector
   "turboquant"         QEngineTurboQuant block-compressed resident ket
+  "turboquant_pager"   QPagerTurboQuant compressed ket sharded over the
+                       device mesh (compressed ICI pair exchange)
 
 create_quantum_interface(layers, n) composes them top-down; OPTIMAL is
 ["unit", "stabilizer_hybrid", "hybrid"] — the reference's production
@@ -35,7 +37,8 @@ OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
 OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
 _TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt",
-             "bdt_attached", "unit_clifford", "sparse", "turboquant"}
+             "bdt_attached", "unit_clifford", "sparse", "turboquant",
+             "turboquant_pager"}
 
 
 def _terminal_factory(name: str, **opts) -> Callable:
@@ -84,6 +87,10 @@ def _terminal_factory(name: str, **opts) -> Callable:
         from .engines.turboquant import QEngineTurboQuant
 
         return lambda n, **kw: QEngineTurboQuant(n, **{**opts, **kw})
+    if name == "turboquant_pager":
+        from .parallel.turboquant_pager import QPagerTurboQuant
+
+        return lambda n, **kw: QPagerTurboQuant(n, **{**opts, **kw})
     if name == "unit_clifford":
         from .layers.qunitclifford import QUnitClifford
 

@@ -217,11 +217,12 @@ class QUnitMulti(QUnit):
                 if d.capacity_bytes <= 0 or d.free_bytes() >= need_bytes]
         if not fits:
             self._raise_no_fit(need_bytes)
-        # ascending used_bytes breaks the tie among unguarded devices
-        # (free_bytes() == inf for all of them): fresh units still
-        # spread instead of piling onto device 0
-        return max(fits, key=lambda d: (d.free_bytes(), -d.used_bytes,
-                                        d.weight))
+        # ascending used_bytes breaks free-bytes/weight ties (notably
+        # among unguarded devices, where free_bytes() is inf for all):
+        # fresh units spread instead of piling onto device 0, while a
+        # higher-weight device still wins at equal free bytes
+        return max(fits, key=lambda d: (d.free_bytes(), d.weight,
+                                        -d.used_bytes))
 
     def _raise_no_fit(self, need_bytes: int) -> None:
         cap = max((d.capacity_bytes for d in self.devices), default=0)
