@@ -22,25 +22,32 @@ _tried = False
 _lock = threading.Lock()
 
 
-def _build() -> bool:
+def _build_so(src: str, so: str, compiler: str, extra=()) -> bool:
+    """mtime-checked, per-PID-temp + atomic-replace native build (shared
+    by every lazy loader here — the safety properties matter: a stale
+    binary must rebuild, and concurrent first-use from two interpreters
+    must never CDLL a half-written object)."""
     try:
-        src_mtime = os.path.getmtime(_SRC)
-        if os.path.exists(_SO) and os.path.getmtime(_SO) >= src_mtime:
+        src_mtime = os.path.getmtime(src)
+        if os.path.exists(so) and os.path.getmtime(so) >= src_mtime:
             return True
         # no -march=native: a cached .so may outlive the build host's ISA
-        # (SIGILL beats the graceful fallback); per-PID temp avoids
-        # concurrent-build races corrupting the installed object
-        tmp = f"{_SO}.{os.getpid()}.tmp"
+        # (SIGILL beats the graceful fallback)
+        tmp = f"{so}.{os.getpid()}.tmp"
         res = subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
+            [compiler, "-O3", "-shared", "-fPIC", *extra, src, "-o", tmp],
             capture_output=True, timeout=120,
         )
         if res.returncode != 0:
             return False
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
         return True
     except Exception:
         return False
+
+
+def _build() -> bool:
+    return _build_so(_SRC, _SO, "g++")
 
 
 def get_tableau_lib():
@@ -71,3 +78,49 @@ def get_tableau_lib():
         except Exception:
             _lib = None
         return _lib
+
+
+# -- RDRAND/RDSEED hardware entropy (reference: rdrandwrapper.hpp) ------
+
+_HW_SRC = os.path.join(_HERE, "hwrng.c")
+_HW_SO = os.path.join(_HERE, "libqrack_hwrng.so")
+
+_hw_lib = None
+_hw_tried = False
+
+
+def _hw_extra_flags():
+    import platform
+
+    if platform.machine() in ("x86_64", "i686", "AMD64"):
+        return ("-mrdrnd", "-mrdseed")
+    return ()
+
+
+def get_hwrng_lib():
+    """Bound RDRAND wrapper library, or None (os.urandom fallback)."""
+    global _hw_lib, _hw_tried
+    if _hw_lib is not None or _hw_tried:
+        return _hw_lib
+    with _lock:
+        if _hw_lib is not None or _hw_tried:
+            return _hw_lib
+        _hw_tried = True
+        if os.environ.get("QRACK_TPU_NO_NATIVE"):
+            return None
+        if not _build_so(_HW_SRC, _HW_SO, "gcc", _hw_extra_flags()):
+            return None
+        try:
+            lib = ctypes.CDLL(_HW_SO)
+            lib.qrack_hw_rdrand_supported.restype = ctypes.c_int
+            lib.qrack_hw_rdseed_supported.restype = ctypes.c_int
+            lib.qrack_rdrand64.restype = ctypes.c_int
+            lib.qrack_rdrand64.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+            lib.qrack_rdseed64.restype = ctypes.c_int
+            lib.qrack_rdseed64.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+            lib.qrack_rdrand_fill.restype = ctypes.c_int
+            lib.qrack_rdrand_fill.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            _hw_lib = lib if lib.qrack_hw_rdrand_supported() else None
+        except Exception:
+            _hw_lib = None
+        return _hw_lib

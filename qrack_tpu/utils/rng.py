@@ -1,11 +1,13 @@
-"""Deterministic RNG wrapper.
+"""Deterministic RNG wrapper + hardware entropy source.
 
 Replaces the reference's mt19937_64 + hardware RDRAND stack
 (reference: include/common/qrack_types.hpp:157 qrack_rand_gen;
-include/common/rdrandwrapper.hpp). Hardware entropy is drawn from
-os.urandom when no seed is given; with SetRandomSeed the stream is
-exactly reproducible, which the conformance suite relies on for
-CPU-vs-TPU parity (SURVEY.md §4 "TPU-build implication").
+include/common/rdrandwrapper.hpp). Unseeded streams draw their seed
+from the RDRAND instruction through a small native wrapper
+(native/hwrng.c, built lazily; os.urandom fallback when the CPU or
+toolchain lacks it); with SetRandomSeed the stream is exactly
+reproducible, which the conformance suite relies on for CPU-vs-TPU
+parity (SURVEY.md §4 "TPU-build implication").
 """
 
 from __future__ import annotations
@@ -15,6 +17,46 @@ from typing import Optional
 
 import numpy as np
 
+def _hwrng():
+    """The RDRAND wrapper library (lazy mtime-checked build with atomic
+    install + lock in qrack_tpu.native), or None."""
+    from ..native import get_hwrng_lib
+
+    return get_hwrng_lib()
+
+
+def hw_rdrand_supported() -> bool:
+    """True when the RDRAND instruction path is live (reference:
+    RdRandom::SupportsRDRAND, rdrandwrapper.hpp)."""
+    return _hwrng() is not None
+
+
+def hw_entropy_bytes(n: int) -> bytes:
+    """n bytes of entropy: RDRAND instruction when available, else
+    os.urandom (the reference's non-RDRAND fallback)."""
+    lib = _hwrng()
+    if lib is not None:
+        import ctypes
+
+        buf = ctypes.create_string_buffer(n)
+        if lib.qrack_rdrand_fill(buf, n):
+            return buf.raw[:n]
+    return os.urandom(n)
+
+
+def hw_rand64() -> Optional[int]:
+    """One raw RDRAND draw (None when unsupported) — the reference's
+    RdRandom::NextRaw."""
+    import ctypes
+
+    lib = _hwrng()
+    if lib is None:
+        return None
+    v = ctypes.c_uint64()
+    if lib.qrack_rdrand64(ctypes.byref(v)):
+        return int(v.value)
+    return None
+
 
 class QrackRandom:
     def __init__(self, seed: Optional[int] = None):
@@ -22,7 +64,7 @@ class QrackRandom:
 
     def seed(self, seed: Optional[int] = None) -> None:
         if seed is None:
-            seed = int.from_bytes(os.urandom(8), "little")
+            seed = int.from_bytes(hw_entropy_bytes(8), "little")
         self._seed = seed
         self._gen = np.random.Generator(np.random.PCG64(seed))
 

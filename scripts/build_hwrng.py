@@ -1,0 +1,24 @@
+"""Build libqrack_hwrng.so — RDRAND/RDSEED hardware-entropy wrappers.
+
+Usage: python scripts/build_hwrng.py
+
+Thin CLI over the package's shared lazy builder (qrack_tpu.native:
+mtime-checked, per-PID temp + atomic replace); qrack_tpu.utils.rng
+builds the same object automatically on first hardware-entropy request.
+Reference analogue: include/common/rdrandwrapper.hpp.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qrack_tpu import native  # noqa: E402
+
+if __name__ == "__main__":
+    ok = native._build_so(native._HW_SRC, native._HW_SO, "gcc",
+                          native._hw_extra_flags())
+    if not ok:
+        print("build failed", file=sys.stderr)
+        sys.exit(1)
+    print(native._HW_SO)

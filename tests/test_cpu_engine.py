@@ -302,3 +302,20 @@ def test_sum_sqr_diff_phase_invariant():
     b.H(0); b.CNOT(0, 1)
     assert a.SumSqrDiff(b) < 1e-9
     assert a.ApproxCompare(b, 1e-6)
+
+
+def test_hardware_entropy_source():
+    """RDRAND instruction path (reference: rdrandwrapper.hpp NextRaw /
+    SupportsRDRAND): real hardware draws when the CPU supports it, and
+    the os.urandom fallback keeps unseeded streams working regardless."""
+    from qrack_tpu.utils import rng as rngmod
+
+    b1 = rngmod.hw_entropy_bytes(32)
+    b2 = rngmod.hw_entropy_bytes(32)
+    assert len(b1) == 32 and b1 != b2
+    if rngmod.hw_rdrand_supported():
+        draws = {rngmod.hw_rand64() for _ in range(8)}
+        assert None not in draws and len(draws) == 8  # 64-bit draws never collide
+    # unseeded streams remain constructible + distinct
+    a, b = rngmod.QrackRandom(), rngmod.QrackRandom()
+    assert a.rand() != b.rand()
