@@ -217,12 +217,17 @@ class QUnitMulti(QUnit):
                 if d.capacity_bytes <= 0 or d.free_bytes() >= need_bytes]
         if not fits:
             self._raise_no_fit(need_bytes)
-        # ascending used_bytes breaks free-bytes/weight ties (notably
-        # among unguarded devices, where free_bytes() is inf for all):
-        # fresh units spread instead of piling onto device 0, while a
-        # higher-weight device still wins at equal free bytes
-        return max(fits, key=lambda d: (d.free_bytes(), d.weight,
-                                        -d.used_bytes))
+        # Unguarded devices all report free_bytes()==inf, so byte-spread
+        # must outrank weight there or every fresh unit piles onto the
+        # single heaviest device (this path is for fresh 1q units, where
+        # spread matters more than capability — see docstring).  Guarded
+        # devices keep the capability order: free bytes, then weight,
+        # with used-bytes as the final tie-break.
+        return max(fits, key=lambda d: (
+            d.free_bytes(),
+            -d.used_bytes if d.capacity_bytes <= 0 else 0,
+            d.weight,
+            -d.used_bytes))
 
     def _raise_no_fit(self, need_bytes: int) -> None:
         cap = max((d.capacity_bytes for d in self.devices), default=0)
