@@ -56,6 +56,12 @@ _j_multishot = jax.jit(gk.multishot_mask_keys)
 _j_uc_2x2 = jax.jit(gk.uc_2x2, static_argnums=(2, 3, 4), donate_argnums=(0,))
 
 
+# one-chip dense f32 width ceiling: int32 flat indices + HBM for
+# (2, 2^n) planes with gate transients (single source — the compressed
+# engines derive their higher caps from it)
+MAX_DENSE_QB = 30
+
+
 class QEngineTPU(QEngine):
     """Dense ket on one accelerator device (TPU; CPU backend in tests)."""
 
@@ -96,9 +102,10 @@ class QEngineTPU(QEngine):
     # ------------------------------------------------------------------
 
     def _check_capacity(self, qubit_count: int) -> None:
-        # int32 index math and one-chip HBM both cap a dense shard at 30
-        # qubits; Compose/Allocate growth funnels through this too.
-        if qubit_count > 30:
+        # int32 index math and one-chip HBM both cap a dense shard at
+        # MAX_DENSE_QB qubits; Compose/Allocate growth funnels through
+        # this too.
+        if qubit_count > MAX_DENSE_QB:
             raise MemoryError(
                 f"QEngineTPU width {qubit_count} exceeds a single dense shard; "
                 "use the QPager/QUnit layers above this engine"

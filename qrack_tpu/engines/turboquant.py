@@ -347,6 +347,26 @@ class QEngineTurboQuant(QEngineTPU):
         subtracts its page bits so every page owns >= 1 chunk)."""
         return qubit_count
 
+    def _compressed_cap(self) -> int:
+        """Per-device width ceiling: codes store 4x (int8) / 2x (int16)
+        more amplitudes per HBM byte than f32 planes — +2 / +1 qubits
+        over the dense cap.  The CHUNKED kernels index with split
+        (chunk, local) int32 pairs, so they are not int32-bound past
+        the dense limit (ADVICE r4 fix); the dense `_state` fallback IS
+        still bound, and its property guard enforces that separately."""
+        from .tpu import MAX_DENSE_QB
+
+        return MAX_DENSE_QB + (2 if self._tq_bits <= 8 else 1)
+
+    def _check_capacity(self, qubit_count: int) -> None:
+        cap = self._compressed_cap()
+        if qubit_count > cap:
+            raise MemoryError(
+                f"QEngineTurboQuant width {qubit_count} exceeds the "
+                f"compressed single-device cap ({cap} at "
+                f"{self._tq_bits}-bit codes); use QPagerTurboQuant or "
+                "the pager/QUnit layers above this engine")
+
     @property
     def _block(self) -> int:
         return 1 << self._tq_block_pow
@@ -382,6 +402,18 @@ class QEngineTurboQuant(QEngineTPU):
     def _state(self):
         if self._codes is None:
             return None
+        from .tpu import MAX_DENSE_QB
+
+        if self.qubit_count > MAX_DENSE_QB:
+            # beyond the dense cap, full f32 planes exceed HBM AND the
+            # dense kernels' int32 flat indices — the chunked op set
+            # (gates, prob, collapse, measurement, SetPermutation) is
+            # the only sound surface at these widths
+            raise MemoryError(
+                f"this operation needs the dense f32 fallback plane, "
+                f"which is unsound past {MAX_DENSE_QB} qubits "
+                f"(width {self.qubit_count}); stay on the chunked op "
+                "set or use QPagerTurboQuant / narrower registers")
         self.peak_transient_amps = max(self.peak_transient_amps,
                                        1 << self.qubit_count)
         return self._decompress_planes()
@@ -647,6 +679,42 @@ class QEngineTurboQuant(QEngineTPU):
         result = chosen * self._chunk_amps + local
         self.SetPermutation(result)
         return result
+
+    # ------------------------------------------------------------------
+    # codes-native initialization: a basis state occupies ONE block, so
+    # SetPermutation writes that block's rotated one-hot row directly —
+    # no full-width f32 materialization (the inherited dense path would
+    # transiently allocate 2^n f32 planes, capping the engine at f32
+    # widths and defeating the 4x-wider-ket point; reference: the
+    # compressed storage is written in place, statevector_turboquant.hpp)
+    # ------------------------------------------------------------------
+
+    def _put_codes(self, codes, scales) -> None:
+        """Install resident arrays (sharded subclass overrides; the
+        base honors an explicit device pin like the dense planes do)."""
+        self._codes = self._put(jnp.asarray(codes))
+        self._scales = self._put(jnp.asarray(scales))
+
+    def SetPermutation(self, perm: int, phase=None) -> None:
+        ph = self._rand_phase() if phase is None else complex(phase)
+        D = self._block
+        n_blocks = max(1, (1 << self.qubit_count) // D)
+        b_idx, d = perm // D, perm % D
+        # rotated one-hot row (re at row-slot d, im at slot D+d), built
+        # DEVICE-side from the resident rotation: only the 2D-float row
+        # ever moves, not an n_blocks-sized host array (at w31/w32 the
+        # host zeros alone would be multiple GiB)
+        row = ph.real * self._rot[d] + ph.imag * self._rot[D + d]
+        scale = jnp.max(jnp.abs(row))
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = tq.qmax(self._tq_bits)
+        row_codes = jnp.round(row / safe * q).astype(self._code_np)
+        codes = (jnp.zeros((n_blocks, 2 * D), dtype=self._code_np)
+                 .at[b_idx].set(row_codes))
+        scales = (jnp.zeros((n_blocks,), dtype=jnp.float32)
+                  .at[b_idx].set(scale.astype(jnp.float32)))
+        self._put_codes(codes, scales)
+        self.running_norm = 1.0
 
     # ------------------------------------------------------------------
     # serialization: seed + scales + codes (reference stores the seed,

@@ -88,6 +88,16 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
     def _local_chunk_bits(self) -> int:
         return self.qubit_count - self._tq_chunk_pow - self.g_bits
 
+    def _check_capacity(self, qubit_count: int) -> None:
+        # per-DEVICE compressed cap, multiplied across the mesh
+        cap = self._compressed_cap() + self.g_bits
+        if qubit_count > cap:
+            raise MemoryError(
+                f"QPagerTurboQuant width {qubit_count} exceeds "
+                f"{self.n_pages} devices' compressed capacity ({cap} at "
+                f"{self._tq_bits}-bit codes); add devices or layer "
+                "QUnit above")
+
     def _maybe_repage(self, width: int) -> None:
         """Dispose/Decompose can shrink the width below one chunk per
         page; re-mesh onto a device prefix so every page keeps >= 1
@@ -112,6 +122,13 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
         super()._compress_planes(planes)
         self._codes = jax.device_put(self._codes, self._code_sharding)
         self._scales = jax.device_put(self._scales, self._scale_sharding)
+
+    def _put_codes(self, codes, scales) -> None:
+        # codes-native SetPermutation lands sharded (chunk-major rows)
+        self._codes = jax.device_put(jnp.asarray(codes),
+                                     self._code_sharding)
+        self._scales = jax.device_put(jnp.asarray(scales),
+                                      self._scale_sharding)
 
     def GetDeviceList(self):
         return [int(d.id) for d in self.mesh.devices.flat]

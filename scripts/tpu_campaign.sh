@@ -63,7 +63,7 @@ name, out = sys.argv[1], sys.argv[2]
 ts = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 for ln in open(out, errors="replace"):
     ln = ln.strip()
-    if ln.startswith('{"metric"'):
+    if ln.startswith('{"metric"') or ln.startswith('{"gate"'):
         try:
             d = json.loads(ln)
         except ValueError:
@@ -83,12 +83,12 @@ run_stage() {  # name timeout_s command...
   cat "$out" >> "$LOG"
   {
     echo "### stage $name @ $(date -u +%FT%TZ) rc=$rc"
-    grep -E '^\{"metric"|_OK$|^HONEST|^devget_empty|^chain|^one_apply|^total_prob|^k1_|^warm ok|passed|^THRESH|^GATE' "$out"
+    grep -E '^\{"metric"|^\{"gate"|_OK$|^HONEST|^devget_empty|^chain|^one_apply|^total_prob|^k1_|^warm ok|passed|^THRESH|^GATE' "$out"
   } >> "$ELOG"
   append_evidence "$name" "$out"
   # success = real evidence lines, or an all-green pytest stage (rc==0
   # guards against 'N failed, M passed' matching on the substring)
-  if grep -qE '^\{"metric"|_OK$' "$out" \
+  if grep -qE '^\{"metric"|^\{"gate"|_OK$' "$out" \
       || { [ "$rc" -eq 0 ] && grep -q ' passed' "$out" \
            && ! grep -q 'failed' "$out"; }; then
     FAILS=0
@@ -169,6 +169,8 @@ run_stage xeb_w22 300 env QRACK_BENCH=xeb QRACK_BENCH_QB=22 \
 
 # ---- per-gate microbench + hbm-limit width ------------------------------
 run_stage microbench_w22 480 python scripts/microbench.py 22 8
+run_stage turboquant_w28 600 python scripts/turboquant_bench.py 28 8 4 3
+run_stage turboquant_w31 600 python scripts/turboquant_bench.py 31 8 2 3
 run_stage qft_w30 620 env QRACK_BENCH=qft QRACK_BENCH_QB=30 \
   QRACK_BENCH_QB_FIRST=30 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
   QRACK_BENCH_BUDGET=580 python bench.py

@@ -1,0 +1,87 @@
+"""Per-gate wall for the block-compressed resident ket (VERDICT r4 #4
+done-criterion: measured per-gate cost at int8 w>=28 showing the O(1)-
+dispatch chunked programs).
+
+Times K chained engine-level gates on QEngineTurboQuant — chunk-local H,
+cross-chunk CNOT (pair path), and a diagonal T above the chunk boundary —
+synced through a real 1-element device read of the scales array
+(`block_until_ready` is dishonest over the axon relay,
+docs/TPU_EVIDENCE.md).  Implied compressed-HBM traffic assumes one
+read+write of the resident codes+scales per gate.
+
+Usage: python scripts/turboquant_bench.py [width] [bits] [chain] [samples]
+Emits one JSON line per gate kind.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import numpy as np
+
+    import jax
+
+    w = int(sys.argv[1]) if len(sys.argv) > 1 else 28
+    bits = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    chain = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    samples = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+
+    from qrack_tpu.engines.turboquant import QEngineTurboQuant
+    from qrack_tpu.utils.rng import QrackRandom
+
+    eng = QEngineTurboQuant(w, bits=bits, rng=QrackRandom(7),
+                            rand_global_phase=False)
+    eng.H(0)  # spread a little mass so gates do real work
+
+    def sync() -> None:
+        np.asarray(jax.device_get(eng._scales[:1]))
+
+    def empty_sync_s(reps: int = 3) -> float:
+        out = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            sync()
+            out.append(time.perf_counter() - t0)
+        return min(out)
+
+    res_bytes = eng.resident_bytes()
+    gates = [
+        ("h_local", lambda: eng.H(1)),
+        ("cnot_cross_chunk", lambda: eng.CNOT(0, w - 1)),
+        ("t_above_chunk", lambda: eng.T(w - 1)),
+        ("cz_mixed", lambda: eng.CZ(1, w - 1)),
+    ]
+    for name, g in gates:
+        g()          # warm/compile — excluded
+        sync()
+        s0 = empty_sync_s()
+        times = []
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            for _ in range(chain):
+                g()
+            sync()
+            times.append(max(time.perf_counter() - t0 - s0, 0.0) / chain)
+        avg = sum(times) / len(times)
+        print(json.dumps({
+            "gate": name, "width": w, "bits": bits,
+            "wall_s": round(avg, 8), "min_s": round(min(times), 8),
+            "std_s": round(statistics.pstdev(times), 8),
+            "chain": chain, "samples": samples,
+            "sync_overhead_s": round(s0, 8),
+            "resident_bytes": int(res_bytes),
+            "n_chunks": eng._n_chunks(),
+            "implied_codes_gbps": round(
+                2 * res_bytes / max(avg, 1e-12) / 1e9, 1),
+            "platform": jax.default_backend(),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

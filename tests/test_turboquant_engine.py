@@ -369,3 +369,44 @@ def test_gate_is_constant_dispatches():
         assert calls["n"] == 1
     finally:
         tqe._program = orig
+
+
+def test_set_permutation_is_codes_native():
+    """SetPermutation writes the one occupied block's rotated row
+    directly — no full-width f32 planes (required for widths beyond the
+    dense single-device cap)."""
+    from qrack_tpu.engines import turboquant as tqe
+
+    q = QEngineTurboQuant(8, bits=16, chunk_qb=4, block_pow=3,
+                          rng=QrackRandom(40), rand_global_phase=False)
+    # a fresh init must never route through the f32 fallback plane
+    called = {"n": 0}
+    orig = type(q)._compress_planes
+
+    def spy(self, planes):
+        called["n"] += 1
+        return orig(self, planes)
+
+    type(q)._compress_planes = spy
+    try:
+        q.SetPermutation(0b1011_0010)
+    finally:
+        type(q)._compress_planes = orig
+    assert called["n"] == 0
+    st = q.GetQuantumState()
+    assert abs(st[0b1011_0010]) == pytest.approx(1.0, abs=1e-3)
+    assert np.sum(np.abs(st) ** 2) == pytest.approx(1.0, abs=1e-3)
+    # explicit phase survives
+    q.SetPermutation(3, phase=1j)
+    assert q.GetAmplitude(3) == pytest.approx(1j, abs=1e-3)
+
+
+def test_width_caps_scale_with_bits_and_pages():
+    from qrack_tpu.parallel.turboquant_pager import QPagerTurboQuant
+
+    with pytest.raises(MemoryError):
+        QEngineTurboQuant(33, bits=8, rng=QrackRandom(41))
+    with pytest.raises(MemoryError):
+        QEngineTurboQuant(32, bits=16, rng=QrackRandom(42))
+    with pytest.raises(MemoryError):
+        QPagerTurboQuant(36, bits=8, n_pages=2, rng=QrackRandom(43))
