@@ -366,6 +366,17 @@ class QEngineTurboQuant(QEngineTPU):
                 f"compressed single-device cap ({cap} at "
                 f"{self._tq_bits}-bit codes); use QPagerTurboQuant or "
                 "the pager/QUnit layers above this engine")
+        # GROWTH (Compose/Allocate on a live engine) routes through the
+        # dense f32 fallback plane, which is only sound to MAX_DENSE_QB;
+        # fresh construction is codes-native and may use the full cap
+        from .tpu import MAX_DENSE_QB
+
+        if (qubit_count > MAX_DENSE_QB
+                and getattr(self, "_codes", None) is not None):
+            raise MemoryError(
+                f"growing a compressed engine past {MAX_DENSE_QB} qubits "
+                "requires the dense fallback plane (unsound at that "
+                "width); construct at the target width instead")
 
     @property
     def _block(self) -> int:
@@ -411,9 +422,11 @@ class QEngineTurboQuant(QEngineTPU):
             # the only sound surface at these widths
             raise MemoryError(
                 f"this operation needs the dense f32 fallback plane, "
-                f"which is unsound past {MAX_DENSE_QB} qubits "
-                f"(width {self.qubit_count}); stay on the chunked op "
-                "set or use QPagerTurboQuant / narrower registers")
+                f"which is unsound past {MAX_DENSE_QB} qubits (width "
+                f"{self.qubit_count}): flat int32 indices overflow and "
+                "the planes exceed HBM.  At this width the chunked op "
+                "set (gates, prob, collapse, measurement, "
+                "SetPermutation) is the supported surface")
         self.peak_transient_amps = max(self.peak_transient_amps,
                                        1 << self.qubit_count)
         return self._decompress_planes()
@@ -432,6 +445,14 @@ class QEngineTurboQuant(QEngineTPU):
         # sharded Dispose regression test)
         n_amps = planes.shape[-1]
         n_new = int(round(math.log2(n_amps)))
+        from .tpu import MAX_DENSE_QB
+
+        if n_new > MAX_DENSE_QB:
+            # belt to the growth guard in _check_capacity: full-width
+            # f32 planes past the dense cap are unsound (HBM + int32)
+            raise MemoryError(
+                f"dense fallback write at width {n_new} is unsound past "
+                f"{MAX_DENSE_QB} qubits on the compressed engine")
         max_cp = self._max_chunk_pow(n_new)
         if self._tq_block_pow > max_cp:
             self._tq_block_pow = max_cp
@@ -689,11 +710,32 @@ class QEngineTurboQuant(QEngineTPU):
     # compressed storage is written in place, statevector_turboquant.hpp)
     # ------------------------------------------------------------------
 
-    def _put_codes(self, codes, scales) -> None:
-        """Install resident arrays (sharded subclass overrides; the
-        base honors an explicit device pin like the dense planes do)."""
-        self._codes = self._put(jnp.asarray(codes))
-        self._scales = self._put(jnp.asarray(scales))
+    def _perm_out_shardings(self):
+        """Output placement for the SetPermutation program (sharded
+        subclass returns its mesh shardings)."""
+        if self._device is not None:
+            from jax.sharding import SingleDeviceSharding
+
+            return (SingleDeviceSharding(self._device),) * 2
+        return None
+
+    def _p_setperm(self, n_blocks: int, twoD: int):
+        cdt = self._code_np
+        sh = self._perm_out_shardings()
+
+        def build():
+            def run(row_codes, scale, b_idx):
+                codes = (jnp.zeros((n_blocks, twoD), dtype=cdt)
+                         .at[b_idx].set(row_codes))
+                scales = (jnp.zeros((n_blocks,), dtype=jnp.float32)
+                          .at[b_idx].set(scale.astype(jnp.float32)))
+                return codes, scales
+
+            kw = {"out_shardings": sh} if sh is not None else {}
+            return jax.jit(run, **kw)
+
+        return _program(("tq_setperm", self._layout_key(),
+                         getattr(self, "_device_id", -1), n_blocks), build)
 
     def SetPermutation(self, perm: int, phase=None) -> None:
         ph = self._rand_phase() if phase is None else complex(phase)
@@ -701,19 +743,18 @@ class QEngineTurboQuant(QEngineTPU):
         n_blocks = max(1, (1 << self.qubit_count) // D)
         b_idx, d = perm // D, perm % D
         # rotated one-hot row (re at row-slot d, im at slot D+d), built
-        # DEVICE-side from the resident rotation: only the 2D-float row
-        # ever moves, not an n_blocks-sized host array (at w31/w32 the
-        # host zeros alone would be multiple GiB)
+        # DEVICE-side from the resident rotation.  The zero-fill +
+        # scatter runs inside a jitted program with explicit output
+        # shardings, so the codes materialize directly where they live
+        # (per-shard on the pager's mesh) — no full-size default-device
+        # transient, which at w32+ would alone exceed one chip's HBM.
         row = ph.real * self._rot[d] + ph.imag * self._rot[D + d]
         scale = jnp.max(jnp.abs(row))
         safe = jnp.where(scale > 0, scale, 1.0)
         q = tq.qmax(self._tq_bits)
         row_codes = jnp.round(row / safe * q).astype(self._code_np)
-        codes = (jnp.zeros((n_blocks, 2 * D), dtype=self._code_np)
-                 .at[b_idx].set(row_codes))
-        scales = (jnp.zeros((n_blocks,), dtype=jnp.float32)
-                  .at[b_idx].set(scale.astype(jnp.float32)))
-        self._put_codes(codes, scales)
+        self._codes, self._scales = self._p_setperm(n_blocks, 2 * D)(
+            row_codes, scale, jnp.asarray(b_idx, gk.IDX_DTYPE))
         self.running_norm = 1.0
 
     # ------------------------------------------------------------------

@@ -785,36 +785,80 @@ class QBdt(QInterface):
         return q
 
     def Compose(self, other: "QBdt", start=None) -> int:
+        """Insert `other`'s qubits at index `start` (reference: Compose
+        with arbitrary start, include/qinterface.hpp Compose(toCopy,
+        start)).  Tree-native for any start in the tree region: the new
+        index layout [low | other | high] is a SPLICE — at depth
+        `start`, each subtree N (the high factor continuation) is
+        replaced by other's tree with every LEAF terminal redirected to
+        N.  Peak cost O(self nodes * other nodes), never 2^n."""
         if start is None:
             start = self.qubit_count
-        if start != self.qubit_count:
-            raise NotImplementedError("mid-insertion Compose on QBdt")
         o = other if isinstance(other, QBdt) else None
-        if o is not None and not self.attached_qubits and not o.attached_qubits:
-            # graft: replace every LEAF of self with other's root
+        tq = self.tree_qubits
+        if (o is not None and not o.attached_qubits and start <= tq):
             graft_scale, graft_root = self._graft_import(o)
-            memo = {}
+            tail_memo: Dict[tuple, tuple] = {}
 
-            def splice(node):
+            def with_tail(g, tail):
+                """Copy graft subtree g, LEAF terminals -> unit-weight
+                tail (the memo key assumes unit weight — keep it so)."""
+                if g is None:
+                    return 0j, None
+                if g is _Tree.LEAF:
+                    return 1.0 + 0j, tail
+                key = (id(g), id(tail))
+                hit = tail_memo.get(key)
+                if hit is not None:
+                    return hit
+                w0, c0, w1, c1 = g
+                nw0, n0 = with_tail(c0, tail)
+                nw1, n1 = with_tail(c1, tail)
+                out = self._t.node(w0 * nw0, n0, w1 * nw1, n1)
+                tail_memo[key] = out
+                return out
+
+            memo: Dict[tuple, tuple] = {}
+
+            def splice(node, d):
+                if d == start:
+                    # node may be None (zero branch), a terminal (when
+                    # start == tq), or an interior subtree: all become
+                    # the tail under other's grafted levels
+                    if node is None:
+                        return 0j, None
+                    return with_tail(graft_root, node)
                 if node is None:
-                    return None
-                if node is _Tree.LEAF:
-                    return graft_root
-                hit = memo.get(id(node))
+                    return 0j, None
+                key = (id(node), d)  # shared nodes may recur at depths
+                hit = memo.get(key)
                 if hit is not None:
                     return hit
                 w0, c0, w1, c1 = node
-                _, out = self._t.node(w0, splice(c0), w1, splice(c1))
-                memo[id(node)] = out
+                nw0, n0 = splice(c0, d + 1)
+                nw1, n1 = splice(c1, d + 1)
+                out = self._t.node(w0 * nw0, n0, w1 * nw1, n1)
+                memo[key] = out
                 return out
 
-            self.root = splice(self.root)
-            self.scale *= graft_scale
+            w, root = splice(self.root, 0)
+            self.scale *= w * graft_scale
+            self.root = root
             self.qubit_count += other.qubit_count
+            self._maybe_gc()
             return start
+        # attached-region insertion / non-QBdt operand: dense fallback
         other_state = np.asarray(other.GetQuantumState())
-        combined = np.kron(other_state, self.GetQuantumState())
-        self.qubit_count += int(np.log2(len(other_state)))
+        m = int(np.log2(len(other_state)))
+        mine = self.GetQuantumState()
+        if start == self.qubit_count:
+            combined = np.kron(other_state, mine)
+        else:
+            from ..utils.states import compose_states
+
+            combined = compose_states(mine, other_state,
+                                      self.qubit_count, m, start)
+        self.qubit_count += m
         self.SetQuantumState(combined)
         return start
 
@@ -1168,11 +1212,8 @@ class QBdt(QInterface):
         self._maybe_gc()
 
     def Allocate(self, start: int, length: int = 1) -> int:
-        if start != self.qubit_count:
-            raise NotImplementedError("mid-insertion Allocate on QBdt")
         fresh = QBdt(length, rng=self.rng.spawn(), rand_global_phase=False)
-        self.Compose(fresh)
-        return start
+        return self.Compose(fresh, start)
 
     def Clone(self) -> "QBdt":
         c = QBdt(self.qubit_count, attached_qubits=self.attached_qubits,

@@ -40,6 +40,11 @@ class QBdtHybrid(QInterface):
         if attached_qubits is None:
             attached_qubits = int(os.environ.get("QRACK_QBDT_ATTACH_QB", "0"))
         self.attached_qubits = min(max(int(attached_qubits), 0), qubit_count)
+        # before abandoning the tree entirely, try the attached form
+        # once (bottom-half entanglement is exactly what dense leaves
+        # absorb); off via QRACK_QBDT_ADAPTIVE_ATTACH=0
+        self._adaptive_attach = bool(int(os.environ.get(
+            "QRACK_QBDT_ADAPTIVE_ATTACH", "1")))
         self.bdt: Optional[QBdt] = QBdt(
             qubit_count, init_state=init_state, rng=self.rng.spawn(),
             attached_qubits=self.attached_qubits, **self._kw)
@@ -63,8 +68,44 @@ class QBdtHybrid(QInterface):
         # narrow registers, absolute node budget for wide ones (a wide
         # tree must hand off before it exhausts host memory)
         budget = min(self.ratio * (1 << min(self.qubit_count, 30)), float(1 << 20))
-        if self.bdt.node_count() > budget + 8:
-            self.SwitchToEngine()
+        half_dense = (1 << min(self.qubit_count, 30)) // 2
+        if self.bdt.attached_qubits:
+            # attached trees hold amplitude payloads in their leaves:
+            # stay while they genuinely compress vs a dense ket (same
+            # criterion that admitted the form below)
+            if self.bdt.footprint_amps() <= half_dense:
+                return
+        elif self.bdt.node_count() <= budget + 8:
+            return
+        if (self._adaptive_attach and self.attached_qubits == 0
+                and self.qubit_count <= 26):
+            # one-shot escalation pure-tree -> tree-top/dense-bottom:
+            # costs the same 2^n pass the engine switch would, and wins
+            # whenever the blowup lives in the bottom half (the
+            # "attached beats both pure forms" regime, tests/test_qbdt)
+            state = self.bdt.GetQuantumState()
+            cand = QBdt(self.qubit_count,
+                        attached_qubits=self.qubit_count // 2,
+                        rng=self.rng.spawn(), **self._kw)
+            cand.rand_global_phase = self.rand_global_phase
+            cand.SetQuantumState(state)
+            # adopt when the blowup was concentrated in the bottom half:
+            # the top tree is back under the node budget (per-gate cost
+            # is node-bound — leaves run vectorized kernels) and the
+            # leaves actually compress vs a dense ket
+            if (cand.node_count() <= budget + 8
+                    and cand.footprint_amps() <= half_dense):
+                self.bdt = cand
+                self.attached_qubits = cand.attached_qubits
+                return
+            # attached form failed too: hand the already-materialized
+            # ket straight to the engine
+            self.engine = self._factory(self.qubit_count,
+                                        rng=self.rng.spawn(), **self._kw)
+            self.engine.SetQuantumState(state)
+            self.bdt = None
+            return
+        self.SwitchToEngine()
 
     def MCMtrxPerm(self, controls, mtrx, target, perm) -> None:
         self._live().MCMtrxPerm(controls, mtrx, target, perm)

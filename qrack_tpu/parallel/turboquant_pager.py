@@ -26,6 +26,10 @@ falls back through the inherited `_state` property: the full-ket
 decompress is a plain jitted matmul over the sharded codes, which GSPMD
 partitions across the mesh, and the inherited dense kernels then run
 auto-partitioned — the CombineAndOp-style escape hatch, kept sharded.
+The hatch is only sound up to MAX_DENSE_QB total qubits (the dense
+kernels use flat int32 indices); past that the chunked op set — gates,
+probabilities, collapse, measurement, SetPermutation — is the whole
+legal surface, and fallback ops raise a MemoryError saying so.
 """
 
 from __future__ import annotations
@@ -123,12 +127,9 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
         self._codes = jax.device_put(self._codes, self._code_sharding)
         self._scales = jax.device_put(self._scales, self._scale_sharding)
 
-    def _put_codes(self, codes, scales) -> None:
-        # codes-native SetPermutation lands sharded (chunk-major rows)
-        self._codes = jax.device_put(jnp.asarray(codes),
-                                     self._code_sharding)
-        self._scales = jax.device_put(jnp.asarray(scales),
-                                      self._scale_sharding)
+    def _perm_out_shardings(self):
+        # codes-native SetPermutation materializes per-shard on the mesh
+        return (self._code_sharding, self._scale_sharding)
 
     def GetDeviceList(self):
         return [int(d.id) for d in self.mesh.devices.flat]

@@ -461,3 +461,83 @@ def test_factory_bdt_attached():
                                   rng=QrackRandom(97),
                                   rand_global_phase=False)
     assert q2.attached_qubits == 2
+
+
+# ---------------- mid-insertion Compose / adaptive attach ----------------
+
+
+@pytest.mark.parametrize("start", [0, 2, 4])
+def test_mid_insertion_compose_matches_dense(start, monkeypatch):
+    """Compose at an arbitrary start is a tree splice (reference:
+    Compose(toCopy, start)); state parity with the dense oracle and no
+    dense materialization on the tree path."""
+    n, m = 4, 2
+    q = QBdt(n, rng=QrackRandom(101), rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(101), rand_global_phase=False)
+    for eng in (q, d):
+        eng.H(0); eng.CNOT(0, 1); eng.T(2); eng.RY(0.4, 3)
+    oq = QBdt(m, rng=QrackRandom(102), rand_global_phase=False)
+    od = QEngineCPU(m, rng=QrackRandom(102), rand_global_phase=False)
+    for eng in (oq, od):
+        eng.H(0); eng.CNOT(0, 1); eng.T(1)
+    monkeypatch.setattr(QBdt, "GetQuantumState", lambda *a: (_ for _ in ()).throw(
+        AssertionError("dense path used for a tree splice")))
+    q.Compose(oq, start)
+    monkeypatch.undo()
+    d.Compose(od, start)
+    assert q.qubit_count == n + m
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-7)
+
+
+def test_mid_insertion_compose_attached_self():
+    """Splice below an attached region keeps the leaves on top."""
+    n, att, m = 5, 2, 2
+    q = QBdt(n, attached_qubits=att, rng=QrackRandom(103),
+             rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(103), rand_global_phase=False)
+    for eng in (q, d):
+        eng.H(0); eng.CNOT(0, 4); eng.T(3)
+    oq = QBdt(m, rng=QrackRandom(104), rand_global_phase=False)
+    od = QEngineCPU(m, rng=QrackRandom(104), rand_global_phase=False)
+    for eng in (oq, od):
+        eng.RY(0.7, 0); eng.CNOT(0, 1)
+    q.Compose(oq, 1)
+    d.Compose(od, 1)
+    assert q.attached_qubits == att and q.qubit_count == n + m
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-7)
+
+
+def test_mid_insertion_allocate():
+    q = QBdt(3, rng=QrackRandom(105), rand_global_phase=False)
+    d = QEngineCPU(3, rng=QrackRandom(105), rand_global_phase=False)
+    for eng in (q, d):
+        eng.H(0); eng.CNOT(0, 2)
+        eng.Allocate(1, 2)
+    assert q.qubit_count == 5
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-7)
+
+
+def test_hybrid_adaptive_attach_beats_engine_switch():
+    """Bottom-half entanglement blows the pure tree but fits the
+    attached form: the hybrid escalates tree -> attached, NOT engine."""
+    from qrack_tpu.layers.qbdthybrid import QBdtHybrid
+
+    n = 8
+    q = QBdtHybrid(n, engine_factory=lambda m, **kw: QEngineCPU(
+        m, **{**kw, "rand_global_phase": False}),
+        ratio_threshold=0.02, rng=QrackRandom(106), rand_global_phase=False)
+    d = QEngineCPU(n, rng=QrackRandom(106), rand_global_phase=False)
+    # dense-entangle ONLY the top half (deep qubits = leaf region)
+    for eng in (q, d):
+        for i in range(n // 2, n):
+            eng.H(i)
+        eng.CZ(4, 5); eng.CNOT(5, 6); eng.T(6); eng.CZ(6, 7)
+        eng.RY(0.8, 7); eng.CNOT(4, 7); eng.RZ(0.3, 5); eng.CNOT(6, 4)
+        eng.U(5, 0.2, 0.4, 0.6); eng.CZ(7, 5)
+    assert q.isBinaryDecisionTree()        # still a tree...
+    assert q.bdt.attached_qubits > 0       # ...in the attached form
+    got = align_phase(q.GetQuantumState(), d.GetQuantumState())
+    np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
