@@ -625,10 +625,19 @@ class QEngineTurboQuant(QEngineTPU):
 
         return _program(("tq_probmask", self._layout_key()), build)
 
+    @staticmethod
+    def _host_scalar(x) -> float:
+        """Host value of a (possibly replicated, possibly not fully
+        addressable) device scalar — the multi-host-legal read pattern
+        (parallel/pager.py _host_read)."""
+        if getattr(x, "is_fully_addressable", True):
+            return float(np.asarray(x))
+        return float(np.asarray(x.addressable_shards[0].data))
+
     def _k_prob_mask(self, mask, perm) -> float:
         ca, cs = self._tq_chunk_pow, self._chunk_amps
         c3, s2 = self._chunk3()
-        total = float(self._p_prob_mask()(
+        total = self._host_scalar(self._p_prob_mask()(
             c3, s2, self._rot_t, mask & (cs - 1), perm & (cs - 1),
             mask >> ca, perm >> ca))
         return min(max(total, 0.0), 1.0)
@@ -683,8 +692,7 @@ class QEngineTurboQuant(QEngineTPU):
         never materializes more than one chunk."""
         n_ch = self._n_chunks()
         c3, s2 = self._chunk3()
-        masses = np.asarray(_j_chunk_masses(c3, s2, self._qmax),
-                            dtype=np.float64)
+        masses = self._chunk_masses(c3, s2)
         tot = masses.sum()
         u = self.Rand() * tot
         acc = 0.0
@@ -696,10 +704,17 @@ class QEngineTurboQuant(QEngineTPU):
                 break
         self._note_transient(1)
         pl = self._dec_chunk(chosen)
-        local = int(_j_sample_chunk(pl, float(self.Rand())))
+        local = int(self._host_scalar(_j_sample_chunk(
+            pl, float(self.Rand()))))
         result = chosen * self._chunk_amps + local
         self.SetPermutation(result)
         return result
+
+    def _chunk_masses(self, c3, s2) -> np.ndarray:
+        """Host copy of per-chunk masses (sharded subclass overrides
+        with an all-gather program so the read is multi-host legal)."""
+        return np.asarray(_j_chunk_masses(c3, s2, self._qmax),
+                          dtype=np.float64)
 
     # ------------------------------------------------------------------
     # codes-native initialization: a basis state occupies ONE block, so
@@ -719,29 +734,34 @@ class QEngineTurboQuant(QEngineTPU):
             return (SingleDeviceSharding(self._device),) * 2
         return None
 
-    def _p_setperm(self, n_blocks: int, twoD: int):
+    def _p_setperm(self, n_chunks: int, cb: int, twoD: int):
         cdt = self._code_np
         sh = self._perm_out_shardings()
 
         def build():
-            def run(row_codes, scale, b_idx):
-                codes = (jnp.zeros((n_blocks, twoD), dtype=cdt)
-                         .at[b_idx].set(row_codes))
-                scales = (jnp.zeros((n_blocks,), dtype=jnp.float32)
-                          .at[b_idx].set(scale.astype(jnp.float32)))
-                return codes, scales
+            def run(row_codes, scale, cid, bid):
+                # two-level (chunk, block-in-chunk) scatter: both
+                # indices stay int32 at ANY width (a flat block index
+                # would overflow int32 at max pager widths)
+                codes = (jnp.zeros((n_chunks, cb, twoD), dtype=cdt)
+                         .at[cid, bid].set(row_codes))
+                scales = (jnp.zeros((n_chunks, cb), dtype=jnp.float32)
+                          .at[cid, bid].set(scale.astype(jnp.float32)))
+                return codes.reshape(n_chunks * cb, twoD), scales.reshape(-1)
 
             kw = {"out_shardings": sh} if sh is not None else {}
             return jax.jit(run, **kw)
 
         return _program(("tq_setperm", self._layout_key(),
-                         getattr(self, "_device_id", -1), n_blocks), build)
+                         getattr(self, "_device_id", -1), n_chunks, cb),
+                        build)
 
     def SetPermutation(self, perm: int, phase=None) -> None:
         ph = self._rand_phase() if phase is None else complex(phase)
         D = self._block
-        n_blocks = max(1, (1 << self.qubit_count) // D)
-        b_idx, d = perm // D, perm % D
+        cs = self._chunk_amps
+        cb = self._chunk_blocks
+        cid, bid, d = perm // cs, (perm % cs) // D, perm % D
         # rotated one-hot row (re at row-slot d, im at slot D+d), built
         # DEVICE-side from the resident rotation.  The zero-fill +
         # scatter runs inside a jitted program with explicit output
@@ -753,8 +773,10 @@ class QEngineTurboQuant(QEngineTPU):
         safe = jnp.where(scale > 0, scale, 1.0)
         q = tq.qmax(self._tq_bits)
         row_codes = jnp.round(row / safe * q).astype(self._code_np)
-        self._codes, self._scales = self._p_setperm(n_blocks, 2 * D)(
-            row_codes, scale, jnp.asarray(b_idx, gk.IDX_DTYPE))
+        self._codes, self._scales = self._p_setperm(
+            self._n_chunks(), cb, 2 * D)(
+            row_codes, scale, jnp.asarray(cid, gk.IDX_DTYPE),
+            jnp.asarray(bid, gk.IDX_DTYPE))
         self.running_norm = 1.0
 
     # ------------------------------------------------------------------

@@ -794,6 +794,9 @@ class QBdt(QInterface):
         N.  Peak cost O(self nodes * other nodes), never 2^n."""
         if start is None:
             start = self.qubit_count
+        if not (0 <= start <= self.qubit_count):
+            raise ValueError(
+                f"Compose start {start} out of range [0, {self.qubit_count}]")
         o = other if isinstance(other, QBdt) else None
         tq = self.tree_qubits
         if (o is not None and not o.attached_qubits and start <= tq):

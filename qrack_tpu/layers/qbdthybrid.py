@@ -53,10 +53,11 @@ class QBdtHybrid(QInterface):
     def _live(self):
         return self.engine if self.engine is not None else self.bdt
 
-    def SwitchToEngine(self) -> None:
+    def SwitchToEngine(self, state=None) -> None:
         if self.engine is not None:
             return
-        state = self.bdt.GetQuantumState()
+        if state is None:
+            state = self.bdt.GetQuantumState()
         self.engine = self._factory(self.qubit_count, rng=self.rng.spawn(), **self._kw)
         self.engine.SetQuantumState(state)
         self.bdt = None
@@ -100,10 +101,7 @@ class QBdtHybrid(QInterface):
                 return
             # attached form failed too: hand the already-materialized
             # ket straight to the engine
-            self.engine = self._factory(self.qubit_count,
-                                        rng=self.rng.spawn(), **self._kw)
-            self.engine.SetQuantumState(state)
-            self.bdt = None
+            self.SwitchToEngine(state)
             return
         self.SwitchToEngine()
 

@@ -45,9 +45,9 @@ from ..ops import gatekernels as gk
 from ..utils.bits import is_pow2, log2
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def _shard_map(fn, mesh, in_specs, out_specs, **kw):
     return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs)
+                         out_specs=out_specs, **kw)
 
 
 class QPagerTurboQuant(tqe.QEngineTurboQuant):
@@ -264,6 +264,55 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
                                self._code_np, self._qmax)
         return tqe._program(("tqp_collapse", self._layout_key()),
                             self._wrap(run, 7))
+
+    # ------------------------------------------------------------------
+    # multi-host-safe reads: masses gather with a collective, one-chunk
+    # decompression lands replicated (the only legal read patterns when
+    # no process addresses every shard — parallel/cluster.py)
+    # ------------------------------------------------------------------
+
+    def _chunk_masses(self, c3, s2) -> np.ndarray:
+        qmax = self._qmax
+        mesh = self.mesh
+
+        def build():
+            def shard_fn(codes3, scales2):
+                y = (codes3.astype(jnp.float32)
+                     * (scales2 / qmax)[..., None])
+                local = jnp.sum(y * y, axis=(1, 2))
+                return jax.lax.all_gather(local, "pages").reshape(-1)
+
+            # all_gather output IS replicated; the static VMA checker
+            # cannot infer that, so disable it for this program only
+            f = _shard_map(shard_fn, mesh, (P("pages"), P("pages")), P(),
+                           check_vma=False)
+            return jax.jit(f)
+
+        prog = tqe._program(("tqp_masses", self._layout_key()), build)
+        out = prog(c3, s2)
+        if out.is_fully_addressable:
+            return np.asarray(out, dtype=np.float64)
+        return np.asarray(out.addressable_shards[0].data, dtype=np.float64)
+
+    def _dec_chunk(self, c: int):
+        cb, block, qmax = self._chunk_blocks, self._block, self._qmax
+
+        def build():
+            def run(codes3, scales2, rot_t, cid):
+                # chunk-major dynamic_slice: the chunk id stays int32 at
+                # any width (a flat block offset c*cb would overflow)
+                cc = jax.lax.dynamic_slice(
+                    codes3, (cid, 0, 0), (1, cb, codes3.shape[-1]))
+                ss = jax.lax.dynamic_slice(scales2, (cid, 0), (1, cb))
+                rows = tqe._dec_rows_f(cc.reshape(cb, -1),
+                                       ss.reshape(cb), rot_t, qmax)
+                return tqe._rows_to_planes(rows, block)
+
+            return jax.jit(run, out_shardings=NamedSharding(self.mesh, P()))
+
+        prog = tqe._program(("tqp_dec_chunk", self._layout_key()), build)
+        c3, s2 = self._chunk3()
+        return prog(c3, s2, self._rot_t, jnp.asarray(c, gk.IDX_DTYPE))
 
     def _p_collapse_scales(self):
         run = tqe._mk_collapse_scales()

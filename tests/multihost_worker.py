@@ -78,6 +78,24 @@ def main() -> None:
     gout = gfn(qftm.basis_planes(n, 0, sharding=gsh))
     gamps = np.asarray(jax.device_get(fetch(gout, 0)))
 
+    # 3) the sharded COMPRESSED ket over the same global mesh: chunked
+    #    shard_map programs + b-bit ppermute pair exchange across the
+    #    process boundary; reads go through the multi-host-safe paths
+    #    (psum'd prob, all-gathered masses, replicated chunk decompress)
+    from qrack_tpu.parallel.turboquant_pager import QPagerTurboQuant
+
+    tq = QPagerTurboQuant(n, bits=16, chunk_qb=3, block_pow=2,
+                          devices=jax.devices(), n_pages=8,
+                          rng=QrackRandom(777), rand_global_phase=False)
+    for i in range(n):
+        tq.H(i)
+    tq.CNOT(0, 6)       # page-bit target: cross-process code exchange
+    tq.T(6)
+    tq.CZ(5, 6)
+    tq_p3 = tq.Prob(3)
+    tq_p6 = tq.Prob(6)
+    tq_m = tq.MAll()
+
     print("RESULT " + json.dumps({
         "proc": process_index(),
         "procs": process_count(),
@@ -90,6 +108,9 @@ def main() -> None:
         "qft_im": [float(x) for x in qamps[1]],
         "rcs_norm": float((ramps[0] ** 2 + ramps[1] ** 2).sum()),
         "grover_p_target": grm.success_probability(gamps, 3),
+        "tq_prob3": float(tq_p3),
+        "tq_prob6": float(tq_p6),
+        "tq_mall": int(tq_m),
     }), flush=True)
 
 

@@ -101,5 +101,10 @@ def test_cluster_matches_oracle(n_procs):
         np.testing.assert_allclose(got_qft, ref_qft, atol=3e-5)
         assert abs(r["rcs_norm"] - 1.0) < 1e-3
         assert r["grover_p_target"] > 0.9
-    # host-side measurement draw must agree across processes
+        # sharded compressed ket over the same cluster (16-bit lossy
+        # tolerance): uniform superposition -> both marginals 1/2
+        assert abs(r["tq_prob3"] - 0.5) < 1e-3
+        assert abs(r["tq_prob6"] - 0.5) < 1e-3
+    # host-side measurement draws must agree across processes
     assert len({r["mall"] for r in results}) == 1
+    assert len({r["tq_mall"] for r in results}) == 1

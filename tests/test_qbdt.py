@@ -541,3 +541,12 @@ def test_hybrid_adaptive_attach_beats_engine_switch():
     assert q.bdt.attached_qubits > 0       # ...in the attached form
     got = align_phase(q.GetQuantumState(), d.GetQuantumState())
     np.testing.assert_allclose(got, d.GetQuantumState(), atol=1e-6)
+
+
+def test_compose_start_out_of_range_raises():
+    q = QBdt(3, rng=QrackRandom(107), rand_global_phase=False)
+    other = QBdt(1, rng=QrackRandom(108), rand_global_phase=False)
+    with pytest.raises(ValueError):
+        q.Compose(other, -1)
+    with pytest.raises(ValueError):
+        q.Allocate(7, 2)
