@@ -76,3 +76,34 @@ def test_random_api_interleavings_match_oracle(trial):
     f = abs(np.vdot(np.asarray(o.GetQuantumState()),
                     np.asarray(s.GetQuantumState()))) ** 2
     assert f > 1 - 1e-6, (trial, f)
+
+
+# the same fuzz vocabulary over the round-5 stacks: the sharded
+# compressed ket (lossy — fidelity floor scaled to 16-bit codes) and
+# the attached-leaf tree (exact)
+_R5_STACKS = [
+    ("turboquant_pager", {"bits": 16, "chunk_qb": 3, "block_pow": 2},
+     1 - 1e-5),
+    ("bdt_attached", {"attached_qubits": 3}, 1 - 1e-6),
+]
+
+
+@pytest.mark.parametrize("name,kw,floor",
+                         _R5_STACKS, ids=[s[0] for s in _R5_STACKS])
+@pytest.mark.parametrize("trial", range(4))
+def test_fuzz_round5_stacks(name, kw, floor, trial):
+    rng = np.random.Generator(np.random.PCG64(2000 + trial))
+    o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
+    s = create_quantum_interface(name, N, rng=QrackRandom(trial),
+                                 rand_global_phase=False, **kw)
+    for step in range(25):
+        op, args = _ops(rng)
+        getattr(o, op)(*args)
+        getattr(s, op)(*args)
+        if rng.integers(0, 10) == 0:
+            qb = int(rng.integers(0, N))
+            assert abs(o.Prob(qb) - s.Prob(qb)) < 5e-4, (trial, step, op)
+    a = np.asarray(o.GetQuantumState())
+    b = np.asarray(s.GetQuantumState())
+    f = abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real * np.vdot(b, b).real)
+    assert f > floor, (trial, f)
