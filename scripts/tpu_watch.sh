@@ -4,6 +4,12 @@
 # healthy windows can be short (observed: ~5 min) — so the campaign
 # starts the instant the chip answers, with every stage watchdogged.
 #
+# Cadence: ~9.5 min between probes for the first 12 attempts of a run,
+# then ~25 min.  A killed client can leave a half-claim on the server
+# and probing too often may keep refreshing the wedge instead of letting
+# the stale claim expire (docs/TPU_EVIDENCE.md wedge notes; 45+ probes
+# at the short cadence never saw a healthy window in round 5).
+#
 # Usage: nohup scripts/tpu_watch.sh &   (log: bench_out/watch.log)
 cd "$(dirname "$0")/.."
 mkdir -p bench_out
@@ -22,18 +28,18 @@ for i in $(seq 1 200); do
     CLOG="$(PYTHON="$PY" bash scripts/tpu_campaign.sh 2>> "$LOG")"
     echo "campaign exited at $(date +%H:%M:%S) log=$CLOG" >> "$LOG"
     # success = THIS run both finished its stage list and actually
-    # validated timing on the chip; a run where every stage wedged and
-    # was cut down by its timeout still prints CAMPAIGN DONE, and stale
-    # logs from earlier runs must not satisfy the gate
+    # validated timing on the chip (the campaign withholds CAMPAIGN
+    # DONE when it aborted with skipped stages)
     if [ -n "$CLOG" ] && grep -q "CAMPAIGN DONE" "$CLOG" 2>/dev/null \
         && grep -q "TIMING_PROBE_OK" "$CLOG" 2>/dev/null; then
       echo "campaign complete — watcher exiting" >> "$LOG"
       exit 0
     fi
   fi
-  # ~9.5 min between probes: a killed client can leave a half-claim on
-  # the server; probing too often may keep refreshing the wedge instead
-  # of letting the stale claim expire
-  sleep 570
+  if [ "$i" -le 12 ]; then
+    sleep 570
+  else
+    sleep 1500
+  fi
 done
 exit 1

@@ -410,3 +410,34 @@ def test_width_caps_scale_with_bits_and_pages():
         QEngineTurboQuant(32, bits=16, rng=QrackRandom(42))
     with pytest.raises(MemoryError):
         QPagerTurboQuant(36, bits=8, n_pages=2, rng=QrackRandom(43))
+
+
+def test_xeb_quantization_fidelity_sweep():
+    """XEB-style fidelity of the compressed ket vs code width on an RCS
+    plan (reference: the [supreme] fidelity suite's bits-of-precision
+    axis): 16-bit ~ exact, 8-bit bounded, and the sharded engine matches
+    the single-device one at equal bits (roadmap: XEB sweeps extended to
+    the compressed engines)."""
+    from qrack_tpu.models.rcs import reference_rcs_state
+    from qrack_tpu.parallel.turboquant_pager import QPagerTurboQuant
+
+    n, depth, seed = 6, 4, 13
+    ideal = reference_rcs_state(
+        n, depth, seed, QEngineCPU(n, rng=QrackRandom(1),
+                                   rand_global_phase=False))
+
+    def xeb(engine):
+        st = reference_rcs_state(n, depth, seed, engine)
+        return abs(np.vdot(ideal, st)) ** 2 / float(np.vdot(st, st).real)
+
+    f16 = xeb(QEngineTurboQuant(n, bits=16, chunk_qb=3, block_pow=2,
+                                rng=QrackRandom(2), rand_global_phase=False))
+    f8 = xeb(QEngineTurboQuant(n, bits=8, chunk_qb=3, block_pow=2,
+                               rng=QrackRandom(3), rand_global_phase=False))
+    fs16 = xeb(QPagerTurboQuant(n, bits=16, chunk_qb=3, block_pow=2,
+                                n_pages=4, rng=QrackRandom(4),
+                                rand_global_phase=False))
+    assert f16 > 1 - 1e-5
+    assert f8 > 0.98            # bounded by 8-bit reconstruction error
+    assert f16 > f8             # precision axis is monotone
+    assert abs(fs16 - f16) < 1e-6   # sharding is numerically invisible
