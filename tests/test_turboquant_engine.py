@@ -427,8 +427,7 @@ def test_xeb_quantization_fidelity_sweep():
                                    rand_global_phase=False))
 
     def xeb(engine):
-        st = reference_rcs_state(n, depth, seed, engine)
-        return abs(np.vdot(ideal, st)) ** 2 / float(np.vdot(st, st).real)
+        return fidelity(ideal, reference_rcs_state(n, depth, seed, engine))
 
     f16 = xeb(QEngineTurboQuant(n, bits=16, chunk_qb=3, block_pow=2,
                                 rng=QrackRandom(2), rand_global_phase=False))
