@@ -426,7 +426,8 @@ class QEngineTurboQuant(QEngineTPU):
                 f"{self.qubit_count}): flat int32 indices overflow and "
                 "the planes exceed HBM.  At this width the chunked op "
                 "set (gates, prob, collapse, measurement, "
-                "SetPermutation) is the supported surface")
+                "SetPermutation, amplitude/page reads) is the "
+                "supported surface")
         self.peak_transient_amps = max(self.peak_transient_amps,
                                        1 << self.qubit_count)
         return self._decompress_planes()
@@ -778,6 +779,56 @@ class QEngineTurboQuant(QEngineTPU):
             row_codes, scale, jnp.asarray(cid, gk.IDX_DTYPE),
             jnp.asarray(bid, gk.IDX_DTYPE))
         self.running_norm = 1.0
+
+    # ------------------------------------------------------------------
+    # block-local reads: one amplitude needs only its own block decoded
+    # (the reference's decompress-per-block read access,
+    # statevector_turboquant.hpp) — no dense fallback, sound at ANY
+    # width, ~2D bytes over the wire
+    # ------------------------------------------------------------------
+
+    def _rot_host_np(self) -> np.ndarray:
+        cached = getattr(self, "_rot_host", None)
+        if cached is None or cached.shape[0] != 2 * self._block:
+            cached = np.asarray(self._rot, dtype=np.float32)
+            self._rot_host = cached
+        return cached
+
+    def _fetch_blocks(self, b0: int, nb: int):
+        """Host (codes, scales) for blocks [b0, b0+nb) — the sharded
+        subclass overrides with a replicated collective fetch so the
+        read stays multi-host legal."""
+        return (np.asarray(self._codes[b0:b0 + nb], dtype=np.float32),
+                np.asarray(self._scales[b0:b0 + nb], dtype=np.float32))
+
+    def GetAmplitude(self, perm: int) -> complex:
+        D = self._block
+        b, d = perm // D, perm % D
+        codes, scales = self._fetch_blocks(b, 1)
+        scale = float(scales[0])
+        if scale == 0.0:
+            return 0j
+        rot = self._rot_host_np()
+        y = codes[0] * (scale / self._qmax)
+        # decompress just the two needed coordinates: row @ rot.T at
+        # columns d (re) and D+d (im) = dot with rot's rows d / D+d
+        re = float(y @ rot[d])
+        im = float(y @ rot[D + d])
+        return complex(re, im)
+
+    def GetAmplitudePage(self, offset: int, length: int) -> np.ndarray:
+        """Block-aligned page read: decode only the covered blocks."""
+        D = self._block
+        b0 = offset // D
+        b1 = (offset + length - 1) // D + 1
+        codes, scales = self._fetch_blocks(b0, b1 - b0)
+        rot = self._rot_host_np()
+        rows = (codes * (scales / self._qmax)[:, None]) @ rot.T
+        flat_re = rows[:, :D].reshape(-1)
+        flat_im = rows[:, D:].reshape(-1)
+        lo = offset - b0 * D
+        return (flat_re[lo:lo + length]
+                + 1j * flat_im[lo:lo + length]).astype(np.complex128)
 
     # ------------------------------------------------------------------
     # serialization: seed + scales + codes (reference stores the seed,

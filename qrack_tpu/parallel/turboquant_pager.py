@@ -314,6 +314,49 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
         c3, s2 = self._chunk3()
         return prog(c3, s2, self._rot_t, jnp.asarray(c, gk.IDX_DTYPE))
 
+    def _fetch_blocks(self, b0: int, nb: int):
+        """Replicated per-chunk dynamic-slice fetch of block rows:
+        multi-host legal (raw host indexing of the sharded arrays would
+        raise on non-addressable shards) and int32-safe via the
+        two-level (chunk, block-in-chunk) addressing."""
+        cb = self._chunk_blocks
+        c3, s2 = self._chunk3()
+        parts_c, parts_s = [], []
+        b = b0
+        left = nb
+        while left > 0:
+            cid, boff = divmod(b, cb)
+            take = min(left, cb - boff)
+
+            def build(take=take):
+                def run(codes3, scales2, cid, boff):
+                    cc = jax.lax.dynamic_slice(
+                        codes3, (cid, boff, 0),
+                        (1, take, codes3.shape[-1]))
+                    ss = jax.lax.dynamic_slice(scales2, (cid, boff),
+                                               (1, take))
+                    return cc.reshape(take, -1), ss.reshape(take)
+
+                rep = NamedSharding(self.mesh, P())
+                return jax.jit(run, out_shardings=(rep, rep))
+
+            prog = tqe._program(
+                ("tqp_blockrows", self._layout_key(), take), build)
+            cc, ss = prog(c3, s2, jnp.asarray(cid, gk.IDX_DTYPE),
+                          jnp.asarray(boff, gk.IDX_DTYPE))
+            parts_c.append(self._host_rows(cc))
+            parts_s.append(self._host_rows(ss))
+            b += take
+            left -= take
+        return (np.concatenate(parts_c).astype(np.float32),
+                np.concatenate(parts_s).astype(np.float32))
+
+    @staticmethod
+    def _host_rows(x) -> np.ndarray:
+        if getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        return np.asarray(x.addressable_shards[0].data)
+
     def _p_collapse_scales(self):
         run = tqe._mk_collapse_scales()
         mesh = self.mesh

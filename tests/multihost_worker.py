@@ -94,6 +94,7 @@ def main() -> None:
     tq.CZ(5, 6)
     tq_p3 = tq.Prob(3)
     tq_p6 = tq.Prob(6)
+    tq_amp0 = tq.GetAmplitude(0)      # block-local replicated fetch
     tq_m = tq.MAll()
 
     print("RESULT " + json.dumps({
@@ -110,6 +111,7 @@ def main() -> None:
         "grover_p_target": grm.success_probability(gamps, 3),
         "tq_prob3": float(tq_p3),
         "tq_prob6": float(tq_p6),
+        "tq_amp0_abs": abs(tq_amp0),
         "tq_mall": int(tq_m),
     }), flush=True)
 

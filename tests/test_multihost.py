@@ -105,6 +105,9 @@ def test_cluster_matches_oracle(n_procs):
         # tolerance): uniform superposition -> both marginals 1/2
         assert abs(r["tq_prob3"] - 0.5) < 1e-3
         assert abs(r["tq_prob6"] - 0.5) < 1e-3
+        # block-local amplitude read before MAll: uniform superposition
+        # amplitude magnitude 2^-3.5
+        assert abs(r["tq_amp0_abs"] - 2 ** -3.5) < 1e-3
     # host-side measurement draws must agree across processes
     assert len({r["mall"] for r in results}) == 1
     assert len({r["tq_mall"] for r in results}) == 1

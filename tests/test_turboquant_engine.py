@@ -440,3 +440,24 @@ def test_xeb_quantization_fidelity_sweep():
     assert f8 > 0.98            # bounded by 8-bit reconstruction error
     assert f16 > f8             # precision axis is monotone
     assert abs(fs16 - f16) < 1e-6   # sharding is numerically invisible
+
+
+def test_block_local_amplitude_reads():
+    """GetAmplitude/GetAmplitudePage decode only the covered blocks —
+    values must match the full decompress path exactly, on both the
+    single-device and the sharded engine."""
+    from qrack_tpu.parallel.turboquant_pager import QPagerTurboQuant
+
+    for eng in (QEngineTurboQuant(7, bits=16, chunk_qb=4, block_pow=2,
+                                  rng=QrackRandom(50),
+                                  rand_global_phase=False),
+                QPagerTurboQuant(7, bits=16, chunk_qb=3, block_pow=2,
+                                 n_pages=4, rng=QrackRandom(50),
+                                 rand_global_phase=False)):
+        random_circuit(eng, QrackRandom(51), 25, 7)
+        full = eng.GetQuantumState()
+        for perm in (0, 3, 17, 63, 127):
+            assert eng.GetAmplitude(perm) == pytest.approx(full[perm],
+                                                           abs=1e-6)
+        page = eng.GetAmplitudePage(5, 9)   # straddles block boundaries
+        np.testing.assert_allclose(page, full[5:14], atol=1e-6)
