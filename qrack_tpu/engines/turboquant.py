@@ -816,6 +816,49 @@ class QEngineTurboQuant(QEngineTPU):
         im = float(y @ rot[D + d])
         return complex(re, im)
 
+    def _p_setamp(self):
+        sh = self._perm_out_shardings()
+
+        def build():
+            def run(codes3, scales2, row, scale, cid, bid):
+                # two-level (chunk, block-in-chunk) scatter, like
+                # _p_setperm: a flat block index silently wraps int32
+                # at max pager widths; output shardings keep the write
+                # on-mesh for the sharded subclass
+                C, cb, twoD = codes3.shape
+                codes3 = codes3.at[cid, bid].set(row)
+                scales2 = scales2.at[cid, bid].set(
+                    scale.astype(jnp.float32))
+                return codes3.reshape(C * cb, twoD), scales2.reshape(-1)
+
+            kw = {"out_shardings": sh} if sh is not None else {}
+            return jax.jit(run, donate_argnums=(0, 1), **kw)
+
+        return _program(("tq_setamp", self._layout_key(),
+                         getattr(self, "_device_id", -1)), build)
+
+    def SetAmplitude(self, perm: int, amp: complex) -> None:
+        """Block-local write: decode the one covered block, poke the
+        amplitude, requantize that block only."""
+        amp = complex(amp)
+        D = self._block
+        cs = self._chunk_amps
+        b, d = perm // D, perm % D
+        cid, bid = perm // cs, (perm % cs) // D
+        codes, scales = self._fetch_blocks(b, 1)
+        rot = self._rot_host_np()
+        vec = (codes[0] * (float(scales[0]) / self._qmax)) @ rot.T
+        vec[d] = amp.real
+        vec[D + d] = amp.imag
+        y = vec @ rot
+        scale = float(np.max(np.abs(y)))
+        safe = scale if scale > 0 else 1.0
+        row = np.round(y / safe * self._qmax).astype(self._code_np)
+        c3, s2 = self._chunk3()
+        self._codes, self._scales = self._p_setamp()(
+            c3, s2, jnp.asarray(row), jnp.float32(scale),
+            jnp.asarray(cid, gk.IDX_DTYPE), jnp.asarray(bid, gk.IDX_DTYPE))
+
     def GetAmplitudePage(self, offset: int, length: int) -> np.ndarray:
         """Block-aligned page read: decode only the covered blocks."""
         D = self._block

@@ -461,3 +461,37 @@ def test_block_local_amplitude_reads():
                                                            abs=1e-6)
         page = eng.GetAmplitudePage(5, 9)   # straddles block boundaries
         np.testing.assert_allclose(page, full[5:14], atol=1e-6)
+
+
+def test_block_local_set_amplitude():
+    """SetAmplitude requantizes only the touched block and matches the
+    dense oracle's semantics (used by QUnit's cached-shard flushes)."""
+    n = 6
+    q = QEngineTurboQuant(n, bits=16, chunk_qb=4, block_pow=2,
+                          rng=QrackRandom(60), rand_global_phase=False)
+    o = QEngineCPU(n, rng=QrackRandom(60), rand_global_phase=False)
+    for e in (q, o):
+        e.H(0); e.CNOT(0, 3)
+    codes_before = np.asarray(q._codes).copy()
+    for e in (q, o):
+        e.SetAmplitude(5, 0.25 - 0.1j)
+    assert q.GetAmplitude(5) == pytest.approx(0.25 - 0.1j, abs=1e-3)
+    # only the one covered block's codes changed
+    D = q._block
+    changed = np.any(np.asarray(q._codes) != codes_before, axis=1)
+    assert changed[5 // D]
+    assert not np.any(np.delete(changed, 5 // D))
+    assert fidelity(q.GetQuantumState(), o.GetQuantumState()) > 1 - 1e-5
+
+
+def test_block_local_set_amplitude_sharded():
+    from qrack_tpu.parallel.turboquant_pager import QPagerTurboQuant
+
+    q = QPagerTurboQuant(6, bits=16, chunk_qb=3, block_pow=2, n_pages=4,
+                         rng=QrackRandom(61), rand_global_phase=False)
+    q.H(0)
+    q.SetAmplitude(33, 0.5 + 0.25j)
+    assert q.GetAmplitude(33) == pytest.approx(0.5 + 0.25j, abs=1e-3)
+    # state stays sharded and operable
+    q.H(1)
+    assert 0.0 <= q.Prob(1) <= 1.0
