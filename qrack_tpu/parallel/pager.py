@@ -48,6 +48,33 @@ from .. import matrices as mat
 _PROGRAMS: dict = {}
 
 
+def pager_devices_from_env():
+    """Device list from QRACK_QPAGER_DEVICES (reference: the same env
+    selecting pager devices, src/qpager.cpp:170), or None when unset.
+    Unknown ids fail loudly — a typo must not silently fall back."""
+    from ..config import get_config
+
+    spec = get_config().pager_devices.strip()
+    if not spec:
+        return None
+    ids = [int(t) for t in spec.split(",") if t.strip()]
+    if not ids:
+        raise ValueError(
+            f"QRACK_QPAGER_DEVICES={spec!r} contains no device ids")
+    if len(set(ids)) != len(ids):
+        # a Mesh with duplicate devices constructs fine and then fails
+        # at first dispatch with an opaque XLA internal error
+        raise ValueError(
+            f"QRACK_QPAGER_DEVICES={spec!r} repeats device ids")
+    by_id = {d.id: d for d in jax.devices()}
+    missing = [i for i in ids if i not in by_id]
+    if missing:
+        raise ValueError(
+            f"QRACK_QPAGER_DEVICES names unknown device ids {missing} "
+            f"(available: {sorted(by_id)})")
+    return [by_id[i] for i in ids]
+
+
 def _program(key, builder):
     fn = _PROGRAMS.get(key)
     if fn is None:
@@ -91,7 +118,7 @@ class QPager(QEngine):
 
             dtype = get_config().device_real_dtype()
         if devices is None:
-            devices = jax.devices()
+            devices = pager_devices_from_env() or jax.devices()
         # power-of-two device prefix (reference: page-count policy,
         # src/qpager.cpp:89-292)
         if n_pages is None:

@@ -56,7 +56,9 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
                  n_pages=None, **kwargs):
         if devices is None:
-            devices = jax.devices()
+            from .pager import pager_devices_from_env
+
+            devices = pager_devices_from_env() or jax.devices()
         if n_pages is None:
             n_pages = 1 << log2(len(devices))
         if not is_pow2(n_pages):

@@ -319,3 +319,29 @@ def test_hardware_entropy_source():
     # unseeded streams remain constructible + distinct
     a, b = rngmod.QrackRandom(), rngmod.QrackRandom()
     assert a.rand() != b.rand()
+
+
+def test_hwrng_native_opt_out(monkeypatch):
+    """QRACK_TPU_NO_NATIVE disables the instruction path; entropy still
+    flows through the os.urandom fallback (reference: rdrandwrapper's
+    non-RDRAND fallback)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         f"import sys; sys.path.insert(0, {repo!r})\n"
+         "from qrack_tpu.utils import rng\n"
+         "assert not rng.hw_rdrand_supported()\n"
+         "assert rng.hw_rand64() is None\n"
+         "b = rng.hw_entropy_bytes(16)\n"
+         "assert len(b) == 16 and b != rng.hw_entropy_bytes(16)\n"
+         "print('NO_NATIVE_OK')"],
+        capture_output=True, text=True, timeout=120,
+        env={k: v for k, v in __import__('os').environ.items()
+             if k != 'PYTHONPATH'} | {"QRACK_TPU_NO_NATIVE": "1",
+                                      "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "NO_NATIVE_OK" in out.stdout

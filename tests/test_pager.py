@@ -368,3 +368,24 @@ def test_compose_ring_all_starts_and_no_allgather():
     hlo = fn.lower(a, b).compile().as_text()
     assert "all-gather" not in hlo, "ring compose must not all-gather the ket"
     assert "collective-permute" in hlo, "ring compose should ppermute"
+
+
+def test_pager_devices_env_selection():
+    """QRACK_QPAGER_DEVICES (via the config tier) selects the mesh
+    device subset (reference: src/qpager.cpp:170); unknown ids fail
+    loudly."""
+    import pytest
+
+    from qrack_tpu import set_config
+
+    try:
+        set_config(pager_devices="2,3")
+        p = QPager(4, rng=QrackRandom(9), rand_global_phase=False,
+                   n_pages=2)
+        assert [d.id for d in p.mesh.devices.flat] == [2, 3]
+        set_config(pager_devices="99")
+        with pytest.raises(ValueError, match="unknown device ids"):
+            QPager(4, rng=QrackRandom(9), rand_global_phase=False,
+                   n_pages=1)
+    finally:
+        set_config(pager_devices="")
