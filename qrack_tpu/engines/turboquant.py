@@ -533,11 +533,64 @@ class QEngineTurboQuant(QEngineTPU):
 
         return _program(("tq_pair", self._layout_key(), tb_pos), build)
 
+    # opt-in fused Pallas path (ops/pallas_turboquant.py): one HBM
+    # read+write of the b-bit CODES per gate.  Single-device only (the
+    # sharded subclass keeps the shard_map XLA programs); same
+    # QRACK_USE_PALLAS flag as the dense segment sweep.
+    _pallas_capable = True
+    _PALLAS_TILE_POW = int(os.environ.get("QRACK_PALLAS_TQ_TILE_QB", "18"))
+
+    def _use_pallas(self) -> bool:
+        return (self._pallas_capable
+                and os.environ.get("QRACK_USE_PALLAS") == "1")
+
+    def _pallas_interpret(self) -> bool:
+        return jax.default_backend() != "tpu"
+
+    def _pallas_tile_pow(self) -> int:
+        # tile must cover whole blocks (a tile smaller than one code
+        # row breaks the kernel's reshapes) and fit the register
+        return max(min(self._PALLAS_TILE_POW, self.qubit_count),
+                   self._tq_block_pow)
+
+    def _p_pallas_low(self, target: int, tp: int):
+        from ..ops import pallas_turboquant as ptq
+
+        def build():
+            # donated like every sibling chunk program: without it each
+            # gate holds TWO full code arrays in HBM
+            return jax.jit(ptq.make_tq_gate_low(
+                self.qubit_count, self._tq_block_pow, self._tq_bits,
+                target, tile_pow=tp, interpret=self._pallas_interpret()),
+                donate_argnums=(0, 1))
+
+        return _program(("tq_pl_low", self._layout_key(), target, tp),
+                        build)
+
+    def _p_pallas_diag(self, tp: int):
+        from ..ops import pallas_turboquant as ptq
+
+        def build():
+            return jax.jit(ptq.make_tq_diag(
+                self.qubit_count, self._tq_block_pow, self._tq_bits,
+                tile_pow=tp, interpret=self._pallas_interpret()),
+                donate_argnums=(0, 1))
+
+        return _program(("tq_pl_diag", self._layout_key(), tp), build)
+
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:
         cmask, cval = self._cmask_cval(controls, perm)
         mp = gk.mtrx_planes(np.asarray(m2, dtype=np.complex128), jnp.float32)
         ca = self._tq_chunk_pow
         cs = self._chunk_amps
+        tp = self._pallas_tile_pow()
+        if self._use_pallas() and target < tp:
+            self._note_transient(1)
+            T = 1 << tp
+            self._codes, self._scales = self._p_pallas_low(target, tp)(
+                self._codes, self._scales, self._rot, self._rot_t, mp,
+                cmask >> tp, cval >> tp, cmask & (T - 1), cval & (T - 1))
+            return
         if target < ca:
             self._note_transient(1)
             prog = self._p_gate_low(target)
@@ -567,6 +620,20 @@ class QEngineTurboQuant(QEngineTPU):
         ca = self._tq_chunk_pow
         cs = self._chunk_amps
         d0, d1 = complex(d0), complex(d1)
+        if self._use_pallas():
+            self._note_transient(1)
+            tp = self._pallas_tile_pow()
+            T = 1 << tp
+            dp = np.zeros((2, 2, 2), np.float32)
+            dp[0, 0, 0], dp[0, 0, 1] = d0.real, d1.real
+            dp[1, 0, 0], dp[1, 0, 1] = d0.imag, d1.imag
+            tm_lo = (1 << target) if target < tp else 0
+            tb_hi = 0 if target < tp else (1 << (target - tp))
+            self._codes, self._scales = self._p_pallas_diag(tp)(
+                self._codes, self._scales, self._rot, self._rot_t, dp,
+                tm_lo, tb_hi, cmask & (T - 1), cval & (T - 1),
+                cmask >> tp, cval >> tp)
+            return
         tmask_lo = (1 << target) if target < ca else 0
         tb_hi = 0 if target < ca else (1 << (target - ca))
         self._note_transient(1)

@@ -53,6 +53,9 @@ def _shard_map(fn, mesh, in_specs, out_specs, **kw):
 class QPagerTurboQuant(tqe.QEngineTurboQuant):
     """Sharded compressed dense ket (chunk axis over a "pages" mesh)."""
 
+    # the Pallas fused path is single-device; the mesh keeps shard_map
+    _pallas_capable = False
+
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
                  n_pages=None, **kwargs):
         if devices is None:

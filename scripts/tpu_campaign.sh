@@ -170,6 +170,8 @@ run_stage xeb_w22 300 env QRACK_BENCH=xeb QRACK_BENCH_QB=22 \
 # ---- per-gate microbench + hbm-limit width ------------------------------
 run_stage microbench_w22 480 python scripts/microbench.py 22 8
 run_stage turboquant_w28 600 python scripts/turboquant_bench.py 28 8 4 3
+run_stage turboquant_w28_pallas 600 env QRACK_USE_PALLAS=1 \
+  python scripts/turboquant_bench.py 28 8 4 3
 run_stage turboquant_w31 600 python scripts/turboquant_bench.py 31 8 2 3
 run_stage qft_w30 620 env QRACK_BENCH=qft QRACK_BENCH_QB=30 \
   QRACK_BENCH_QB_FIRST=30 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
