@@ -24,7 +24,14 @@ from .. import matrices as mat
 
 def _range_to_cubes(lo: int, hi: int, length: int) -> List[Tuple[int, int]]:
     """Decompose integer range [lo, hi) over `length`-bit values into
-    aligned blocks (bit_count k, block_index m) with block = [m*2^k, (m+1)*2^k)."""
+    aligned blocks (bit_count k, block_index m) with block = [m*2^k, (m+1)*2^k).
+
+    Bounds are clamped to the representable values here — an
+    out-of-range bound (e.g. PhaseFlipIfLess with greater_perm >=
+    2^length) must never emit impossible-value cubes, which mis-fire as
+    extra flips (fuzz-soak regression, round 5)."""
+    lo = max(lo, 0)
+    hi = min(hi, 1 << length)
     cubes: List[Tuple[int, int]] = []
     k = 0
     while lo < hi:
@@ -46,8 +53,16 @@ class AluMixin:
     def _flip_if_in_range(self, lo: int, hi: int, start: int, length: int, target: int,
                           extra_controls: Sequence[int] = (), extra_perm: int = 0) -> None:
         """X `target` for every basis state whose [start,length) register
-        value lies in [lo, hi) — used for carry/overflow flags."""
-        if lo >= hi:
+        value lies in [lo, hi) — used for carry/overflow flags.
+        Bounds are clamped by _range_to_cubes."""
+        if length == 0:
+            # a zero-bit register has value 0: unconditional flip iff
+            # 0 is in range (matches the engine kernels' v-in-range test)
+            if lo <= 0 < hi:
+                self.MCMtrxPerm(tuple(extra_controls), mat.X2, target,
+                                extra_perm)
+            return
+        if lo >= hi or hi <= 0 or lo >= (1 << length):
             return
         for (k, m) in _range_to_cubes(lo, hi, length):
             ctrls = list(extra_controls)
@@ -62,10 +77,21 @@ class AluMixin:
 
     def _phase_flip_if_in_range(self, lo: int, hi: int, start: int, length: int,
                                 extra_controls: Sequence[int] = (), extra_perm: int = 0) -> None:
-        """-1 phase on every basis state whose register value is in [lo, hi)."""
-        if lo >= hi:
-            return
+        """-1 phase on every basis state whose register value is in
+        [lo, hi).  Bounds are clamped by _range_to_cubes."""
         minus_i2 = np.array([[-1, 0], [0, -1]], dtype=np.complex128)
+        if length == 0:
+            # zero-bit register: value 0 — global flip iff 0 in range
+            # (-I on any qubit outside the controls is a global -1)
+            if lo <= 0 < hi:
+                t = 0
+                while t in extra_controls:
+                    t += 1
+                self.MCMtrxPerm(tuple(extra_controls), minus_i2, t,
+                                extra_perm)
+            return
+        if lo >= hi or hi <= 0 or lo >= (1 << length):
+            return
         for (k, m) in _range_to_cubes(lo, hi, length):
             ctrls = list(extra_controls)
             perm = extra_perm

@@ -381,3 +381,51 @@ def test_bcd_through_layer_stack():
     u.SetPermutation(0b0101_0011)
     u.INCBCD(21, 0, 8)
     assert u.MAll() == 0b0111_0100
+
+
+def test_phase_flip_if_less_out_of_range_bound():
+    """greater_perm >= 2^length must flip EVERYTHING (the value is
+    always less), including on the gate-synthesis fallback used by the
+    tree layers — fuzz-soak regression: the unclamped bound emitted an
+    impossible-value cube that double-flipped half the register."""
+    import numpy as np
+
+    from qrack_tpu.layers.qbdt import QBdt
+    from qrack_tpu.utils.rng import QrackRandom
+
+    n = 6
+    o = QEngineCPU(n, rng=QrackRandom(3), rand_global_phase=False)
+    b = QBdt(n, attached_qubits=3, rng=QrackRandom(3),
+             rand_global_phase=False)
+    p = QBdt(n, rng=QrackRandom(3), rand_global_phase=False)
+    for e in (o, b, p):
+        for i in range(n):
+            e.H(i)
+        e.T(5)
+        e.PhaseFlipIfLess(3, 4, 1)     # 1-bit register: always < 3
+        e.PhaseFlipIfLess(77, 1, 3)    # 3-bit register: always < 77
+        e.CPhaseFlipIfLess(9, 2, 2, 0)  # controlled, bound past width
+    ref = o.GetQuantumState()
+    np.testing.assert_allclose(np.asarray(b.GetQuantumState()), ref,
+                               atol=1e-8)
+    np.testing.assert_allclose(np.asarray(p.GetQuantumState()), ref,
+                               atol=1e-8)
+
+
+def test_phase_flip_if_less_zero_length_register():
+    """A zero-bit register has value 0: PhaseFlipIfLess(gp, s, 0) is a
+    global -1 for gp >= 1 on both kernel and synthesis paths."""
+    import numpy as np
+
+    from qrack_tpu.layers.qbdt import QBdt
+    from qrack_tpu.utils.rng import QrackRandom
+
+    o = QEngineCPU(2, rng=QrackRandom(4), rand_global_phase=False)
+    b = QBdt(2, rng=QrackRandom(4), rand_global_phase=False)
+    for e in (o, b):
+        e.H(0); e.H(1)
+        e.PhaseFlipIfLess(2, 0, 0)
+        e.PhaseFlipIfLess(0, 0, 0)   # empty range: no-op
+    np.testing.assert_allclose(np.asarray(b.GetQuantumState()),
+                               o.GetQuantumState(), atol=1e-10)
+    assert o.GetQuantumState()[0] == pytest.approx(-0.5)
