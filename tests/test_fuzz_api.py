@@ -107,3 +107,81 @@ def test_fuzz_round5_stacks(name, kw, floor, trial):
     b = np.asarray(s.GetQuantumState())
     f = abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real * np.vdot(b, b).real)
     assert f > floor, (trial, f)
+
+
+# deterministic basis-permutation ALU fuzz: every op here maps basis
+# states to basis states, so the expected index is tracked bit-exactly
+# with Python ints — a distinct angle from the amplitude-level fuzz
+# (this is the in-tree slice of the round-5 soak that validated the
+# closed-form index-gather ALU kernels across stacks)
+
+def _perm_model(val, name, args, n):
+    if name in ("INC", "DEC"):
+        a, s, l = args
+        reg = (val >> s) & ((1 << l) - 1)
+        reg = (reg + (a if name == "INC" else -a)) & ((1 << l) - 1)
+        return (val & ~(((1 << l) - 1) << s)) | (reg << s)
+    if name in ("ROL", "ROR"):
+        a, s, l = args
+        reg = (val >> s) & ((1 << l) - 1)
+        sh = (a % l) if l else 0
+        if name == "ROR":
+            sh = (l - sh) % l if l else 0
+        if l:
+            reg = ((reg << sh) | (reg >> (l - sh))) & ((1 << l) - 1)
+        return (val & ~(((1 << l) - 1) << s)) | (reg << s)
+    if name == "XMask":
+        return val ^ args[0]
+    if name == "Swap":
+        a, b = args
+        ba, bb = (val >> a) & 1, (val >> b) & 1
+        val &= ~((1 << a) | (1 << b))
+        return val | (ba << b) | (bb << a)
+    if name == "CNOT":
+        c, t = args
+        return val ^ (1 << t) if (val >> c) & 1 else val
+    raise KeyError(name)
+
+
+def _perm_op(rng, n):
+    kind = int(rng.integers(0, 6))
+    if kind < 2:
+        s = int(rng.integers(0, n - 1))
+        l = int(rng.integers(1, n - s + 1))
+        return ("INC" if kind == 0 else "DEC",
+                (int(rng.integers(0, 16)), s, l))
+    if kind == 2:
+        s = int(rng.integers(0, n - 1))
+        l = int(rng.integers(1, n - s + 1))
+        return ("ROL" if rng.integers(0, 2) else "ROR",
+                (int(rng.integers(0, 5)), s, l))
+    if kind == 3:
+        return ("XMask", (int(rng.integers(1, 1 << n)),))
+    a = int(rng.integers(0, n))
+    b = (a + 1 + int(rng.integers(0, n - 1))) % n
+    return ("Swap", (a, b)) if kind == 4 else ("CNOT", (a, b))
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_alu_permutation_fuzz(trial):
+    rng = np.random.Generator(np.random.PCG64(40000 + trial))
+    val = int(rng.integers(0, 1 << N))
+    stacks = [
+        QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False),
+        create_quantum_interface("optimal", N, rng=QrackRandom(trial),
+                                 rand_global_phase=False),
+        create_quantum_interface("turboquant_pager", N, bits=16,
+                                 chunk_qb=3, block_pow=2,
+                                 rng=QrackRandom(trial),
+                                 rand_global_phase=False),
+    ]
+    for e in stacks:
+        e.SetPermutation(val)
+    for step in range(20):
+        name, args = _perm_op(rng, N)
+        val = _perm_model(val, name, args, N)
+        for e in stacks:
+            getattr(e, name)(*args)
+    for e in stacks:
+        assert abs(abs(complex(e.GetAmplitude(val))) - 1.0) < 1e-3, \
+            (trial, type(e).__name__, val)
