@@ -320,6 +320,13 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
         line["hbm_roofline_frac"] = round(ghbm / 819.0, 4)
         if ghbm > 1600.0:  # ~2x v5e peak: physically impossible
             line["suspect_timing"] = True
+    try:
+        from qrack_tpu import telemetry as _tele
+
+        if _tele.enabled():
+            line["telemetry"] = _tele.snapshot(include_events=False)
+    except Exception as exc:  # observability must never kill the bench
+        print(f"telemetry snapshot failed: {exc!r}", file=sys.stderr)
     print(json.dumps(line), flush=True)
 
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import telemetry as _tele
 from ..config import get_config
 from ..utils.rng import QrackRandom
 from .cpu import QEngineCPU
@@ -78,6 +79,8 @@ class QHybrid:
         )
         if want == have:
             return
+        if _tele._ENABLED:
+            _tele.event(f"hybrid.switch.{have}_to_{want}", width=n)
         state = self._engine.GetQuantumState()
         rng = self._engine.rng
         new = self._make_engine(n)
@@ -96,6 +99,8 @@ class QHybrid:
         """Host-stage into a target-mode engine at the grown width (it
         may not exist at the current width, e.g. a pager with more pages
         than 2^n_cur)."""
+        if _tele._ENABLED:
+            _tele.event(f"hybrid.grow.{mode}", width=n_new)
         rng = self._engine.rng
         grown = self._make_engine(n_new, mode=mode)
         grown.rng = rng

@@ -40,6 +40,7 @@ from ..config import FP_NORM_EPSILON
 from ..interface import QInterface
 from ..ops import alu_kernels as alu
 from .. import matrices as mat
+from .. import telemetry as _tele
 from ..utils.bits import bit_reg_mask, log2, is_pow2
 
 
@@ -59,6 +60,9 @@ class QEngine(QInterface):
     # numpy-compatible module used by index kernels (jnp for the TPU engine)
     _xp = np
 
+    # engine label in telemetry counter names (gate.<label>.<kind>.w<n>)
+    _tele_name = "engine"
+
     # ------------------------------------------------------------------
     # gate primitive dispatch
     # ------------------------------------------------------------------
@@ -71,8 +75,12 @@ class QEngine(QInterface):
         if mat.is_identity(m) and abs(m[0, 0] - 1.0) <= 1e-14:
             return
         if mat.is_phase(m):
+            if _tele._ENABLED:
+                _tele.inc(f"gate.{self._tele_name}.diag.w{self.qubit_count}")
             self._k_apply_diag(m[0, 0], m[1, 1], target, tuple(controls), perm)
         else:
+            if _tele._ENABLED:
+                _tele.inc(f"gate.{self._tele_name}.2x2.w{self.qubit_count}")
             self._k_apply_2x2(m, target, tuple(controls), perm)
 
     # fast paths: X on many bits is one gather; Z/phase masks are diagonal
@@ -81,6 +89,8 @@ class QEngine(QInterface):
     def XMask(self, mask: int) -> None:
         if not mask:
             return
+        if _tele._ENABLED:
+            _tele.inc(f"gate.{self._tele_name}.permute.w{self.qubit_count}")
         self._k_gather(
             lambda idx: idx ^ mask,
             split=(("xmask", mask),
@@ -91,6 +101,8 @@ class QEngine(QInterface):
     def ZMask(self, mask: int) -> None:
         if not mask:
             return
+        if _tele._ENABLED:
+            _tele.inc(f"gate.{self._tele_name}.phase_mask.w{self.qubit_count}")
 
         def fn(xp, idx):
             par = self._parity_of(xp, idx, mask)
@@ -127,9 +139,13 @@ class QEngine(QInterface):
     def Swap(self, q1: int, q2: int) -> None:
         if q1 == q2:
             return
+        if _tele._ENABLED:
+            _tele.inc(f"gate.{self._tele_name}.swap.w{self.qubit_count}")
         self._k_swap_bits(q1, q2)
 
     def Apply4x4(self, m: np.ndarray, q1: int, q2: int) -> None:
+        if _tele._ENABLED:
+            _tele.inc(f"gate.{self._tele_name}.4x4.w{self.qubit_count}")
         self._k_apply_4x4(np.asarray(m, dtype=np.complex128), q1, q2)
 
     def _k_apply_4x4(self, m4, q1, q2) -> None:

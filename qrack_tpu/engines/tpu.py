@@ -33,27 +33,35 @@ import jax.numpy as jnp
 from ..ops import gatekernels as gk
 from .qengine import QEngine
 from .. import matrices as mat
+from .. import telemetry as _tele
 
 
 # ---------------------------------------------------------------------------
-# module-level jitted programs, shared by every engine instance
+# module-level jitted programs, shared by every engine instance.  The
+# telemetry wrapper classifies each call as compile.<name>.miss (the jit
+# cache grew — XLA compiled) or .hit; with telemetry disabled it is a
+# single boolean test over the raw jitted callable.
 # ---------------------------------------------------------------------------
 
-_j_apply_2x2 = jax.jit(gk.apply_2x2, static_argnums=(2, 3), donate_argnums=(0,))
-_j_apply_diag = jax.jit(gk.apply_diag, static_argnums=(5,), donate_argnums=(0,))
-_j_apply_invert = jax.jit(gk.apply_invert, static_argnums=(5, 6), donate_argnums=(0,))
-_j_apply_4x4 = jax.jit(gk.apply_4x4, static_argnums=(2, 3, 4), donate_argnums=(0,))
-_j_swap_bits = jax.jit(gk.swap_bits, static_argnums=(1, 2, 3), donate_argnums=(0,))
-_j_gather = jax.jit(gk.gather, donate_argnums=(0,))
-_j_phase_apply = jax.jit(gk.phase_factor_apply, donate_argnums=(0,))
-_j_prob_mask = jax.jit(gk.prob_mask_sum)
-_j_collapse = jax.jit(gk.collapse, donate_argnums=(0,))
-_j_normalize = jax.jit(gk.normalize, donate_argnums=(0,))
-_j_probs = jax.jit(gk.probs)
-_j_sum_sqr_diff = jax.jit(gk.sum_sqr_diff)
-_j_sample = jax.jit(gk.sample)
-_j_multishot = jax.jit(gk.multishot_mask_keys)
-_j_uc_2x2 = jax.jit(gk.uc_2x2, static_argnums=(2, 3, 4), donate_argnums=(0,))
+def _jit(name, fn, **kw):
+    return _tele.instrument_jit(f"tpu.{name}", jax.jit(fn, **kw))
+
+
+_j_apply_2x2 = _jit("apply_2x2", gk.apply_2x2, static_argnums=(2, 3), donate_argnums=(0,))
+_j_apply_diag = _jit("apply_diag", gk.apply_diag, static_argnums=(5,), donate_argnums=(0,))
+_j_apply_invert = _jit("apply_invert", gk.apply_invert, static_argnums=(5, 6), donate_argnums=(0,))
+_j_apply_4x4 = _jit("apply_4x4", gk.apply_4x4, static_argnums=(2, 3, 4), donate_argnums=(0,))
+_j_swap_bits = _jit("swap_bits", gk.swap_bits, static_argnums=(1, 2, 3), donate_argnums=(0,))
+_j_gather = _jit("gather", gk.gather, donate_argnums=(0,))
+_j_phase_apply = _jit("phase_apply", gk.phase_factor_apply, donate_argnums=(0,))
+_j_prob_mask = _jit("prob_mask", gk.prob_mask_sum)
+_j_collapse = _jit("collapse", gk.collapse, donate_argnums=(0,))
+_j_normalize = _jit("normalize", gk.normalize, donate_argnums=(0,))
+_j_probs = _jit("probs", gk.probs)
+_j_sum_sqr_diff = _jit("sum_sqr_diff", gk.sum_sqr_diff)
+_j_sample = _jit("sample", gk.sample)
+_j_multishot = _jit("multishot", gk.multishot_mask_keys)
+_j_uc_2x2 = _jit("uc_2x2", gk.uc_2x2, static_argnums=(2, 3, 4), donate_argnums=(0,))
 
 
 # one-chip dense f32 width ceiling: int32 flat indices + HBM for
@@ -66,6 +74,7 @@ class QEngineTPU(QEngine):
     """Dense ket on one accelerator device (TPU; CPU backend in tests)."""
 
     _xp = jnp
+    _tele_name = "tpu"
 
     def __init__(self, qubit_count: int, init_state: int = 0, dtype=None,
                  device_id: int = -1, **kwargs):
@@ -153,13 +162,45 @@ class QEngineTPU(QEngine):
     def EscalateToF64(self, observed_norm: float = None) -> None:
         """Re-cast the resident planes to float64 (reference analogue:
         rebuilding at a higher FPPOW, qrack_types.hpp:88-138 — here it
-        is a live dtype switch, no state round-trip)."""
+        is a live dtype switch, no state round-trip).
+
+        CAVEAT (the QRACK_TPU_AUTO_F64_DRIFT opt-in buys into this):
+        float64 planes require ``jax_enable_x64``, and that flag is
+        PROCESS-GLOBAL — flipping it mid-run changes default dtype
+        promotion for every JAX computation in the process, not just
+        this engine, and invalidates already-compiled programs (XLA
+        recompiles on the next dispatch of each).  Engines created
+        before the flip keep working — their f32 planes carry explicit
+        dtypes — but any tracing that relied on x64-off weak-type
+        defaults may see different dtypes from here on.  When the flip
+        happens after tracing has begun (some program already compiled),
+        an extra warning + telemetry event flags the recompile storm."""
         import warnings
 
         if not jax.config.jax_enable_x64:
+            already_traced = False
+            try:
+                already_traced = _j_apply_2x2._cache_size() > 0
+            except Exception:
+                pass
             jax.config.update("jax_enable_x64", True)
+            _tele.event("engine.tpu.x64_flip",
+                        after_tracing=bool(already_traced),
+                        observed_norm=observed_norm)
+            if already_traced:
+                warnings.warn(
+                    "QRACK_TPU_AUTO_F64_DRIFT escalation enabled "
+                    "jax_enable_x64 AFTER programs were already traced: "
+                    "the flag is process-global, so every live jitted "
+                    "program recompiles on next dispatch and non-qrack "
+                    "JAX code in this process now sees x64 defaults",
+                    RuntimeWarning)
         if self.dtype == jnp.dtype("float64"):
             return
+        _tele.event("engine.tpu.f64_escalation",
+                    observed_norm=observed_norm,
+                    drift_thresh=self._drift_thresh,
+                    width=self.qubit_count)
         warnings.warn(
             f"f32 norm drift {observed_norm!r} exceeded "
             f"QRACK_TPU_AUTO_F64_DRIFT={self._drift_thresh}: escalating "

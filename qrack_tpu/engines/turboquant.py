@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from ..ops import gatekernels as gk
 from ..storage import turboquant as tq
+from .. import telemetry as _tele
 from .tpu import QEngineTPU
 
 
@@ -64,16 +65,19 @@ from .tpu import QEngineTPU
 # ---------------------------------------------------------------------------
 
 # compiled chunked-gate programs, keyed on (kind, layout, gate statics) —
-# the same cached-builder discipline as parallel/pager.py's _PROGRAMS
-_PROGRAMS: dict = {}
+# the same cached-builder discipline as parallel/pager.py's _PROGRAMS,
+# but BOUNDED: an LRU with a cap (QRACK_TQ_PROGRAM_CACHE_CAP) so a
+# long-lived process stops accumulating compiled programs forever, and
+# mesh-derived key parts (QPagerTurboQuant._layout_key) are weakly tied
+# to their mesh — entries die with it instead of pinning it.  Hit/miss/
+# eviction stats surface as compile.turboquant.* telemetry counters and
+# via _PROGRAMS.stats().
+_PROGRAMS = _tele.ProgramCache(
+    "turboquant", cap_env="QRACK_TQ_PROGRAM_CACHE_CAP", default_cap=256)
 
 
 def _program(key, builder):
-    fn = _PROGRAMS.get(key)
-    if fn is None:
-        fn = builder()
-        _PROGRAMS[key] = fn
-    return fn
+    return _PROGRAMS.get_or_build(key, builder)
 
 
 def _dec_rows_f(codes, scales, rot_t, qmax):
@@ -311,6 +315,8 @@ _ZERO = 0  # cid0 for the single-device engine (weak-typed int32 operand)
 
 class QEngineTurboQuant(QEngineTPU):
     """Dense ket resident as rotated b-bit block codes (lossy)."""
+
+    _tele_name = "turboquant"
 
     def __init__(self, qubit_count: int, init_state: int = 0,
                  bits: int = None, block_pow: int = None,

@@ -33,12 +33,24 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence, Union
 
+from . import telemetry as _tele
+
 OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
 OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
 _TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt",
              "bdt_attached", "unit_clifford", "sparse", "turboquant",
              "turboquant_pager"}
+
+
+def _counted(name: str, fn: Callable) -> Callable:
+    """Count stack instantiations per layer (telemetry: factory.create.*).
+    The wrapper only runs at construction time, never per gate."""
+    def make(n, **kw):
+        if _tele._ENABLED:
+            _tele.inc(f"factory.create.{name}")
+        return fn(n, **kw)
+    return make
 
 
 def _terminal_factory(name: str, **opts) -> Callable:
@@ -107,34 +119,35 @@ def build_factory(layers: Sequence[str], **opts) -> Callable:
     if head in _TERMINAL:
         if rest:
             raise ValueError(f"terminal layer {head!r} must be last")
-        return _terminal_factory(head, **opts)
+        return _counted(head, _terminal_factory(head, **opts))
     below = build_factory(rest, **opts) if rest else None
 
     if head == "unit":
         from .layers.qunit import QUnit
 
-        return lambda n, **kw: QUnit(n, unit_factory=below, **kw)
+        return _counted(head, lambda n, **kw: QUnit(n, unit_factory=below, **kw))
     if head == "unit_multi":
         from .layers.qunitmulti import QUnitMulti
 
-        return lambda n, **kw: QUnitMulti(n, unit_factory=below, **kw)
+        return _counted(head, lambda n, **kw: QUnitMulti(n, unit_factory=below, **kw))
     if head == "stabilizer_hybrid":
         from .layers.stabilizerhybrid import QStabilizerHybrid
 
-        return lambda n, **kw: QStabilizerHybrid(n, engine_factory=below, **kw)
+        return _counted(head, lambda n, **kw: QStabilizerHybrid(n, engine_factory=below, **kw))
     if head == "tensor_network":
         from .layers.qtensornetwork import QTensorNetwork
 
-        return lambda n, **kw: QTensorNetwork(n, stack_factory=below, **kw)
+        return _counted(head, lambda n, **kw: QTensorNetwork(n, stack_factory=below, **kw))
     if head == "bdt_hybrid":
         from .layers.qbdthybrid import QBdtHybrid
 
-        return lambda n, **kw: QBdtHybrid(n, engine_factory=below, **kw)
+        return _counted(head, lambda n, **kw: QBdtHybrid(n, engine_factory=below, **kw))
     if head == "noisy":
         from .layers.noisy import QInterfaceNoisy
 
         noise = opts.get("noise")
-        return lambda n, **kw: QInterfaceNoisy(n, inner_factory=below, noise=noise, **kw)
+        return _counted(head, lambda n, **kw: QInterfaceNoisy(
+            n, inner_factory=below, noise=noise, **kw))
     raise ValueError(f"unknown layer {head!r}")
 
 
@@ -155,6 +168,8 @@ def create_quantum_interface(layers: Union[str, Sequence[str]], qubit_count: int
             layers = (layers,)
     opts = {k: kwargs.pop(k) for k in ("noise", "devices", "n_pages", "dtype")
             if k in kwargs}
+    if _tele._ENABLED:
+        _tele.inc("factory.create_interface")
     factory = build_factory(tuple(layers), **opts)
     return factory(qubit_count, init_state=init_state, **kwargs)
 

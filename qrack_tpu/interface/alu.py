@@ -84,11 +84,24 @@ class AluMixin:
             # zero-bit register: value 0 — global flip iff 0 in range
             # (-I on any qubit outside the controls is a global -1)
             if lo <= 0 < hi:
+                ctrls = tuple(extra_controls)
                 t = 0
-                while t in extra_controls:
+                while t in ctrls:
                     t += 1
-                self.MCMtrxPerm(tuple(extra_controls), minus_i2, t,
-                                extra_perm)
+                if t < self.qubit_count:
+                    self.MCMtrxPerm(ctrls, minus_i2, t, extra_perm)
+                elif ctrls:
+                    # every qubit is a control: demote the last control
+                    # to the target with a one-sided phase matrix — the
+                    # -1 fires on exactly the same basis states (a bare
+                    # scan here used to pick t == qubit_count and throw)
+                    pos = len(ctrls) - 1
+                    want1 = (extra_perm >> pos) & 1
+                    ph = (mat.phase_mtrx(1, -1) if want1
+                          else mat.phase_mtrx(-1, 1))
+                    self.MCMtrxPerm(ctrls[:pos], ph, ctrls[pos],
+                                    extra_perm & ((1 << pos) - 1))
+                # zero-qubit interface: nothing to phase, silently done
             return
         if lo >= hi or hi <= 0 or lo >= (1 << length):
             return

@@ -15,6 +15,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..interface import QInterface
+from .. import telemetry as _tele
 from .qbdt import QBdt
 
 
@@ -58,6 +59,8 @@ class QBdtHybrid(QInterface):
             return
         if state is None:
             state = self.bdt.GetQuantumState()
+        if _tele._ENABLED:
+            _tele.event("qbdt.to_dense", width=self.qubit_count)
         self.engine = self._factory(self.qubit_count, rng=self.rng.spawn(), **self._kw)
         self.engine.SetQuantumState(state)
         self.bdt = None

@@ -56,6 +56,7 @@ import numpy as np
 from ..config import FP_NORM_EPSILON, TRYDECOMPOSE_EPSILON
 from ..interface import QInterface
 from .. import matrices as mat
+from .. import telemetry as _tele
 
 
 def _default_unit_factory(n, **kw):
@@ -276,6 +277,12 @@ class QUnit(QInterface):
     def ResetUnitaryFidelity(self) -> None:
         self.log_fidelity = 0.0
 
+    def _dispatch(self, n: int = 1) -> None:
+        """One (or n) engine gate dispatches escaped the fusion buffers."""
+        self.dispatch_count += n
+        if _tele._ENABLED:
+            _tele.inc("qunit.gate.dispatch", n)
+
     def _check_fidelity(self) -> None:
         # NOTE: matches the reference exactly — the SAME env toggle gates
         # both ACE and this floor (include/qunit.hpp:107-118), so from the
@@ -285,6 +292,9 @@ class QUnit(QInterface):
         # mid-run.
         if (not self.is_ace
                 and self.log_fidelity <= math.log(FP_NORM_EPSILON)):
+            if _tele._ENABLED:
+                _tele.event("qunit.fidelity_guard.trip",
+                            log_fidelity=self.log_fidelity)
             raise RuntimeError(
                 "QUnit fidelity estimate is effectively 0! (This does NOT "
                 "necessarily mean the true fidelity is near 0 — consider "
@@ -366,6 +376,8 @@ class QUnit(QInterface):
         eng.SetQuantumState(np.array([s.amp0, s.amp1], dtype=np.complex128))
         s.unit = eng
         s.mapped = 0
+        if _tele._ENABLED:
+            _tele.inc("qunit.unit_fresh")
         return eng
 
     _ACE_ADVISORY = ("QUnit needed to engage automatic circuit elision (ACE) "
@@ -385,6 +397,8 @@ class QUnit(QInterface):
         for u in units[1:]:
             offset = base.qubit_count
             base.Compose(u)
+            if _tele._ENABLED:
+                _tele.inc("qunit.compose")
             for s in self.shards:
                 if s.unit is u:
                     s.unit = base
@@ -455,7 +469,7 @@ class QUnit(QInterface):
             s.amp1 *= complex(phases[1])
         else:
             s.unit.MCMtrxPerm((), np.diag(phases), s.mapped, 0)
-            self.dispatch_count += 1
+            self._dispatch()
 
     def _apply_base_monomial(self, s: _Shard, op: np.ndarray) -> None:
         """Apply a 2x2 monomial at the *base* level of shard s."""
@@ -466,7 +480,7 @@ class QUnit(QInterface):
             s.amp0, s.amp1 = op[0, 1] * s.amp1, op[1, 0] * s.amp0
         else:
             s.unit.MCMtrxPerm((), op, s.mapped, 0)
-            self.dispatch_count += 1
+            self._dispatch()
 
     def _base_prob1(self, s: _Shard) -> float:
         """P(bit = 1) at the *base* level of shard s (below pendings and
@@ -542,23 +556,23 @@ class QUnit(QInterface):
         if np.allclose(d0, 1.0, atol=_EPS):
             if not np.allclose(d1, 1.0, atol=_EPS):
                 unit.MCMtrxPerm((a.mapped,), np.diag(d1), b.mapped, 1)
-                self.dispatch_count += 1
+                self._dispatch()
         elif np.allclose(d1, 1.0, atol=_EPS):
             unit.MCMtrxPerm((a.mapped,), np.diag(d0), b.mapped, 0)
-            self.dispatch_count += 1
+            self._dispatch()
         else:
             unit.MCMtrxPerm((), np.diag(d0), b.mapped, 0)
             unit.MCMtrxPerm((a.mapped,), np.diag(d1 / d0), b.mapped, 1)
-            self.dispatch_count += 2
+            self._dispatch(2)
         if link.has_invert:
             ctrl, tgt = (a, b) if link.xt is b else (b, a)
             if link.x[0] and link.x[1]:
                 unit.MCMtrxPerm((), mat.X2, tgt.mapped, 0)
-                self.dispatch_count += 1
+                self._dispatch()
             else:
                 fire = 1 if link.x[1] else 0
                 unit.MCMtrxPerm((ctrl.mapped,), mat.X2, tgt.mapped, fire)
-                self.dispatch_count += 1
+                self._dispatch()
 
     def _flush_links(self, q: int) -> None:
         s = self.shards[q]
@@ -579,7 +593,7 @@ class QUnit(QInterface):
             s.amp0, s.amp1 = a0, a1
         else:
             s.unit.MCMtrxPerm((), m, s.mapped, 0)
-            self.dispatch_count += 1
+            self._dispatch()
 
     def _flush(self, q: int) -> None:
         """Clear all buffers above qubit q (links first, then pending)."""
@@ -595,7 +609,7 @@ class QUnit(QInterface):
         s = self.shards[q]
         if not self.phase_fusion and not s.cached:
             s.unit.MCMtrxPerm((), m, s.mapped, 0)
-            self.dispatch_count += 1
+            self._dispatch()
             return
         if s.cached and not s.links:
             # free host math on the cached amplitudes (pending is only
@@ -817,7 +831,7 @@ class QUnit(QInterface):
             return
         mapped_ctrls = tuple(self.shards[c].mapped for c in live)
         unit.MCMtrxPerm(mapped_ctrls, m, self.shards[target].mapped, live_perm)
-        self.dispatch_count += 1
+        self._dispatch()
 
     def Swap(self, q1: int, q2: int) -> None:
         """Logical shard exchange — zero engine work (reference:
@@ -841,7 +855,7 @@ class QUnit(QInterface):
             apply_small_unitary_via_primitive(self, m, (q1, q2))
             return
         if hasattr(unit, "Apply4x4"):
-            self.dispatch_count += 1
+            self._dispatch()
             unit.Apply4x4(m, self.shards[q1].mapped, self.shards[q2].mapped)
         else:
             from ..interface.synth import apply_small_unitary_via_primitive
@@ -1150,6 +1164,8 @@ class QUnit(QInterface):
         unit = s.unit
         mapped = s.mapped
         if unit is not None:
+            if _tele._ENABLED:
+                _tele.inc("qunit.separate")
             if unit.qubit_count > 1:
                 unit.Dispose(mapped, 1, 1 if collapsed_val else 0)
                 for other in self.shards:

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from .qunit import QUnit
+from .. import telemetry as _tele
 
 # The reference caps one ket at device-global/3 (OclMemDenom,
 # include/qengine_opencl.hpp:279): gate application transiently holds
@@ -103,16 +104,20 @@ class QUnitMulti(QUnit):
         if device_ids is None:
             device_ids = sorted(jdevs) if jdevs else [0]
         # optional capability weights (relative throughput), e.g.
-        # QRACK_QUNITMULTI_WEIGHTS=1.0,4.0 — one per device id; on one
-        # chip class they stay uniform (MeasureDeviceWeights can derive
-        # them from a live probe instead)
-        wenv = os.environ.get("QRACK_QUNITMULTI_WEIGHTS", "")
-        weights = ([float(t) for t in wenv.split(",") if t.strip()]
-                   if wenv else [])
+        # QRACK_QUNITMULTI_WEIGHTS=1.0,4.0 (positional: k-th token goes
+        # to the k-th SELECTED device, which is NOT necessarily device id
+        # k when QRACK_QUNITMULTI_DEVICES reorders or subsets) or the
+        # unambiguous QRACK_QUNITMULTI_WEIGHTS=0=1.0,3=4.0 (id=weight
+        # pairs; unlisted ids default to 1.0).  Mixed forms are an error.
+        # On one chip class weights stay uniform (MeasureDeviceWeights
+        # can derive them from a live probe instead).
+        weights, wmap = QUnitMulti._parse_weights(
+            os.environ.get("QRACK_QUNITMULTI_WEIGHTS", ""))
         table = [
             DeviceInfo(device_id=i,
                        capacity_bytes=_discover_capacity(jdevs[i]) if i in jdevs else 0,
-                       weight=(weights[k] if k < len(weights) else 1.0))
+                       weight=(wmap.get(i, 1.0) if wmap is not None
+                               else (weights[k] if k < len(weights) else 1.0)))
             for k, i in enumerate(device_ids)
         ]
         unguarded = [d.device_id for d in table if d.capacity_bytes <= 0]
@@ -127,6 +132,29 @@ class QUnitMulti(QUnit):
                 "as runtime OOM instead of MemoryError",
                 RuntimeWarning, stacklevel=3)
         return table
+
+    @staticmethod
+    def _parse_weights(wenv: str):
+        """Parse QRACK_QUNITMULTI_WEIGHTS.  Returns (positional, wmap):
+        exactly one is meaningful — positional list for the bare
+        ``1.0,4.0`` form (wmap is None), id-keyed dict for the
+        ``0=1.0,3=4.0`` form (positional is empty).  Mixing forms
+        raises ValueError."""
+        tokens = [t.strip() for t in wenv.split(",") if t.strip()]
+        if not tokens:
+            return [], None
+        paired = [t for t in tokens if "=" in t]
+        if paired and len(paired) != len(tokens):
+            raise ValueError(
+                "QRACK_QUNITMULTI_WEIGHTS mixes positional and id=weight "
+                f"tokens: {wenv!r} — use one form")
+        if paired:
+            wmap: Dict[int, float] = {}
+            for t in tokens:
+                k, _, v = t.partition("=")
+                wmap[int(k)] = float(v)
+            return [], wmap
+        return [float(t) for t in tokens], None
 
     def MeasureDeviceWeights(self, size: int = 1024, reps: int = 3) -> None:
         """Derive capability weights from a live per-device throughput
@@ -250,6 +278,8 @@ class QUnitMulti(QUnit):
                 seen.add(id(s.unit))
                 units.append(s.unit)
         units.sort(key=lambda u: -u.qubit_count)
+        if _tele._ENABLED:
+            _tele.inc("qunitmulti.redistribute")
         order = self._capability_order()
         for d in self.devices:
             d.used_bytes = 0

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..interface import QInterface
 from .. import matrices as mat
+from .. import telemetry as _tele
 from .stabilizer import QStabilizer, CliffordError, clifford_sequence
 
 
@@ -104,6 +105,9 @@ class QStabilizerHybrid(QInterface):
         if self.engine is not None:
             return
         width = self.qubit_count + self._anc
+        if _tele._ENABLED:
+            _tele.event("stabilizer.to_dense", width=width,
+                        ancillae=self._anc)
         ket = self.stab.GetQuantumState()
         self.engine = self._factory(width, rng=self.rng.spawn(),
                                     **self._eng_kwargs)

@@ -35,6 +35,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry as _tele
+
 
 _INITIALIZED = False
 
@@ -97,6 +99,10 @@ def init_cluster(
     )
     global _INITIALIZED
     _INITIALIZED = True
+    if _tele._ENABLED:
+        _tele.event("cluster.init",
+                    num_processes=jax.process_count(),
+                    process_id=jax.process_index())
 
 
 def process_count() -> int:

@@ -42,10 +42,18 @@ from .. import matrices as mat
 
 
 # ---------------------------------------------------------------------------
-# cached sharded programs, keyed on (n_pages, local_width, static params)
+# cached sharded programs, keyed on (n_pages, local_width, static params).
+# Bounded LRU (QRACK_QPAGER_PROGRAM_CACHE_CAP): compiled shard_map
+# programs close over their mesh, so an unbounded dict pins every mesh a
+# long-lived process ever built; the mesh part of each key is weakly
+# tied to the mesh (see QPager._key) so entries die with it.  Hit/miss/
+# eviction traffic surfaces as compile.pager.* telemetry counters.
 # ---------------------------------------------------------------------------
 
-_PROGRAMS: dict = {}
+from .. import telemetry as _tele
+
+_PROGRAMS = _tele.ProgramCache(
+    "pager", cap_env="QRACK_QPAGER_PROGRAM_CACHE_CAP", default_cap=256)
 
 
 def pager_devices_from_env():
@@ -76,11 +84,7 @@ def pager_devices_from_env():
 
 
 def _program(key, builder):
-    fn = _PROGRAMS.get(key)
-    if fn is None:
-        fn = builder()
-        _PROGRAMS[key] = fn
-    return fn
+    return _PROGRAMS.get_or_build(key, builder)
 
 
 def _state_specs(n_scalars: int):
@@ -107,6 +111,7 @@ class QPager(QEngine):
     """Paged dense engine over a 1-D 'pages' mesh axis."""
 
     _xp = jnp
+    _tele_name = "pager"
 
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
                  n_pages: Optional[int] = None, dtype=None, **kwargs):
@@ -191,7 +196,16 @@ class QPager(QEngine):
     # ------------------------------------------------------------------
 
     def _key(self, *parts):
-        return (self.n_pages, self.local_bits, id(self.mesh)) + parts
+        # mesh_token == id(mesh), but weakly tied: when the mesh is
+        # collected, every cached program keyed to it is dropped
+        return (self.n_pages, self.local_bits,
+                _PROGRAMS.mesh_token(self.mesh)) + parts
+
+    def _tele_exchange(self, op: str, nbytes: float) -> None:
+        """Count one ICI exchange dispatch and its payload bytes
+        (host-side accounting of what the collective moves)."""
+        _tele.inc(f"exchange.pager.{op}")
+        _tele.inc("exchange.pager.bytes", nbytes)
 
     def _p_local_2x2(self, target):
         from ..ops import sharded as shb
@@ -347,6 +361,9 @@ class QPager(QEngine):
             self._state = self._p_local_2x2(target)(self._state, mp, lmask, lval, gmask, gval)
         else:
             gpos = target - self.local_bits
+            if _tele._ENABLED:
+                # pair exchange: half a page out + half back per page
+                self._tele_exchange("global_2x2", self._state.nbytes)
             self._state = self._p_global_2x2(gpos)(self._state, mp, lmask, lval, gmask, gval)
 
     def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
@@ -374,6 +391,10 @@ class QPager(QEngine):
         if q2 < L:
             self._state = self._p_local_swap(q1, q2)(self._state)
         elif q1 >= L:
+            if _tele._ENABLED:
+                # page-pointer permutation: the half of the pages whose
+                # g1/g2 bits differ ship their whole local buffer
+                self._tele_exchange("meta_swap", self._state.nbytes / 2)
             self._state = self._p_meta_swap(q1 - L, q2 - L)(self._state)
         else:
             # mixed local/global: 3 controlled inverts through the
@@ -490,6 +511,10 @@ class QPager(QEngine):
 
         prog = _program(self._key("gatherw") + tuple(key), build)
         args = [jnp.asarray(t, dtype=gk.IDX_DTYPE) for t in targs]
+        if _tele._ENABLED:
+            # ring gather: n_pages-1 full-buffer rotations
+            self._tele_exchange(
+                "ring_gather", self._state.nbytes * (self.n_pages - 1))
         self._state = prog(self._state, *args)
 
     def _p_out_of_place(self, with_passthrough: bool):
@@ -645,6 +670,10 @@ class QPager(QEngine):
             # B IS replicated here, so the path is gated on B at most
             # one page's size (n2 <= local_bits); bigger composed-in
             # states keep the einsum form, where GSPMD may shard B
+            if _tele._ENABLED:
+                # B is replicated; the A pages ring-rotate npg-1 times
+                self._tele_exchange(
+                    "compose_ring", self._state.nbytes * (self.n_pages - 1))
             new_state = self._p_compose_ring(n1, n2, start)(self._state, b)
         else:
             new_state = self._p_compose(n1, n2, start)(self._state, b)
@@ -865,6 +894,10 @@ class QPager(QEngine):
         (a mesh spanning jax.distributed processes), the window is
         replicated through a collective program first — the only legal
         read pattern on such meshes (see parallel/cluster.py)."""
+        if _tele._ENABLED:
+            itemsize = jnp.dtype(self.dtype).itemsize
+            _tele.inc("exchange.pager.host_fetch")
+            _tele.inc("exchange.pager.host_fetch_bytes", 2 * length * itemsize)
         if self._state.is_fully_addressable:
             return np.asarray(
                 jax.device_get(self._state[:, offset:offset + length]),

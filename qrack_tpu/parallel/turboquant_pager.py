@@ -55,6 +55,7 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
 
     # the Pallas fused path is single-device; the mesh keeps shard_map
     _pallas_capable = False
+    _tele_name = "turboquant_pager"
 
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
                  n_pages=None, **kwargs):
@@ -91,8 +92,10 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
     def _layout_key(self):
         # mesh identity in the key: cached shard_map programs close over
         # the mesh, so two instances on different device sets must not
-        # share them (same rule as QPager._key, parallel/pager.py:167)
-        return super()._layout_key() + (self.n_pages, id(self.mesh))
+        # share them (same rule as QPager._key).  The token is id(mesh)
+        # weakly tied to the mesh — entries are purged when it dies.
+        return super()._layout_key() + (
+            self.n_pages, tqe._PROGRAMS.mesh_token(self.mesh))
 
     def _local_chunk_bits(self) -> int:
         return self.qubit_count - self._tq_chunk_pow - self.g_bits
@@ -194,6 +197,12 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
         n_pages, lcb = self.n_pages, self._local_chunk_bits()
         mesh = self.mesh
         perm = [(i, i ^ (1 << page_bit)) for i in range(n_pages)]
+        if tqe._tele._ENABLED:
+            # compressed ICI: every page ppermutes its whole codes+scales
+            # shard to its pair partner (the b-bit win rides the wire too)
+            tqe._tele.inc("exchange.turboquant_pager.cross_gate")
+            tqe._tele.inc("exchange.turboquant_pager.bytes",
+                          self._codes.nbytes + self._scales.nbytes)
 
         def build():
             def shard_fn(codes3, scales2, rot, rot_t, mp,

@@ -1,0 +1,372 @@
+"""Process-wide telemetry: counters, honest-sync spans, and exporters.
+
+The whole engine stack is instrumented through this module (see
+docs/OBSERVABILITY.md for the metric namespace).  Everything is gated
+on ``QRACK_TPU_TELEMETRY=1`` (or :func:`enable`): when disabled, every
+entry point returns after one module-global boolean read and records
+NOTHING — hot gate paths guard with ``if telemetry._ENABLED:`` so even
+the counter-name f-string is never built.
+
+Three surfaces:
+
+* **counters** — :func:`inc` monotonic named counters (gate dispatches
+  by kind/width/engine, compile-cache hits/misses/evictions, pager
+  exchange events + bytes, layer escalations).
+* **spans** — ``with telemetry.span("qft.w28", sync=planes):`` nestable
+  wall-clock timers.  With ``sync=`` the exit is bracketed by a real
+  1-amplitude ``jax.device_get`` read and the empty-queue round trip is
+  subtracted — the utils/timing.py methodology, because
+  ``block_until_ready`` over the axon relay acks dispatch, not
+  completion (docs/TPU_EVIDENCE.md).  A span without ``sync=`` is
+  host-wall only and is marked ``synced: False`` in the trace.
+* **export** — :func:`snapshot` (plain dict), :func:`write_jsonl`
+  (atexit-armed via ``QRACK_TPU_TELEMETRY_OUT=path``),
+  :func:`chrome_trace` (Perfetto-loadable trace-event JSON), and
+  :func:`xplane_bracket` (a ``jax.profiler`` trace bracket whose dumps
+  ``scripts/analyze_xplane.py`` consumes).
+
+Compile-cache accounting comes from two helpers:
+:class:`ProgramCache`, the bounded-LRU replacement for the module-level
+``_PROGRAMS`` dicts (parallel/pager.py, engines/turboquant.py), and
+:func:`instrument_jit`, a thin wrapper over module-level ``jax.jit``
+programs (engines/tpu.py) that classifies each call as hit or miss via
+the jitted function's ``_cache_size()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+__all__ = [
+    "enabled", "enable", "disable", "inc", "event", "span", "snapshot",
+    "reset", "write_jsonl", "chrome_trace", "write_chrome_trace",
+    "xplane_bracket", "instrument_jit", "ProgramCache",
+]
+
+# single hot-path gate: instrumentation sites read this module attribute
+# directly (`if telemetry._ENABLED:`) so the disabled cost is one dict
+# lookup + truth test, with no call and no string formatting
+_ENABLED: bool = os.environ.get("QRACK_TPU_TELEMETRY", "") not in ("", "0")
+
+_LOCK = threading.Lock()
+_EPOCH = time.perf_counter()  # trace timestamps are relative to import
+
+_COUNTERS: Dict[str, float] = {}
+_SPANS: Dict[str, List[float]] = {}   # name -> [count, total_s, min_s, max_s]
+_TRACE: List[dict] = []               # chrome-trace "X" complete events
+_EVENTS: List[dict] = []              # discrete annotated events
+_TRACE_CAP = int(os.environ.get("QRACK_TPU_TELEMETRY_TRACE_CAP", "65536"))
+_EVENT_CAP = int(os.environ.get("QRACK_TPU_TELEMETRY_EVENT_CAP", "4096"))
+
+_TLS = threading.local()  # per-thread span stack (nesting depth)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable() -> None:
+    """Turn telemetry on at runtime (tests; equivalent of the env gate).
+    Arms the atexit JSONL dump if QRACK_TPU_TELEMETRY_OUT is set."""
+    global _ENABLED
+    _ENABLED = True
+    from . import export
+
+    export.arm_atexit()
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def reset() -> None:
+    """Drop all recorded data (counters, spans, traces, events)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _SPANS.clear()
+        _TRACE.clear()
+        _EVENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# counters + events
+# ---------------------------------------------------------------------------
+
+def inc(name: str, n: float = 1) -> None:
+    """Add `n` to the named monotonic counter (no-op when disabled)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def event(name: str, **fields) -> None:
+    """Record a discrete annotated event AND bump its counter.  Events
+    are capped at QRACK_TPU_TELEMETRY_EVENT_CAP; drops are counted."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + 1
+        if len(_EVENTS) < _EVENT_CAP:
+            _EVENTS.append({"name": name,
+                            "t_s": time.perf_counter() - _EPOCH, **fields})
+        else:
+            _COUNTERS["telemetry.events.dropped"] = \
+                _COUNTERS.get("telemetry.events.dropped", 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "sync", "t0", "depth")
+
+    def __init__(self, name: str, sync=None):
+        self.name = name
+        self.sync = sync
+
+    def __enter__(self):
+        stack = getattr(_TLS, "stack", None)
+        if stack is None:
+            stack = _TLS.stack = []
+        self.depth = len(stack)
+        stack.append(self.name)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self.sync is not None:
+            # honest completion: a real device->host read, then subtract
+            # the empty-queue round trip of that read itself
+            # (utils/timing.py devget_sync / empty_queue_sync_s —
+            # block_until_ready over the relay acks dispatch only)
+            from ..utils.timing import devget_sync, empty_queue_sync_s
+
+            devget_sync(self.sync)
+            t1 = time.perf_counter()
+            sync_s = empty_queue_sync_s(self.sync, reps=1)
+            wall = max(t1 - self.t0 - sync_s, 0.0)
+        else:
+            wall = time.perf_counter() - self.t0
+        _TLS.stack.pop()
+        with _LOCK:
+            agg = _SPANS.get(self.name)
+            if agg is None:
+                _SPANS[self.name] = [1, wall, wall, wall]
+            else:
+                agg[0] += 1
+                agg[1] += wall
+                agg[2] = min(agg[2], wall)
+                agg[3] = max(agg[3], wall)
+            if len(_TRACE) < _TRACE_CAP:
+                _TRACE.append({
+                    "name": self.name,
+                    "ts_s": self.t0 - _EPOCH,
+                    "dur_s": wall,
+                    "tid": threading.get_ident(),
+                    "depth": self.depth,
+                    "synced": self.sync is not None,
+                })
+            else:
+                _COUNTERS["telemetry.trace.dropped"] = \
+                    _COUNTERS.get("telemetry.trace.dropped", 0) + 1
+        return False
+
+
+def span(name: str, sync=None):
+    """Nestable wall-clock timer.  `sync` takes the device array (e.g.
+    the (2, 2^n) planes) whose queue the span must drain before its
+    clock stops — without it the span is an untrusted host wall."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _Span(name, sync)
+
+
+# ---------------------------------------------------------------------------
+# compile-cache accounting
+# ---------------------------------------------------------------------------
+
+class _JitProgram:
+    """Transparent wrapper over a module-level jitted program that
+    counts `compile.<name>.miss` (a call that grew the jit cache — XLA
+    compiled) vs `.hit` (dispatch straight from cache).  Disabled path:
+    one boolean test, then the raw call."""
+
+    __slots__ = ("_fn", "_name")
+
+    def __init__(self, name: str, fn):
+        self._fn = fn
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        if not _ENABLED:
+            return self._fn(*args, **kwargs)
+        try:
+            before = self._fn._cache_size()
+        except Exception:
+            before = None
+        out = self._fn(*args, **kwargs)
+        if before is None:
+            inc(f"compile.{self._name}.call")
+        elif self._fn._cache_size() > before:
+            inc(f"compile.{self._name}.miss")
+        else:
+            inc(f"compile.{self._name}.hit")
+        return out
+
+    def __getattr__(self, attr):  # lower/_cache_size/etc. pass through
+        return getattr(self._fn, attr)
+
+
+def instrument_jit(name: str, fn):
+    """Wrap a jitted callable for per-call compile hit/miss counting."""
+    return _JitProgram(name, fn)
+
+
+class ProgramCache:
+    """Bounded LRU of compiled programs with hit/miss/eviction stats.
+
+    Replacement for the module-global ``_PROGRAMS: dict`` pattern: a
+    long-lived process no longer accumulates one compiled program (and
+    its closed-over mesh) per key forever.  Keys are tuples; a key part
+    produced by :meth:`mesh_token` is weakly tied to its mesh — when the
+    mesh is garbage-collected every entry keyed to it is dropped, so
+    dead meshes cannot pin compiled programs until LRU pressure.
+
+    Stats are kept unconditionally (they are O(1) ints); the telemetry
+    counters mirror them only while telemetry is enabled.
+    """
+
+    def __init__(self, name: str, cap: Optional[int] = None,
+                 cap_env: str = "QRACK_TPU_PROGRAM_CACHE_CAP",
+                 default_cap: int = 256):
+        if cap is None:
+            cap = int(os.environ.get(cap_env, str(default_cap)))
+        self.name = name
+        self.cap = max(1, cap)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._od: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_or_build(self, key, builder):
+        with self._lock:
+            fn = self._od.get(key)
+            if fn is not None:
+                self._od.move_to_end(key)
+                self.hits += 1
+                if _ENABLED:
+                    inc(f"compile.{self.name}.hit")
+                return fn
+        fn = builder()  # build outside the lock: builders trace/compile
+        with self._lock:
+            self._od[key] = fn
+            self._od.move_to_end(key)
+            self.misses += 1
+            if _ENABLED:
+                inc(f"compile.{self.name}.miss")
+            while len(self._od) > self.cap:
+                self._od.popitem(last=False)
+                self.evictions += 1
+                if _ENABLED:
+                    inc(f"compile.{self.name}.eviction")
+        return fn
+
+    def mesh_token(self, mesh) -> int:
+        """A cache-key part for `mesh` that is weakly tied to it: a
+        finalizer drops every entry containing the token once the mesh
+        is collected (id() alone would let dead meshes pin programs)."""
+        import weakref
+
+        token = id(mesh)
+        try:
+            weakref.finalize(mesh, self._drop_token, token)
+        except TypeError:
+            pass  # non-weakref-able key source: LRU cap still bounds us
+        return token
+
+    def _drop_token(self, token: int) -> None:
+        def has(part) -> bool:
+            if part == token and isinstance(part, int):
+                return True
+            if isinstance(part, tuple):
+                return any(has(p) for p in part)
+            return False
+
+        with self._lock:
+            dead = [k for k in self._od if has(k)]
+            for k in dead:
+                del self._od[k]
+                self.evictions += 1
+            if dead and _ENABLED:
+                inc(f"compile.{self.name}.eviction", len(dead))
+
+    def stats(self) -> dict:
+        return {"size": len(self._od), "cap": self.cap, "hits": self.hits,
+                "misses": self.misses, "evictions": self.evictions}
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+    def __contains__(self, key) -> bool:
+        return key in self._od
+
+    def clear(self) -> None:
+        with self._lock:
+            self._od.clear()
+
+
+# ---------------------------------------------------------------------------
+# snapshot
+# ---------------------------------------------------------------------------
+
+def snapshot(include_events: bool = True) -> dict:
+    """Plain-dict view of everything recorded so far (JSON-safe)."""
+    with _LOCK:
+        out = {
+            "enabled": _ENABLED,
+            "pid": os.getpid(),
+            "counters": dict(_COUNTERS),
+            "spans": {
+                name: {"count": int(agg[0]), "total_s": agg[1],
+                       "min_s": agg[2], "max_s": agg[3]}
+                for name, agg in _SPANS.items()
+            },
+        }
+        if include_events:
+            out["events"] = list(_EVENTS)
+    return out
+
+
+# exporters live in export.py; re-export the public surface
+from .export import (  # noqa: E402  (cycle-safe: export imports nothing above lazily)
+    chrome_trace, write_chrome_trace, write_jsonl, xplane_bracket,
+)
+
+# arm the atexit JSONL dump when the env gate + out path are both set
+if _ENABLED and os.environ.get("QRACK_TPU_TELEMETRY_OUT"):
+    from .export import arm_atexit as _arm
+
+    _arm()

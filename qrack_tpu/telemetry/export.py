@@ -1,0 +1,134 @@
+"""Telemetry exporters: JSONL snapshots, Chrome trace-event JSON, and
+the jax.profiler xplane bracket.
+
+Formats:
+
+* **JSONL** — one :func:`qrack_tpu.telemetry.snapshot` dict per line,
+  appended (a long campaign accumulates a history; consumers take the
+  last line).  Armed at process exit by ``QRACK_TPU_TELEMETRY_OUT``.
+* **Chrome trace-event JSON** — the `{"traceEvents": [...]}` object
+  format (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU):
+  spans become ``"ph": "X"`` complete events, discrete telemetry events
+  become ``"ph": "i"`` instants, and every counter's final value is one
+  ``"ph": "C"`` sample at the end of the trace.  Loads directly in
+  Perfetto / chrome://tracing.
+* **xplane** — :func:`xplane_bracket` wraps ``jax.profiler``
+  start/stop_trace; the resulting ``*.xplane.pb`` dumps are what
+  ``scripts/analyze_xplane.py`` parses for on-device op walls.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Optional
+
+_US = 1e6
+_ATEXIT_ARMED = False
+
+
+def write_jsonl(path: Optional[str] = None) -> str:
+    """Append one snapshot line to `path` (default:
+    QRACK_TPU_TELEMETRY_OUT).  Returns the path written."""
+    from . import snapshot
+
+    if path is None:
+        path = os.environ.get("QRACK_TPU_TELEMETRY_OUT", "")
+    if not path:
+        raise ValueError(
+            "no output path: pass one or set QRACK_TPU_TELEMETRY_OUT")
+    with open(path, "a") as f:
+        f.write(json.dumps(snapshot()) + "\n")
+    return path
+
+
+def _dump() -> None:
+    """The registered exit hook: re-reads the enable gate and the out
+    path at exit time, and never raises."""
+    from . import _ENABLED
+
+    if _ENABLED and os.environ.get("QRACK_TPU_TELEMETRY_OUT"):
+        try:
+            write_jsonl()
+        except Exception:
+            pass  # exit hooks must never raise
+
+
+def arm_atexit() -> None:
+    """Register the one-shot exit dump (idempotent; no-op without an
+    out path at exit time)."""
+    global _ATEXIT_ARMED
+    if _ATEXIT_ARMED:
+        return
+    _ATEXIT_ARMED = True
+    import atexit
+
+    atexit.register(_dump)
+
+
+def chrome_trace() -> dict:
+    """Trace-event JSON object for the current telemetry state."""
+    from . import _EVENTS, _LOCK, _TRACE, snapshot
+
+    pid = os.getpid()
+    evs = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "qrack_tpu"},
+    }]
+    with _LOCK:
+        trace = list(_TRACE)
+        events = list(_EVENTS)
+    end_us = 0.0
+    for t in trace:
+        ts = t["ts_s"] * _US
+        dur = t["dur_s"] * _US
+        end_us = max(end_us, ts + dur)
+        evs.append({
+            "name": t["name"], "ph": "X", "cat": "span",
+            "ts": ts, "dur": dur, "pid": pid, "tid": t["tid"],
+            "args": {"depth": t["depth"], "synced": t["synced"]},
+        })
+    for e in events:
+        ts = e["t_s"] * _US
+        end_us = max(end_us, ts)
+        args = {k: v for k, v in e.items() if k not in ("name", "t_s")}
+        evs.append({
+            "name": e["name"], "ph": "i", "cat": "event", "s": "p",
+            "ts": ts, "pid": pid, "tid": 0, "args": args,
+        })
+    for name, value in sorted(snapshot(include_events=False)["counters"].items()):
+        evs.append({
+            "name": name, "ph": "C", "ts": end_us, "pid": pid, "tid": 0,
+            "args": {"value": value},
+        })
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(), f)
+    return path
+
+
+@contextlib.contextmanager
+def xplane_bracket(logdir: Optional[str] = None, name: str = "telemetry"):
+    """Bracket a region with a jax.profiler trace when telemetry is on
+    and a log dir is configured (arg or QRACK_TPU_TELEMETRY_XPLANE);
+    otherwise a pass-through.  The dump under `logdir` is the input to
+    scripts/analyze_xplane.py."""
+    from . import _ENABLED, event
+
+    if logdir is None:
+        logdir = os.environ.get("QRACK_TPU_TELEMETRY_XPLANE", "")
+    if not (_ENABLED and logdir):
+        yield None
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+        event("telemetry.xplane.dump", logdir=logdir, region=name)

@@ -1,0 +1,130 @@
+"""Summarize a telemetry snapshot JSONL (QRACK_TPU_TELEMETRY_OUT).
+
+Each line of the input is one qrack_tpu.telemetry.snapshot() dict
+(docs/OBSERVABILITY.md); a long campaign appends many.  By default the
+LAST line is reported — pass --all to aggregate every line (counters
+sum; spans merge).  Sections:
+
+  * top gate counters (gate.<engine>.<kind>.w<width>), grouped and raw
+  * compile-cache traffic: hit/miss/eviction per cache, miss ratio
+  * exchange traffic: pager/ICI event counts and bytes
+  * layer events (qunit/stabilizer/qbdt/hybrid/factory escalations)
+  * spans: count, total, mean
+
+Usage: python scripts/telemetry_report.py tele.jsonl [--all] [--top N]
+       python scripts/telemetry_report.py tele.jsonl --json
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load(path: str, aggregate: bool) -> dict:
+    snaps = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                snaps.append(json.loads(line))
+    if not snaps:
+        raise SystemExit(f"no snapshot lines in {path}")
+    if not aggregate:
+        return snaps[-1]
+    merged = {"counters": defaultdict(float), "spans": {}, "lines": len(snaps)}
+    for s in snaps:
+        for k, v in s.get("counters", {}).items():
+            merged["counters"][k] += v
+        for name, agg in s.get("spans", {}).items():
+            cur = merged["spans"].get(name)
+            if cur is None:
+                merged["spans"][name] = dict(agg)
+            else:
+                cur["count"] += agg["count"]
+                cur["total_s"] += agg["total_s"]
+                cur["min_s"] = min(cur["min_s"], agg["min_s"])
+                cur["max_s"] = max(cur["max_s"], agg["max_s"])
+    merged["counters"] = dict(merged["counters"])
+    return merged
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def report(snap: dict, top: int) -> dict:
+    counters = snap.get("counters", {})
+    gates = {k: v for k, v in counters.items() if k.startswith("gate.")}
+    out = {
+        "top_gates": sorted(gates.items(), key=lambda kv: -kv[1])[:top],
+        "gates_total": sum(gates.values()),
+        "compile": {},
+        "exchange": {},
+        "layer_events": {},
+        "spans": snap.get("spans", {}),
+    }
+    for k, v in counters.items():
+        if k.startswith("compile."):
+            # compile.<cache>.<hit|miss|eviction|call> — cache names may
+            # themselves be dotted (compile.tpu.apply_2x2.miss)
+            cache, _, kind = k[len("compile."):].rpartition(".")
+            out["compile"].setdefault(cache, {})[kind] = v
+        elif k.startswith("exchange."):
+            out["exchange"][k] = v
+        elif k.split(".")[0] in ("qunit", "qunitmulti", "stabilizer",
+                                 "qbdt", "hybrid", "factory", "engine",
+                                 "cluster"):
+            out["layer_events"][k] = v
+    for cache, kinds in out["compile"].items():
+        total = kinds.get("hit", 0) + kinds.get("miss", 0)
+        if total:
+            kinds["miss_ratio"] = round(kinds.get("miss", 0) / total, 4)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="snapshot JSONL (QRACK_TPU_TELEMETRY_OUT)")
+    ap.add_argument("--all", action="store_true",
+                    help="aggregate every line instead of taking the last")
+    ap.add_argument("--top", type=int, default=10,
+                    help="gate counters to show (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    args = ap.parse_args(argv)
+
+    rep = report(load(args.path, args.all), args.top)
+    if args.json:
+        print(json.dumps(rep, indent=1, sort_keys=True))
+        return 0
+
+    print(f"== top gates (of {rep['gates_total']:.0f} total dispatches) ==")
+    for name, v in rep["top_gates"]:
+        print(f"  {name:<40s} {v:>12.0f}")
+    print("== compile caches ==")
+    for cache, kinds in sorted(rep["compile"].items()):
+        parts = " ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
+        print(f"  {cache:<40s} {parts}")
+    print("== exchange ==")
+    for name, v in sorted(rep["exchange"].items()):
+        shown = _fmt_bytes(v) if name.endswith("bytes") else f"{v:.0f}"
+        print(f"  {name:<40s} {shown:>12s}")
+    print("== layer events ==")
+    for name, v in sorted(rep["layer_events"].items()):
+        print(f"  {name:<40s} {v:>12.0f}")
+    if rep["spans"]:
+        print("== spans ==")
+        for name, agg in sorted(rep["spans"].items()):
+            mean = agg["total_s"] / max(agg["count"], 1)
+            print(f"  {name:<32s} n={agg['count']:<6d} "
+                  f"total={agg['total_s']:.6f}s mean={mean:.6f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -429,3 +429,36 @@ def test_phase_flip_if_less_zero_length_register():
     np.testing.assert_allclose(np.asarray(b.GetQuantumState()),
                                o.GetQuantumState(), atol=1e-10)
     assert o.GetQuantumState()[0] == pytest.approx(-0.5)
+
+
+def test_phase_flip_zero_length_all_qubits_controlled():
+    """Regression: the zero-length branch of _phase_flip_if_in_range
+    scans for a free qubit to carry the -I; when every qubit is a
+    control it used to pick target == qubit_count and throw.  The fix
+    demotes the last control to the target with a one-sided phase."""
+    # public-surface repro: flag control exhausts a 1-qubit engine
+    q = make(1, perm=1)
+    q.CPhaseFlipIfLess(1, 0, 0, 0)  # 0-bit register, 0 < 1: flip iff flag
+    assert q.GetQuantumState()[1] == pytest.approx(-1.0)
+    q0 = make(1, perm=0)
+    q0.CPhaseFlipIfLess(1, 0, 0, 0)  # flag clear: no flip
+    assert q0.GetQuantumState()[0] == pytest.approx(1.0)
+
+    # multi-control: -1 exactly on the perm-selected basis state
+    q2 = make(2)
+    q2.H(0); q2.H(1)
+    q2._phase_flip_if_in_range(0, 1, 0, 0, extra_controls=(0, 1), extra_perm=3)
+    np.testing.assert_allclose(q2.GetQuantumState(), [0.5, 0.5, 0.5, -0.5],
+                               atol=1e-10)
+    q3 = make(2)
+    q3.H(0); q3.H(1)
+    q3._phase_flip_if_in_range(0, 1, 0, 0, extra_controls=(0, 1), extra_perm=0)
+    np.testing.assert_allclose(q3.GetQuantumState(), [-0.5, 0.5, 0.5, 0.5],
+                               atol=1e-10)
+
+    # a free qubit exists: unchanged behavior (global -I via free qubit)
+    q4 = make(3)
+    q4.H(0); q4.H(1)
+    q4._phase_flip_if_in_range(0, 1, 0, 0, extra_controls=(0, 1), extra_perm=3)
+    st = q4.GetQuantumState()
+    np.testing.assert_allclose(st[:4], [0.5, 0.5, 0.5, -0.5], atol=1e-10)

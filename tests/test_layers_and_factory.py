@@ -510,3 +510,30 @@ def test_qunitmulti_measured_weights():
                    device_table=table, rand_global_phase=False)
     q.MeasureDeviceWeights(size=128, reps=2)
     assert q.devices[0].weight == 1.0   # fastest device normalizes to 1
+
+
+def test_qunitmulti_weights_env_forms(monkeypatch):
+    """QRACK_QUNITMULTI_WEIGHTS parses both the positional form (k-th
+    token -> k-th SELECTED device) and the id=weight pair form (keyed by
+    device id, robust to QRACK_QUNITMULTI_DEVICES reordering); mixing
+    the two is rejected."""
+    # positional: tokens follow the SELECTION order, not the device id
+    assert QUnitMulti._parse_weights("1.0,4.0") == ([1.0, 4.0], None)
+    # id=weight pairs: keyed by device id, unlisted ids default later
+    assert QUnitMulti._parse_weights("0=1.0,3=4.0") == ([], {0: 1.0, 3: 4.0})
+    assert QUnitMulti._parse_weights("") == ([], None)
+    with pytest.raises(ValueError, match="mixes positional"):
+        QUnitMulti._parse_weights("1.0,3=4.0")
+
+    monkeypatch.setenv("QRACK_QUNITMULTI_DEVICES", "")
+    monkeypatch.setenv("QRACK_QUNITMULTI_MAX_QB", "20")
+    # pair form applies by id even when the selection reorders ids
+    monkeypatch.setenv("QRACK_QUNITMULTI_WEIGHTS", "2=8.0,0=2.0")
+    table = QUnitMulti._build_device_table([2, 0, 1])
+    by_id = {d.device_id: d.weight for d in table}
+    assert by_id == {2: 8.0, 0: 2.0, 1: 1.0}
+    # positional form applies by selection position
+    monkeypatch.setenv("QRACK_QUNITMULTI_WEIGHTS", "8.0,2.0")
+    table = QUnitMulti._build_device_table([2, 0, 1])
+    by_pos = [d.weight for d in table]
+    assert by_pos == [8.0, 2.0, 1.0]
