@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import resilience as _res
 from .. import telemetry as _tele
 from ..config import get_config
 from ..utils.rng import QrackRandom
@@ -44,28 +45,46 @@ class QHybrid:
         self._devices = devices
         self._kwargs = dict(kwargs)
         self._kwargs["rng"] = rng if rng is not None else QrackRandom()
+        # failover ceiling: None = healthy; "tpu" = pager died, never
+        # re-promote past single-device; "cpu" = tunnel unusable, pin
+        # to host (resilience layer, docs/RESILIENCE.md)
+        self._failed_over: Optional[str] = None
         self._engine = self._make_engine(qubit_count, init_state)
 
     # ------------------------------------------------------------------
 
     def _mode_for(self, qubit_count: int) -> str:
-        if qubit_count < self._tpu_threshold:
+        if self._failed_over == "cpu" or qubit_count < self._tpu_threshold:
             return "cpu"
-        if qubit_count <= self._pager_threshold:
+        if qubit_count <= self._pager_threshold or self._failed_over == "tpu":
             return "tpu"
         return "pager"
 
     def _make_engine(self, qubit_count: int, init_state: int = 0, mode: Optional[str] = None):
         if mode is None:
             mode = self._mode_for(qubit_count)
-        if mode == "cpu":
-            return QEngineCPU(qubit_count, init_state=init_state, **self._kwargs)
-        if mode == "tpu":
-            return QEngineTPU(qubit_count, init_state=init_state, **self._kwargs)
-        from ..parallel.pager import QPager
+        try:
+            if mode == "cpu":
+                return QEngineCPU(qubit_count, init_state=init_state, **self._kwargs)
+            if mode == "tpu":
+                return QEngineTPU(qubit_count, init_state=init_state, **self._kwargs)
+            from ..parallel.pager import QPager
 
-        return QPager(qubit_count, init_state=init_state, devices=self._devices,
-                      **self._kwargs)
+            return QPager(qubit_count, init_state=init_state, devices=self._devices,
+                          **self._kwargs)
+        except _res.FAILOVER_ERRORS as e:
+            # construction-time failover (discover/first-compile died):
+            # degrade the target mode and rebuild
+            from .tpu import MAX_DENSE_QB
+
+            fallback = ("tpu" if mode == "pager"
+                        and qubit_count <= MAX_DENSE_QB else "cpu")
+            self._failed_over = fallback
+            if _tele._ENABLED:
+                _tele.event(f"resilience.failover.init_{mode}_to_{fallback}",
+                            width=qubit_count, cause=type(e).__name__)
+                _tele.inc("resilience.failovers")
+            return self._make_engine(qubit_count, init_state, mode=fallback)
 
     def _maybe_switch(self) -> None:
         """Re-materialize the ket when the width crosses a threshold
@@ -92,8 +111,30 @@ class QHybrid:
     # full-surface forwarding with structural hooks
     # ------------------------------------------------------------------
 
+    def _fail_over(self, cause) -> None:
+        """In-place degradation: snapshot the ket off the failing engine
+        and continue the circuit on the next engine down (pager→tpu→cpu).
+        The ceiling sticks for this instance — a healed tunnel serves the
+        NEXT circuit, via the breaker's half-open probe."""
+        from ..resilience.failover import _engine_kind, fail_over_engine
+
+        fallback = fail_over_engine(self._engine, cause)
+        self._failed_over = _engine_kind(fallback)
+        self._engine = fallback
+
     def __getattr__(self, name):
-        return getattr(self._engine, name)
+        val = getattr(self._engine, name)
+        if not _res._ACTIVE or not callable(val):
+            return val
+
+        def call(*args, **kwargs):
+            try:
+                return getattr(self._engine, name)(*args, **kwargs)
+            except _res.FAILOVER_ERRORS as e:
+                self._fail_over(e)
+                return getattr(self._engine, name)(*args, **kwargs)
+
+        return call
 
     def _grow_to(self, n_new: int, mode: str, full_state) -> None:
         """Host-stage into a target-mode engine at the grown width (it
@@ -159,6 +200,7 @@ class QHybrid:
         c._kwargs = dict(self._kwargs)
         # fresh stream: the clone must not consume the original's RNG
         c._kwargs["rng"] = self._kwargs["rng"].spawn()
+        c._failed_over = self._failed_over
         c._engine = self._engine.Clone()
         return c
 

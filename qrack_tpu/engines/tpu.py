@@ -34,17 +34,55 @@ from ..ops import gatekernels as gk
 from .qengine import QEngine
 from .. import matrices as mat
 from .. import telemetry as _tele
+from .. import resilience as _res
 
 
 # ---------------------------------------------------------------------------
 # module-level jitted programs, shared by every engine instance.  The
 # telemetry wrapper classifies each call as compile.<name>.miss (the jit
 # cache grew — XLA compiled) or .hit; with telemetry disabled it is a
-# single boolean test over the raw jitted callable.
+# single boolean test over the raw jitted callable.  The resilience
+# wrapper outside it guards the whole compile-or-dispatch at site
+# "tpu.compile" (watchdog / retry / breaker) — same off-by-default
+# one-boolean-test discipline.
 # ---------------------------------------------------------------------------
 
 def _jit(name, fn, **kw):
-    return _tele.instrument_jit(f"tpu.{name}", jax.jit(fn, **kw))
+    return _res.instrument_dispatch(
+        "tpu.compile", _tele.instrument_jit(f"tpu.{name}", jax.jit(fn, **kw)))
+
+
+def _device_get(fn, *args):
+    """Host-read boundary (site "tpu.device_get"): the only sync that
+    proves completion over the relay — and therefore the one that hangs
+    when the tunnel wedges mid-flight."""
+    if _res._ACTIVE:
+        return _res.call_guarded("tpu.device_get", fn, args)
+    return fn(*args)
+
+
+def _discover(device_id: int):
+    """jax.devices() backend init (site "discover") — the single worst
+    hang site (CLAUDE.md: wedges for hours).  With resilience active it
+    is breaker-gated and, under QRACK_TPU_PROBE_FIRST=1, preceded by a
+    SIGTERM-first subprocess probe so the wedge is detected by a
+    killable child instead of this process."""
+    if device_id < 0:
+        return None
+    if not _res._ACTIVE:
+        return jax.devices()[device_id]
+    import os as _os
+
+    if _os.environ.get("QRACK_TPU_PROBE_FIRST", "") not in ("", "0"):
+        from ..resilience import probe as _probe
+        from ..resilience.errors import DispatchGiveUp, DispatchTimeout
+
+        r = _probe.ensure_backend()
+        if not r.ok:
+            _res.get_breaker().record_failure("discover")
+            raise DispatchGiveUp(
+                "discover", DispatchTimeout("discover", detail="probe failed"))
+    return _res.call_guarded("discover", lambda: jax.devices()[device_id])
 
 
 _j_apply_2x2 = _jit("apply_2x2", gk.apply_2x2, static_argnums=(2, 3), donate_argnums=(0,))
@@ -101,7 +139,7 @@ class QEngineTPU(QEngine):
         self._drift_check_every = max(1, int(_os.environ.get(
             "QRACK_TPU_DRIFT_CHECK_GATES", "64")))
         self._gate_count = 0
-        self._device = jax.devices()[device_id] if device_id >= 0 else None
+        self._device = _discover(device_id)
         self._device_id = device_id
         self._state = None  # (2, 2^n) planes
         self.SetPermutation(init_state)
@@ -282,7 +320,8 @@ class QEngineTPU(QEngine):
     def MAll(self) -> int:
         """Device-side categorical sample; no 2^n host transfer
         (reference MAll ships probabilities to host)."""
-        result = int(_j_sample(self._state, float(self.Rand())))
+        r = float(self.Rand())
+        result = _device_get(lambda st: int(_j_sample(st, r)), self._state)
         self.SetPermutation(result)
         return result
 
@@ -359,7 +398,7 @@ class QEngineTPU(QEngine):
     # ------------------------------------------------------------------
 
     def GetQuantumState(self) -> np.ndarray:
-        return gk.from_planes(self._state)
+        return _device_get(gk.from_planes, self._state)
 
     def SetQuantumState(self, state) -> None:
         st = np.asarray(state).reshape(-1)
@@ -368,7 +407,8 @@ class QEngineTPU(QEngine):
         self._state = self._put(gk.to_planes(st, self.dtype))
 
     def GetAmplitude(self, perm: int) -> complex:
-        amp = np.asarray(self._state[:, perm], dtype=np.float64)
+        amp = _device_get(
+            lambda st: np.asarray(st[:, perm], dtype=np.float64), self._state)
         return complex(amp[0], amp[1])
 
     def SetAmplitude(self, perm: int, amp: complex) -> None:
@@ -404,14 +444,14 @@ class QEngineTPU(QEngine):
 
     def Finish(self) -> None:
         if self._state is not None:
-            self._state.block_until_ready()
+            _device_get(self._state.block_until_ready)
 
     # -- device placement (reference: SetDevice, opencl.cpp:535) --
 
     def SetDevice(self, device_id: int) -> None:
         if device_id == self._device_id:
             return
-        self._device = jax.devices()[device_id] if device_id >= 0 else None
+        self._device = _discover(device_id)
         self._device_id = device_id
         self._state = self._put(self._state)
 
@@ -427,7 +467,9 @@ class QEngineTPU(QEngine):
         return not bool(jnp.any(self._state != 0))
 
     def GetAmplitudePage(self, offset: int, length: int) -> np.ndarray:
-        return gk.from_planes(self._state[:, offset:offset + length])
+        return _device_get(
+            lambda st: gk.from_planes(st[:, offset:offset + length]),
+            self._state)
 
     def SetAmplitudePage(self, page, offset: int) -> None:
         self._state = self._state.at[:, offset:offset + len(page)].set(

@@ -76,8 +76,13 @@ _PROGRAMS = _tele.ProgramCache(
     "turboquant", cap_env="QRACK_TQ_PROGRAM_CACHE_CAP", default_cap=256)
 
 
-def _program(key, builder):
-    return _PROGRAMS.get_or_build(key, builder)
+def _program(key, builder, site: str = "turboquant.dispatch"):
+    # cached-with-the-program resilience wrapper — same discipline as
+    # parallel/pager.py's _program (disabled cost: one boolean test)
+    from .. import resilience as _res
+
+    return _PROGRAMS.get_or_build(
+        key, lambda: _res.instrument_dispatch(site, builder()))
 
 
 def _dec_rows_f(codes, scales, rot_t, qmax):

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence, Union
 
+from . import resilience as _res
 from . import telemetry as _tele
 
 OPTIMAL = ("unit", "stabilizer_hybrid", "hybrid")
@@ -50,6 +51,30 @@ def _counted(name: str, fn: Callable) -> Callable:
         if _tele._ENABLED:
             _tele.inc(f"factory.create.{name}")
         return fn(n, **kw)
+    return make
+
+
+# terminals that dispatch over the tunnel without their own failover
+# logic (QHybrid fails over in place; cpu/stabilizer/... never dispatch)
+_ACCEL_TERMINALS = {"tpu", "pager", "turboquant", "turboquant_pager"}
+
+
+def _maybe_resilient(name: str, fn: Callable) -> Callable:
+    """Wrap a bare accelerator terminal in ResilientEngine when the
+    resilience layer is active, so a factory-built stack gets the same
+    TPU→CPU degradation QHybrid provides (construction-time failures
+    included).  _ACTIVE is re-read per construction: enabling resilience
+    after import still takes effect."""
+    if name not in _ACCEL_TERMINALS:
+        return fn
+
+    def make(n, **kw):
+        if not _res._ACTIVE:
+            return fn(n, **kw)
+        from .resilience.failover import ResilientEngine
+
+        return ResilientEngine.build(fn, n, **kw)
+
     return make
 
 
@@ -119,7 +144,7 @@ def build_factory(layers: Sequence[str], **opts) -> Callable:
     if head in _TERMINAL:
         if rest:
             raise ValueError(f"terminal layer {head!r} must be last")
-        return _counted(head, _terminal_factory(head, **opts))
+        return _counted(head, _maybe_resilient(head, _terminal_factory(head, **opts)))
     below = build_factory(rest, **opts) if rest else None
 
     if head == "unit":

@@ -226,6 +226,7 @@ class QCircuit:
         from ..ops import gatekernels as gk
         from ..ops import sharded as sh
         from ..utils.bits import control_offset
+        from ..utils.compat import shard_map as _compat_shard_map
 
         npg = mesh.devices.size
         g_bits = npg.bit_length() - 1
@@ -258,7 +259,7 @@ class QCircuit:
             return local
 
         fn = jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=P(None, "pages"),
+            _compat_shard_map(body, mesh=mesh, in_specs=P(None, "pages"),
                           out_specs=P(None, "pages")),
             donate_argnums=(0,),
         )

@@ -22,6 +22,7 @@ import os
 import numpy as np
 
 import jax
+from ..utils.compat import shard_map as _compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -246,7 +247,7 @@ def make_sharded_qft_fn(mesh: Mesh, n: int, inverse: bool = False,
         return local
 
     fn = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P(None, "pages"), out_specs=P(None, "pages")),
+        _compat_shard_map(body, mesh=mesh, in_specs=P(None, "pages"), out_specs=P(None, "pages")),
         donate_argnums=(0,),
     )
     return fn, sharding

@@ -13,6 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+from ..utils.compat import shard_map as _compat_shard_map
 
 from .. import matrices as mat
 from ..ops import gatekernels as gk
@@ -195,7 +196,7 @@ def make_sharded_rcs_fn(mesh, n: int, depth: int, seed: int,
         return local
 
     fn = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=P(None, "pages"),
+        _compat_shard_map(body, mesh=mesh, in_specs=P(None, "pages"),
                       out_specs=P(None, "pages")),
         donate_argnums=(0,),
     )

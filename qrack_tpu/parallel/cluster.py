@@ -39,6 +39,9 @@ from .. import telemetry as _tele
 
 
 _INITIALIZED = False
+# effective (coordinator, num_processes, process_id, local_device_ids)
+# of the successful bring-up — repeat calls are checked against it
+_INIT_ARGS: Optional[tuple] = None
 
 
 def is_initialized() -> bool:
@@ -72,20 +75,49 @@ def init_cluster(
     On the CPU backend the gloo collectives implementation is selected
     first — cross-process psum/ppermute need a wire format, and gloo is
     the DCN stand-in there (real TPU meshes use ICI/DCN natively).
-    No-op when called twice or when no coordinator is configured and
-    topology discovery is unavailable.
+    Repeat calls are idempotent ONLY with the same effective arguments;
+    a repeat with different arguments raises RuntimeError (the process
+    is already wired to one coordinator — silently ignoring a new one
+    would leave a half-reconfigured cluster).  A PARTIAL configuration
+    (some of coordinator/num_processes/process_id set, others missing)
+    raises ValueError naming exactly what is missing, instead of
+    letting jax.distributed.initialize hang waiting on a coordinator
+    that was never fully specified.
+    No-op when no coordinator is configured at all (single process).
     """
-    if is_initialized():
-        return
+    global _INITIALIZED, _INIT_ARGS
     coordinator_address = coordinator_address or os.environ.get("QRACK_COORDINATOR")
     if num_processes is None and "QRACK_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["QRACK_NUM_PROCESSES"])
     if process_id is None and "QRACK_PROCESS_ID" in os.environ:
         process_id = int(os.environ["QRACK_PROCESS_ID"])
-    if coordinator_address is None and num_processes is None:
+    effective = (coordinator_address, num_processes, process_id,
+                 tuple(local_device_ids) if local_device_ids is not None
+                 else None)
+    if is_initialized():
+        if _INIT_ARGS is not None and effective != _INIT_ARGS:
+            raise RuntimeError(
+                "init_cluster() called again with different arguments: "
+                f"first {_INIT_ARGS}, now {effective}; jax.distributed "
+                "cannot be re-initialized in a live process — restart it "
+                "to change cluster topology")
+        return
+    if coordinator_address is None and num_processes is None \
+            and process_id is None:
         # single-process: nothing to bring up (mirrors the reference,
         # where cluster backends are compile-time optional)
         return
+    missing = [name for name, val in (
+        ("coordinator_address (or QRACK_COORDINATOR)", coordinator_address),
+        ("num_processes (or QRACK_NUM_PROCESSES)", num_processes),
+        ("process_id (or QRACK_PROCESS_ID)", process_id),
+    ) if val is None]
+    if missing:
+        raise ValueError(
+            "partial cluster configuration: missing "
+            + ", ".join(missing)
+            + " — set all three of coordinator/num_processes/process_id "
+            "(or none, for single-process / TPU-pod auto-discovery)")
     # gloo is the cpu backend's only cross-process wire format; setting
     # it is a no-op for TPU backends, so select it unconditionally
     # (checking the platform here would initialize the backend, which
@@ -97,8 +129,8 @@ def init_cluster(
         process_id=process_id,
         local_device_ids=local_device_ids,
     )
-    global _INITIALIZED
     _INITIALIZED = True
+    _INIT_ARGS = effective
     if _tele._ENABLED:
         _tele.event("cluster.init",
                     num_processes=jax.process_count(),

@@ -32,6 +32,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 import jax
+from ..utils.compat import shard_map as _compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -51,6 +52,7 @@ from .. import matrices as mat
 # ---------------------------------------------------------------------------
 
 from .. import telemetry as _tele
+from .. import resilience as _res
 
 _PROGRAMS = _tele.ProgramCache(
     "pager", cap_env="QRACK_QPAGER_PROGRAM_CACHE_CAP", default_cap=256)
@@ -83,8 +85,14 @@ def pager_devices_from_env():
     return [by_id[i] for i in ids]
 
 
-def _program(key, builder):
-    return _PROGRAMS.get_or_build(key, builder)
+def _program(key, builder, site: str = "pager.dispatch"):
+    # the resilience wrapper is cached WITH the program, so the per-call
+    # disabled cost stays one boolean test (no per-gate allocation);
+    # cross-page collectives pass site="pager.exchange" so fault
+    # injection / breaker accounting can tell ICI traffic from
+    # page-local dispatch
+    return _PROGRAMS.get_or_build(
+        key, lambda: _res.instrument_dispatch(site, builder()))
 
 
 def _state_specs(n_scalars: int):
@@ -95,16 +103,23 @@ def _state_specs(n_scalars: int):
 from ..ops.sharded import split_masks as _split_masks  # single source of truth
 
 
+def _host_read_raw(x) -> np.ndarray:
+    if x.is_fully_addressable:
+        return np.asarray(x)
+    return np.asarray(x.addressable_shards[0].data)
+
+
 def _host_read(x) -> np.ndarray:
-    """Host value of a program output.
+    """Host value of a program output (site "pager.device_get" — the
+    completion-proving sync that hangs when the tunnel wedges).
 
     Multi-host safe for REPLICATED outputs (out_specs=P() /
     out_shardings P()): when the mesh spans jax.distributed processes
     the array is not fully addressable, but any process-local shard of
     a replicated array holds the whole value."""
-    if x.is_fully_addressable:
-        return np.asarray(x)
-    return np.asarray(x.addressable_shards[0].data)
+    if _res._ACTIVE:
+        return _res.call_guarded("pager.device_get", _host_read_raw, (x,))
+    return _host_read_raw(x)
 
 
 class QPager(QEngine):
@@ -216,7 +231,7 @@ class QPager(QEngine):
             def f(local, mp, lmask, lval, gmask, gval):
                 return shb.apply_local_2x2(local, mp, L, target, lmask, lval, gmask, gval)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
             ), donate_argnums=(0,))
 
@@ -231,11 +246,12 @@ class QPager(QEngine):
             def f(local, mp, lmask, lval, gmask, gval):
                 return shb.apply_global_2x2(local, mp, npg, gpos, lmask, lval, gmask, gval)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
             ), donate_argnums=(0,))
 
-        return _program(self._key("g2x2", gpos), build)
+        return _program(self._key("g2x2", gpos), build,
+                        site="pager.exchange")
 
     def _p_diag(self):
         from ..ops import sharded as shb
@@ -243,7 +259,7 @@ class QPager(QEngine):
         mesh = self.mesh
 
         def build():
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 shb.apply_diag, mesh=mesh, in_specs=_state_specs(10),
                 out_specs=P(None, "pages")
             ), donate_argnums=(0,))
@@ -261,7 +277,7 @@ class QPager(QEngine):
                 ok = ((idx & lmask) == lval) & ((pid & gmask) == gval)
                 return jax.lax.psum(jnp.sum(jnp.where(ok, p, 0.0)), "pages")
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=_state_specs(4), out_specs=P()
             ))
 
@@ -278,7 +294,7 @@ class QPager(QEngine):
                 scale = (1.0 / jnp.sqrt(nrm_sq)).astype(local.dtype)
                 return jnp.where(ok, local * scale, jnp.zeros((), local.dtype))
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=_state_specs(5), out_specs=P(None, "pages")
             ), donate_argnums=(0,))
 
@@ -291,7 +307,7 @@ class QPager(QEngine):
             def f(local):
                 return jnp.sum(local[0] ** 2 + local[1] ** 2).reshape(1)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=_state_specs(0), out_specs=P("pages")
             ))
 
@@ -315,11 +331,12 @@ class QPager(QEngine):
             def f(local):
                 return jax.lax.ppermute(local, "pages", perm)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=P(None, "pages"), out_specs=P(None, "pages")
             ), donate_argnums=(0,))
 
-        return _program(self._key("metaswap", g1, g2), build)
+        return _program(self._key("metaswap", g1, g2), build,
+                        site="pager.exchange")
 
     def _p_local_swap(self, q1, q2):
         L, mesh = self.local_bits, self.mesh
@@ -328,7 +345,7 @@ class QPager(QEngine):
             def f(local):
                 return gk.swap_bits(local, L, q1, q2)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=P(None, "pages"), out_specs=P(None, "pages")
             ), donate_argnums=(0,))
 
@@ -343,7 +360,7 @@ class QPager(QEngine):
                 im = jax.lax.psum(jnp.sum(a[0] * b[1] - a[1] * b[0]), "pages")
                 return jnp.maximum(0.0, 1.0 - (re * re + im * im))
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=(P(None, "pages"), P(None, "pages")), out_specs=P()
             ))
 
@@ -455,7 +472,7 @@ class QPager(QEngine):
                 fre, fim = body(jnp, pid, lidx, L, *ta)
                 return gk.cmul(fre, fim, local).astype(local.dtype)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh,
                 in_specs=(P(None, "pages"),) + (P(),) * len(targs),
                 out_specs=P(None, "pages"),
@@ -503,13 +520,14 @@ class QPager(QEngine):
             def f(local, *ta):
                 return shb.gather_ring(local, npg, L, body, ta)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh,
                 in_specs=(P(None, "pages"),) + (P(),) * len(targs),
                 out_specs=P(None, "pages"),
             ), donate_argnums=(0,))
 
-        prog = _program(self._key("gatherw") + tuple(key), build)
+        prog = _program(self._key("gatherw") + tuple(key), build,
+                        site="pager.exchange")
         args = [jnp.asarray(t, dtype=gk.IDX_DTYPE) for t in targs]
         if _tele._ENABLED:
             # ring gather: n_pages-1 full-buffer rotations
@@ -639,11 +657,12 @@ class QPager(QEngine):
             def f(a, b):
                 return shb.compose_ring(a, b, npg, L, start, n1, n2)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=(P(None, "pages"), P()),
                 out_specs=P(None, "pages")), donate_argnums=(0,))
 
-        return _program(self._key("composering", n1, n2, start), build)
+        return _program(self._key("composering", n1, n2, start), build,
+                        site="pager.exchange")
 
     def _k_compose(self, other, start) -> None:
         n1, n2 = self.qubit_count, other.qubit_count
@@ -899,9 +918,15 @@ class QPager(QEngine):
             _tele.inc("exchange.pager.host_fetch")
             _tele.inc("exchange.pager.host_fetch_bytes", 2 * length * itemsize)
         if self._state.is_fully_addressable:
-            return np.asarray(
-                jax.device_get(self._state[:, offset:offset + length]),
-                dtype=np.float64)
+            def read(st):
+                return np.asarray(
+                    jax.device_get(st[:, offset:offset + length]),
+                    dtype=np.float64)
+
+            if _res._ACTIVE:  # site "pager.device_get": the relay sync
+                return _res.call_guarded("pager.device_get", read,
+                                         (self._state,))
+            return read(self._state)
         from .cluster import replicate_program
 
         prog = _program(self._key("replicate", length),

@@ -37,6 +37,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+from ..utils.compat import shard_map as _compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -46,7 +47,7 @@ from ..utils.bits import is_pow2, log2
 
 
 def _shard_map(fn, mesh, in_specs, out_specs, **kw):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+    return _compat_shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, **kw)
 
 
@@ -239,7 +240,7 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
             return jax.jit(f, donate_argnums=(0, 1))
 
         return tqe._program(("tqp_cross", self._layout_key(), page_bit),
-                            build)
+                            build, site="turboquant_pager.exchange")
 
     def _p_diag(self):
         run = tqe._mk_diag(self._tq_chunk_pow, self._block, self._code_np,

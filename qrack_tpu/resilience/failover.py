@@ -1,0 +1,164 @@
+"""Graceful degradation: TPU→CPU (and pager→single-device) failover.
+
+When a guarded dispatch escalates past retry — the breaker is open
+(:class:`~.errors.BreakerOpen`) or retries are exhausted
+(:class:`~.errors.DispatchGiveUp`) — the circuit in flight should
+still COMPLETE, just slower.  The mechanism:
+
+1. snapshot the resident ket off the failing engine
+   (``GetQuantumState`` — a host read that still works when the
+   failure was injected/transient, and is taken under
+   ``faults.suspended()`` so a device_get fault cannot block its own
+   recovery),
+2. build the next engine in the fallback chain
+   (``QPager → QEngineTPU`` (width permitting, breaker willing)
+   ``→ QEngineCPU``), carrying the rng so measurement streams
+   continue unbroken,
+3. rehydrate via ``SetQuantumState`` and replay the ONE failed call.
+
+Because every injected fault fires at site entry and real XLA errors
+surface before results commit (see dispatch.py), the snapshot equals
+the pre-call state and the replayed call produces the same result the
+healthy path would have — the oracle-equivalence property
+tests/test_resilience.py asserts.
+
+Two consumers:
+
+* :class:`ResilientEngine` — a forwarding proxy the factory wraps
+  around bare ``tpu``/``pager`` terminals (factory.py
+  ``_maybe_resilient``).
+* :class:`QHybrid` — already a router; it fails over in place via
+  :func:`fail_over_engine` (engines/hybrid.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import telemetry as _tele
+from . import breaker as _breaker
+from . import faults as _faults
+from .errors import FAILOVER_ERRORS
+
+# attributes that live on the proxy itself, never forwarded
+_SELF_ATTRS = ("_engine", "_chain_pos")
+
+
+def _engine_kind(engine) -> str:
+    name = type(engine).__name__
+    return {"QPager": "pager", "QEngineTPU": "tpu",
+            "QEngineCPU": "cpu"}.get(name, name.lower())
+
+
+def _fallback_candidates(engine):
+    """Yield (kind, builder) pairs downstream of `engine` in the chain
+    pager -> tpu -> cpu.  Builders take (qubit_count, state, rng)."""
+    from ..engines.cpu import QEngineCPU
+    from ..engines.tpu import MAX_DENSE_QB, QEngineTPU
+
+    kind = _engine_kind(engine)
+    n = engine.qubit_count
+    if kind == "pager" and n <= MAX_DENSE_QB \
+            and _breaker.get_breaker().state == "closed":
+        # single-device TPU is only worth trying when the tunnel is not
+        # the thing that just failed (breaker still closed => the
+        # failure was local to the paged path, e.g. one exchange site)
+        yield "tpu", lambda st, rng: _rehydrate(QEngineTPU, n, st, rng)
+    yield "cpu", lambda st, rng: _rehydrate(QEngineCPU, n, st, rng)
+
+
+def _rehydrate(cls, n, state, rng):
+    eng = cls(n, rng=rng)
+    eng.SetQuantumState(state)
+    return eng
+
+
+def fail_over_engine(engine, cause: Optional[BaseException] = None):
+    """Snapshot `engine`'s ket and return a rehydrated fallback engine.
+    Raises the original `cause` (or RuntimeError) when the whole chain
+    is exhausted — e.g. a pager wider than QRACK_MAX_CPU_QB."""
+    with _faults.suspended():
+        state = engine.GetQuantumState()
+        rng = getattr(engine, "rng", None)
+        src = _engine_kind(engine)
+        last_err: Optional[BaseException] = cause
+        for kind, build in _fallback_candidates(engine):
+            try:
+                fallback = build(state, rng)
+            except Exception as e:  # noqa: BLE001 — try next in chain
+                last_err = e
+                continue
+            if _tele._ENABLED:
+                _tele.event(f"resilience.failover.{src}_to_{kind}",
+                            width=engine.qubit_count,
+                            cause=type(cause).__name__ if cause else "")
+                _tele.inc("resilience.failovers")
+            return fallback
+    raise last_err if last_err is not None else RuntimeError(
+        f"no failover target for {src} width {engine.qubit_count}")
+
+
+class ResilientEngine:
+    """Forwarding proxy: any engine method that escalates with a
+    FAILOVER_ERRORS exception is transparently re-run once on the
+    fallback engine (state snapshotted pre-call — see module doc).
+    After failover all subsequent calls go to the fallback; the proxy
+    never fails back (a healed tunnel is the NEXT circuit's business,
+    via the breaker's half-open probe on a fresh engine)."""
+
+    def __init__(self, engine):
+        object.__setattr__(self, "_engine", engine)
+
+    @classmethod
+    def build(cls, factory, *args, **kwargs):
+        """Construction-time failover: when building the primary engine
+        itself dies on a guarded site (discover/first-compile), fall
+        back to QEngineCPU at the same width."""
+        try:
+            return cls(factory(*args, **kwargs))
+        except FAILOVER_ERRORS as e:
+            from ..engines.cpu import QEngineCPU
+
+            n = args[0] if args else kwargs.get("qubit_count")
+            if _tele._ENABLED:
+                _tele.event("resilience.failover.init_to_cpu", width=n,
+                            cause=type(e).__name__)
+                _tele.inc("resilience.failovers")
+            kw = {k: kwargs[k] for k in ("init_state", "rng") if k in kwargs}
+            return cls(QEngineCPU(n, **kw))
+
+    # -- plumbing ------------------------------------------------------
+
+    def _fail_over(self, cause):
+        fallback = fail_over_engine(self._engine, cause)
+        object.__setattr__(self, "_engine", fallback)
+        return fallback
+
+    def __getattr__(self, name):
+        val = getattr(object.__getattribute__(self, "_engine"), name)
+        if not callable(val):
+            return val
+
+        def call(*args, **kwargs):
+            try:
+                return getattr(self._engine, name)(*args, **kwargs)
+            except FAILOVER_ERRORS as e:
+                self._fail_over(e)
+                return getattr(self._engine, name)(*args, **kwargs)
+
+        call.__name__ = name
+        return call
+
+    def __setattr__(self, name, value):
+        if name in _SELF_ATTRS:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._engine, name, value)
+
+    def __repr__(self):
+        return f"ResilientEngine({self._engine!r})"
+
+    # len()/indexing style helpers some call sites use
+    @property
+    def engine(self):
+        return self._engine

@@ -1,0 +1,220 @@
+"""Deterministic fault injection for the resilience layer.
+
+Grammar (``QRACK_TPU_FAULTS``, comma-separated specs):
+
+    site:kind:after_n[:seed]
+
+* ``site`` — a full dispatch-site name (``tpu.compile``,
+  ``pager.exchange``, ...), a bare site category matching any engine
+  (``discover``, ``compile``, ``dispatch``, ``device_get``,
+  ``exchange``), or ``*`` for every site.
+* ``kind`` — ``timeout`` | ``hang`` | ``raise`` | ``nan-poison`` |
+  ``device-loss``.
+* ``after_n`` — how many calls at the site pass through before the
+  fault arms.  ``N`` fires once at call N+1 then heals (the transient
+  case retry must recover); ``N+M`` fires on M consecutive calls;
+  ``N+`` never heals (the persistent case that must trip the breaker
+  or fail over).
+* ``seed`` — optional; when set, each armed call fires with
+  probability 1/2 drawn from a PCG64(seed) stream private to the spec
+  (deterministic given the seed — scripts/fault_soak.py uses this).
+
+Every kind fires at SITE ENTRY, before the guarded callable runs, so
+the resident ket is never donated into a failed dispatch and both
+retry and snapshot-based failover see intact state.  ``nan-poison``
+models the output-validation path (QRACK_TPU_VALIDATE=1) detecting a
+non-finite result; ``hang`` makes the dispatch wrapper run a sleeping
+stub so the watchdog timeout is exercised for real.
+
+Injection is recorded as `resilience.fault.<site>.<kind>` telemetry
+counters/events.  Tests drive the programmatic API (:func:`inject`,
+:func:`clear`, :func:`suspended`) instead of the env var.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .. import telemetry as _tele
+from .errors import (DeviceLost, DispatchFailure, InjectedFault, NaNPoisoned)
+
+KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss")
+
+_LOCK = threading.RLock()
+_SPECS: List["FaultSpec"] = []
+_SUSPENDED = 0  # re-entrant suspension depth (failover snapshots)
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    kind: str
+    after_n: int = 0
+    times: Optional[int] = 1       # None = persistent (never heals)
+    seed: Optional[int] = None
+    calls: int = 0                 # matching calls observed
+    fired: int = 0                 # faults actually delivered
+    _rng: object = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (kinds: {', '.join(KINDS)})")
+        if self.seed is not None:
+            import numpy as np
+
+            self._rng = np.random.Generator(np.random.PCG64(self.seed))
+
+    def matches(self, site: str) -> bool:
+        return (self.site == "*" or self.site == site
+                or site.rsplit(".", 1)[-1] == self.site)
+
+    def should_fire(self) -> bool:
+        """Advance this spec's call counter; True when the fault fires."""
+        self.calls += 1
+        if self.calls <= self.after_n:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self._rng is not None and self._rng.random() >= 0.5:
+            return False
+        self.fired += 1
+        return True
+
+
+def parse_spec(text: str) -> FaultSpec:
+    parts = text.strip().split(":")
+    if len(parts) < 3 or len(parts) > 4:
+        raise ValueError(
+            f"bad fault spec {text!r}: want site:kind:after_n[:seed]")
+    site, kind, after = parts[0], parts[1], parts[2]
+    seed = int(parts[3]) if len(parts) == 4 else None
+    if "+" in after:
+        n, m = after.split("+", 1)
+        times = None if m in ("", "inf") else int(m)
+        after_n = int(n)
+    else:
+        after_n, times = int(after), 1
+    return FaultSpec(site=site, kind=kind, after_n=after_n,
+                     times=times, seed=seed)
+
+
+def load_env(value: Optional[str] = None) -> int:
+    """(Re)load specs from QRACK_TPU_FAULTS; returns the spec count."""
+    if value is None:
+        value = os.environ.get("QRACK_TPU_FAULTS", "")
+    with _LOCK:
+        _SPECS.clear()
+        for tok in value.split(","):
+            if tok.strip():
+                _SPECS.append(parse_spec(tok))
+        return len(_SPECS)
+
+
+def inject(site: str, kind: str, after_n: int = 0,
+           times: Optional[int] = 1, seed: Optional[int] = None) -> FaultSpec:
+    """Programmatic injection (tests).  Activates the resilience layer
+    so guarded sites start checking."""
+    spec = FaultSpec(site=site, kind=kind, after_n=after_n,
+                     times=times, seed=seed)
+    with _LOCK:
+        _SPECS.append(spec)
+    from . import enable
+
+    enable()
+    return spec
+
+
+def clear() -> None:
+    with _LOCK:
+        _SPECS.clear()
+
+
+def specs() -> List[FaultSpec]:
+    with _LOCK:
+        return list(_SPECS)
+
+
+def is_suspended() -> bool:
+    with _LOCK:
+        return _SUSPENDED > 0
+
+
+class suspended:
+    """Re-entrant context manager standing down the WHOLE resilience
+    machinery (injection here; breaker/watchdog via dispatch.py checking
+    :func:`is_suspended`).  Failover snapshots read the ket through it:
+    neither an injected device_get fault nor an already-open breaker may
+    block the recovery path that exists to get state OFF the failing
+    engine (docs/RESILIENCE.md caveats)."""
+
+    def __enter__(self):
+        global _SUSPENDED
+        with _LOCK:
+            _SUSPENDED += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _SUSPENDED
+        with _LOCK:
+            _SUSPENDED -= 1
+        return False
+
+
+def check(site: str) -> Optional[str]:
+    """Evaluate injection at a dispatch site.
+
+    Raises the matching :class:`DispatchFailure` subclass for the
+    ``timeout``/``raise``/``nan-poison``/``device-loss`` kinds, returns
+    the directive string ``"hang"`` (the dispatch wrapper swaps in a
+    sleeping stub), or returns None (no fault).
+    """
+    with _LOCK:
+        if not _SPECS or _SUSPENDED:
+            return None
+        fired_kind = None
+        for spec in _SPECS:
+            if spec.matches(site) and spec.should_fire():
+                fired_kind = spec.kind
+                break
+    if fired_kind is None:
+        return None
+    if _tele._ENABLED:
+        _tele.event(f"resilience.fault.{site}.{fired_kind}")
+    if fired_kind == "hang":
+        return "hang"
+    if fired_kind == "timeout":
+        from .errors import DispatchTimeout
+
+        raise DispatchTimeout(site, detail="injected timeout")
+    if fired_kind == "device-loss":
+        raise DeviceLost(site, "injected device loss")
+    if fired_kind == "nan-poison":
+        raise NaNPoisoned(site, "injected non-finite output")
+    raise InjectedFault(site, "injected failure")
+
+
+def validate_finite(site: str, out) -> None:
+    """QRACK_TPU_VALIDATE=1 hook: raise NaNPoisoned when a float array
+    in `out` holds a non-finite value.  Forces completion of the
+    checked value — a real device sync, so this is an opt-in."""
+    import numpy as np
+
+    vals = out if isinstance(out, (tuple, list)) else (out,)
+    for v in vals:
+        dt = getattr(v, "dtype", None)
+        if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+            continue
+        import jax.numpy as jnp
+
+        if not bool(jnp.all(jnp.isfinite(v))):
+            raise NaNPoisoned(site, "non-finite value in dispatch output")
+
+
+# env-armed at import so `QRACK_TPU_FAULTS=... python app.py` needs no
+# code change (the module only loads when resilience is active/wired)
+if os.environ.get("QRACK_TPU_FAULTS", "").strip():
+    load_env()

@@ -1,0 +1,34 @@
+"""JAX version compatibility shims.
+
+shard_map moved over jax's release history:
+
+* jax <= 0.4.x — ``jax.experimental.shard_map.shard_map`` (and
+  ``jax.shard_map`` does not exist; on 0.4.37 the deprecation
+  machinery raises AttributeError for it)
+* jax >= 0.5/0.6 — ``jax.shard_map`` is the public name
+
+Every call site in this package routes through :func:`shard_map` so
+the resolution happens ONCE here instead of failing at 13 scattered
+sites when the container's jax is on the other side of the move.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # modern public name
+    _shard_map = jax.shard_map  # may raise AttributeError via deprecation
+    _LEGACY = False
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
+    if _LEGACY and "check_vma" in kw:
+        # the replication check was renamed check_rep -> check_vma when
+        # shard_map went public; translate for the experimental form
+        kw["check_rep"] = kw.pop("check_vma")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -1,0 +1,121 @@
+"""Randomized fault soak: the fuzz-API op vocabulary under injected
+faults, vs the CPU oracle.
+
+Each trial builds a TPU-family stack and a QEngineCPU oracle, runs a
+random interleaving of the tests/test_fuzz_api.py op vocabulary
+(SetBit excluded — cross-stack rng streams legitimately diverge on
+measuring ops, CLAUDE.md), and injects one randomized fault spec
+(site x kind x after_n, seeded PCG64) midway.  Whatever the resilience
+layer does — retry through a transient, trip the breaker, fail over to
+CPU — the final state must stay oracle-equivalent, which is exactly
+the "faults may cost time, never correctness" contract.
+
+Usage:
+    python scripts/fault_soak.py [trials] [seed]
+
+Defaults: 100 trials, seed 0.  Exit 0 = all trials oracle-equivalent.
+~100 trials is a few minutes on the CPU backend; the slow-marked
+tests/test_resilience.py::test_fault_soak_smoke runs a short slice in
+CI.  One line of JSON per trial on stdout; a failing trial prints its
+full spec so `python scripts/fault_soak.py 1 <seed>` reproduces it.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
+
+pin_host_cpu(8)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import QEngineCPU, create_quantum_interface  # noqa: E402
+from qrack_tpu import resilience as res  # noqa: E402
+from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
+
+# stacks that exercise each guarded dispatch family
+STACKS = [
+    ("tpu", {}),
+    ("pager", {"n_pages": 4}),
+    ("hybrid", {"tpu_threshold_qubits": 3}),
+]
+SITES = ["*", "tpu.compile", "tpu.device_get", "pager.dispatch",
+         "pager.exchange", "pager.device_get", "compile", "device_get"]
+# hang exercised by the dedicated watchdog tests, not the soak (a
+# watchdog-less hang stub sleeps its full bounded nap per fire — x100
+# trials that is minutes of pure sleep)
+KINDS = ["timeout", "raise", "nan-poison", "device-loss"]
+
+
+def run_trial(trial: int, seed: int) -> dict:
+    rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
+    stack_name, kw = STACKS[trial % len(STACKS)]
+    site = SITES[int(rng.integers(0, len(SITES)))]
+    kind = KINDS[int(rng.integers(0, len(KINDS)))]
+    after_n = int(rng.integers(0, 12))
+    persistent = bool(rng.integers(0, 2))
+    info = {"trial": trial, "stack": stack_name, "site": site, "kind": kind,
+            "after_n": after_n, "persistent": persistent}
+
+    res.faults.clear()
+    res.reset_breaker()
+    res.configure(max_retries=2, backoff_s=0.0, timeout_s=0.0)
+    res.enable()
+    try:
+        o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
+        s = create_quantum_interface(stack_name, N, rng=QrackRandom(trial),
+                                     rand_global_phase=False, **kw)
+        res.faults.inject(site, kind, after_n=after_n,
+                          times=None if persistent else 1)
+        n_ops = 0
+        for _ in range(30):
+            name, args = _ops(rng)
+            if name == "SetBit":
+                continue  # measuring op: cross-stack rng streams diverge
+            getattr(o, name)(*args)
+            getattr(s, name)(*args)
+            n_ops += 1
+        with res.faults.suspended():
+            a = np.asarray(o.GetQuantumState())
+            b = np.asarray(s.GetQuantumState())
+        f = abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
+                                       * np.vdot(b, b).real)
+        info["n_ops"] = n_ops
+        info["fired"] = sum(sp.fired for sp in res.faults.specs())
+        info["breaker"] = res.get_breaker().snapshot()["state"]
+        info["fidelity"] = float(f)
+        info["ok"] = bool(f > 1 - 1e-6)
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        res.faults.clear()
+        res.reset_breaker()
+        res.disable()
+    return info
+
+
+def main(argv) -> int:
+    trials = int(argv[1]) if len(argv) > 1 else 100
+    seed = int(argv[2]) if len(argv) > 2 else 0
+    failures = 0
+    for t in range(trials):
+        info = run_trial(t, seed)
+        print(json.dumps(info), flush=True)
+        if not info["ok"]:
+            failures += 1
+    print(f"SOAK {'FAILED' if failures else 'OK'}: "
+          f"{trials - failures}/{trials} trials oracle-equivalent",
+          flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,24 +1,20 @@
-"""Minimal TPU health probe. Writes result to stdout line-buffered.
+"""Thin wrapper over qrack_tpu.resilience.probe (the probe logic lives
+there, library-ified).
 
-Run ONLY under a hard timeout from a parent; never SIGKILL mid-op if
-avoidable. Exits 0 with PROBE_OK on success.
+Default mode runs the hang-prone payload directly — run it ONLY under a
+hard timeout from a parent (tpu_watch.sh does this).  ``--watchdog``
+runs the payload in a SIGTERM-first watchdogged subprocess instead, so
+no external `timeout` is needed: exits 0 on PROBE_OK, 1 otherwise.
 """
-import time
+import os
+import runpy
+import sys
 
-def main():
-    t0 = time.time()
-    import jax
-    import jax.numpy as jnp
-    devs = jax.devices()
-    print(f"PROBE devices={devs}", flush=True)
-    x = jnp.arange(16, dtype=jnp.float32)
-    y = (x * 2.0 + 1.0).block_until_ready()
-    print(f"PROBE small_op_ok sum={float(y.sum())} t={time.time()-t0:.2f}s", flush=True)
-    # a modestly sized matmul to confirm real compute works
-    a = jnp.ones((512, 512), dtype=jnp.float32)
-    b = (a @ a).block_until_ready()
-    print(f"PROBE matmul_ok val={float(b[0,0])} t={time.time()-t0:.2f}s", flush=True)
-    print("PROBE_OK", flush=True)
+# run the library module by file path: the payload child must not pay
+# for (or hang inside) a full qrack_tpu package import
+_PROBE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "..", "qrack_tpu", "resilience", "probe.py")
 
 if __name__ == "__main__":
-    main()
+    sys.argv[0] = _PROBE
+    runpy.run_path(_PROBE, run_name="__main__")

@@ -20,7 +20,10 @@ PY="${PYTHON:-/opt/venv/bin/python}"
 
 for i in $(seq 1 200); do
   echo "=== probe $i at $(date +%H:%M:%S) ===" >> "$LOG"
-  timeout --signal=TERM --kill-after=15 120 "$PY" scripts/tpu_probe.py > "$ONE" 2>&1
+  # the library watchdog (qrack_tpu.resilience.probe) escalates
+  # SIGTERM -> 15s grace -> SIGKILL -> bounded wait, same policy the
+  # old external `timeout --signal=TERM --kill-after=15 120` provided
+  "$PY" scripts/tpu_probe.py --watchdog --timeout 120 --term-grace 15 > "$ONE" 2>&1
   echo "exit=$? at $(date +%H:%M:%S)" >> "$LOG"
   cat "$ONE" >> "$LOG"
   if grep -q PROBE_OK "$ONE"; then

@@ -8,6 +8,7 @@ jax_platforms="axon,cpu" from sitecustomize at interpreter start, so the
 config must be updated back before any backend init (otherwise a wedged
 TPU tunnel hangs the whole suite)."""
 
+import faulthandler
 import os
 import sys
 
@@ -16,3 +17,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
 
 pin_host_cpu(8)
+
+# Hang forensics: a wedged dispatch (the tunnel's signature failure
+# mode) shows up as a silent stuck suite.  Dump every thread's stack to
+# stderr after QRACK_TEST_DUMP_AFTER seconds (default 15 min — inside
+# the driver's kill window, past any legitimately slow test), repeating
+# so a long hang leaves multiple samples.  SIGTERM (the watchdogs'
+# first signal) also dumps before dying.
+faulthandler.enable()
+_dump_after = float(os.environ.get("QRACK_TEST_DUMP_AFTER", "900"))
+if _dump_after > 0:
+    faulthandler.dump_traceback_later(_dump_after, repeat=True)
+try:
+    import signal
+
+    faulthandler.register(signal.SIGTERM, chain=True)
+except (AttributeError, ValueError):
+    pass  # platform without SIGTERM registration (e.g. non-main thread)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/benchmark tests (tier-1 runs -m 'not slow')")

@@ -101,10 +101,6 @@ def test_qft20_optimal_counts_three_layers():
 
 
 def test_exchange_counters_on_pager():
-    import jax
-
-    if not hasattr(jax, "shard_map"):
-        pytest.skip("QPager needs jax.shard_map (newer jax)")
     tele.enable()
     q = create_quantum_interface("pager", 6, n_pages=4)
     q.H(5)  # global qubit: half-page ppermute exchange
