@@ -148,6 +148,17 @@ class QEngineTPU(QEngine):
     # helpers
     # ------------------------------------------------------------------
 
+    @property
+    def device_planes(self):
+        """The resident (2, 2^n) split-plane ket on device.  The serving
+        batcher stacks these across sessions into one (B, 2, 2^n) vmap
+        operand and writes each slice back after the batched dispatch."""
+        return self._state
+
+    @device_planes.setter
+    def device_planes(self, planes) -> None:
+        self._state = planes
+
     def _check_capacity(self, qubit_count: int) -> None:
         # int32 index math and one-chip HBM both cap a dense shard at
         # MAX_DENSE_QB qubits; Compose/Allocate growth funnels through

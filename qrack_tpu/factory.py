@@ -59,6 +59,19 @@ def _counted(name: str, fn: Callable) -> Callable:
 _ACCEL_TERMINALS = {"tpu", "pager", "turboquant", "turboquant_pager"}
 
 
+def touches_accelerator(layers: Union[str, Sequence[str]]) -> bool:
+    """True when a layer spec's terminal dispatches over the TPU tunnel
+    (directly, or via QHybrid's width switch).  The serving layer uses
+    this to classify sessions for breaker-aware load shedding before an
+    engine exists; a live session is classified by its actual engine."""
+    if isinstance(layers, str):
+        if layers in ("optimal", "optimal_multi"):
+            return True  # OPTIMAL terminates in "hybrid"
+        layers = (layers,)
+    term = layers[-1] if layers else ""
+    return term in _ACCEL_TERMINALS or term == "hybrid"
+
+
 def _maybe_resilient(name: str, fn: Callable) -> Callable:
     """Wrap a bare accelerator terminal in ResilientEngine when the
     resilience layer is active, so a factory-built stack gets the same

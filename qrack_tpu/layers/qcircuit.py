@@ -211,9 +211,41 @@ class QCircuit:
         out.gates = [g.clone() for g in self.gates]
         return out
 
+    def structure_digest(self) -> str:
+        """Stable content hash of the gate sequence — targets, controls,
+        AND payload values.  Two circuits share a digest iff they trace
+        to the same jaxpr with the same baked-in gate constants
+        (compile_fn embeds matrices as literals), which is the batch
+        identity the serving layer keys on."""
+        import hashlib
+
+        h = hashlib.sha1()
+        for g in self.gates:
+            h.update(f"t{g.target};c{g.controls};".encode())
+            for perm in sorted(g.payloads):
+                h.update(f"p{perm}:".encode())
+                h.update(np.ascontiguousarray(g.payloads[perm]).tobytes())
+        return h.hexdigest()
+
+    def shape_key(self, n: int) -> Tuple[int, int, str]:
+        """Batch-bucket key at engine width `n`: (width, gate-count
+        bucket, structure digest).  The digest already implies the gate
+        count; the log2 bucket rides along so occupancy reports group
+        circuits of similar size without parsing digests."""
+        return (n, len(self.gates).bit_length(), self.structure_digest())
+
     # ------------------------------------------------------------------
     # TPU batch path: the whole circuit as one traced program
     # ------------------------------------------------------------------
+
+    def compile_batched_fn(self, n: int):
+        """fn(stacked) applying the circuit over (B, 2, 2^n) stacked
+        kets via vmap over :meth:`compile_fn` — one XLA program for a
+        whole batch of independent sessions (serve/batcher.py)."""
+        import jax
+
+        self._check_fused_range(n)
+        return jax.vmap(self.compile_fn(n))
 
     def compile_sharded_fn(self, mesh, n: int):
         """One jitted program applying the whole circuit to a ket sharded

@@ -16,6 +16,7 @@ comparable with the gate-at-a-time path.
 
 from __future__ import annotations
 
+import cmath
 import math
 import os
 
@@ -153,6 +154,29 @@ def make_qft_fn(n: int, inverse: bool = False, fast: bool | None = None):
         return body(planes, n)
 
     return fn
+
+
+def qft_qcircuit(n: int, inverse: bool = False):
+    """The same QFT as :func:`qft_planes` but as a QCircuit gate-IR
+    object — the form the serving layer batches (QCircuit.shape_key /
+    compile_batched_fn).  Gate order matches QInterface::QFT exactly
+    (reference: src/qinterface/qinterface.cpp:114), so states are
+    bit-for-bit comparable with every other QFT path here."""
+    from ..layers.qcircuit import QCircuit
+    from .. import matrices as mat
+
+    circ = QCircuit(n)
+    end = n - 1
+    for i in range(n):
+        h_bit = i if inverse else end - i
+        if i:
+            for j in range(i):
+                other = h_bit - 1 - j if inverse else h_bit + 1 + j
+                ang = (-1.0 if inverse else 1.0) * math.pi / (1 << (j + 1))
+                circ.append_ctrl((other,), h_bit,
+                                 mat.phase_mtrx(1.0, cmath.exp(1j * ang)), 1)
+        circ.append_1q(h_bit, mat.H2)
+    return circ
 
 
 # ---------------------------------------------------------------------------

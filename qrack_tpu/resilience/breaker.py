@@ -91,6 +91,17 @@ class CircuitBreaker:
                     _tele.event("resilience.breaker.trip", site=site,
                                 consecutive_failures=self.consecutive_failures)
 
+    def open_remaining_s(self) -> float:
+        """Seconds until an OPEN breaker would half-open (0 when closed,
+        half-open, or past cooldown).  Read-only — unlike allow() it
+        never transitions state, so admission-control callers (the serve
+        scheduler's load shedding) can consult it without consuming the
+        half-open probe slot that belongs to the dispatch path."""
+        with self._lock:
+            if self.state != "open":
+                return 0.0
+            return max(0.0, self.cooldown_s - (self._clock() - self.opened_at))
+
     def snapshot(self) -> dict:
         with self._lock:
             return {"state": self.state,

@@ -19,6 +19,11 @@ Grammar (``QRACK_TPU_FAULTS``, comma-separated specs):
   probability 1/2 drawn from a PCG64(seed) stream private to the spec
   (deterministic given the seed — scripts/fault_soak.py uses this).
 
+Specs are validated at parse time against the :data:`SITES` registry
+and :data:`KINDS`: an unknown site or kind raises ValueError listing
+the valid values, because a typo'd env spec that silently never fires
+is worse than no injection at all.
+
 Every kind fires at SITE ENTRY, before the guarded callable runs, so
 the resident ket is never donated into a failed dispatch and both
 retry and snapshot-based failover see intact state.  ``nan-poison``
@@ -42,6 +47,32 @@ from .. import telemetry as _tele
 from .errors import (DeviceLost, DispatchFailure, InjectedFault, NaNPoisoned)
 
 KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss")
+
+# every call_guarded site in the tree (grep '"<name>"' call_guarded /
+# instrument_dispatch / guard_callable call sites when adding one) —
+# QRACK_TPU_FAULTS validates against this registry at parse time so a
+# typo'd site fails LOUDLY instead of configuring an injection that
+# silently never fires.  The programmatic API (inject / FaultSpec) is
+# deliberately unvalidated: tests exercise synthetic sites.
+SITES = (
+    "discover",
+    "tpu.compile", "tpu.device_get",
+    "pager.dispatch", "pager.exchange", "pager.device_get",
+    "turboquant.dispatch", "turboquant_pager.exchange",
+    "serve.dispatch", "serve.device_get",
+)
+# bare last-segment categories that match the site family on any engine
+CATEGORIES = ("discover", "compile", "dispatch", "device_get", "exchange")
+
+
+def validate_site(site: str) -> None:
+    """Raise ValueError (listing the valid values) for a site token that
+    can never match a real dispatch site."""
+    if site == "*" or site in SITES or site in CATEGORIES:
+        return
+    raise ValueError(
+        f"unknown fault site {site!r}; valid sites: {', '.join(SITES)}; "
+        f"categories: {', '.join(CATEGORIES)}; or '*'")
 
 _LOCK = threading.RLock()
 _SPECS: List["FaultSpec"] = []
@@ -91,13 +122,19 @@ def parse_spec(text: str) -> FaultSpec:
         raise ValueError(
             f"bad fault spec {text!r}: want site:kind:after_n[:seed]")
     site, kind, after = parts[0], parts[1], parts[2]
-    seed = int(parts[3]) if len(parts) == 4 else None
-    if "+" in after:
-        n, m = after.split("+", 1)
-        times = None if m in ("", "inf") else int(m)
-        after_n = int(n)
-    else:
-        after_n, times = int(after), 1
+    validate_site(site)
+    try:
+        seed = int(parts[3]) if len(parts) == 4 else None
+        if "+" in after:
+            n, m = after.split("+", 1)
+            times = None if m in ("", "inf") else int(m)
+            after_n = int(n)
+        else:
+            after_n, times = int(after), 1
+    except ValueError:
+        raise ValueError(
+            f"bad fault spec {text!r}: after_n/seed must be integers "
+            "(grammar: site:kind:after_n[:seed], after_n = N | N+M | N+)")
     return FaultSpec(site=site, kind=kind, after_n=after_n,
                      times=times, seed=seed)
 

@@ -1,0 +1,221 @@
+"""The single dispatch-owner thread.
+
+ALL device traffic in a serving process flows through this one daemon
+thread — engine construction (admin jobs), batched circuit dispatch,
+and synchronous reads (measure/sample/get_state as "call" jobs).  That
+codifies the one-jax-client rule in code: concurrent jax clients have
+coincided with fresh tunnel wedges (CLAUDE.md), so serialization is a
+correctness discipline here, not a simplification.
+
+Every batched dispatch is wrapped in resilience.call_guarded at site
+"serve.dispatch" and its completing read at "serve.device_get" (when
+the resilience layer is active), so the watchdog / retry / breaker
+machinery applies to serving exactly as it does to the library path.
+When a dispatch escalates past retry (FAILOVER_ERRORS), every job in
+the batch fails over INDIVIDUALLY: the session's pre-batch ket is
+still intact (the batch stack is a copy, never a donation of resident
+planes), so fail_over_engine snapshots it onto the next engine in the
+pager→tpu→cpu chain and the job replays gate-at-a-time there.
+
+Job completion is devget-honest: a handle only completes after a real
+one-element device->host read of the batched output, because
+block_until_ready over the relay acks dispatch, not completion.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from .. import telemetry as _tele
+from ..resilience.errors import FAILOVER_ERRORS
+from . import batcher as _batcher
+from .scheduler import Job, Scheduler
+from .session import SessionManager, planes_engine
+
+
+class Executor:
+    def __init__(self, scheduler: Scheduler, sessions: SessionManager,
+                 tick_s: float = 0.25, sync: bool = True):
+        self.scheduler = scheduler
+        self.sessions = sessions
+        self.tick_s = tick_s
+        self.sync = sync  # devget-honest completion (QRACK_SERVE_SYNC)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="qrack-serve-executor")
+        self._thread.start()
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(join_timeout)
+            self._thread = None
+
+    @property
+    def thread_ident(self) -> Optional[int]:
+        return self._thread.ident if self._thread else None
+
+    # -- main loop -----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.scheduler.next_batch(timeout=self.tick_s)
+            if batch is None:
+                self.sessions.evict_idle()
+                continue
+            try:
+                self._run(batch)
+            except BaseException as e:  # noqa: BLE001 — never strand handles
+                for job in batch:
+                    if not job.handle.done():
+                        job.handle._fail(e)
+                        self._account(job, ok=False)
+
+    def _run(self, batch: List[Job]) -> None:
+        for job in batch:
+            job.handle._start()
+        if batch[0].batchable:
+            self._run_batched(batch)
+        else:
+            self._run_single(batch[0])
+
+    # -- batched circuit path ------------------------------------------
+
+    def _run_batched(self, jobs: List[Job]) -> None:
+        from .. import resilience as _res
+
+        engines = [planes_engine(j.session.engine) for j in jobs]
+        # a session may have failed over (to a non-plane engine) after
+        # this job was queued as batchable — run those gate-at-a-time
+        stale = [j for j, e in zip(jobs, engines) if e is None]
+        if stale:
+            for job in stale:
+                try:
+                    job.circuit.Run(job.session.engine)
+                except BaseException as e:  # noqa: BLE001
+                    job.handle._fail(e)
+                    self._account(job, ok=False)
+                else:
+                    self._complete(job, None)
+            jobs = [j for j, e in zip(jobs, engines) if e is not None]
+            engines = [e for e in engines if e is not None]
+            if not jobs:
+                return
+        # pin the pre-batch planes: run_batch writes its output back to
+        # the engines BEFORE the honest sync, so a sync-side escalation
+        # must roll the engines back or the failover replay would apply
+        # the circuit twice (scripts/serve_soak.py caught exactly this)
+        pre_planes = [eng.device_planes for eng in engines]
+        span = _tele.span("serve.execute") if _tele._ENABLED else None
+        try:
+            if span:
+                span.__enter__()
+            try:
+                out = _batcher.run_batch(jobs, engines)
+                if self.sync:
+                    if _res._ACTIVE:
+                        _res.call_guarded("serve.device_get",
+                                          _batcher.sync_scalar, (out,))
+                    else:
+                        _batcher.sync_scalar(out)
+            finally:
+                if span:
+                    span.__exit__(None, None, None)
+        except FAILOVER_ERRORS as e:
+            for eng, planes in zip(engines, pre_planes):
+                eng.device_planes = planes
+            self._fail_over_jobs(jobs, e)
+            return
+        for job in jobs:
+            self._complete(job, None)
+
+    def _fail_over_jobs(self, jobs: List[Job], cause) -> None:
+        """Per-job engine failover + gate-at-a-time replay.  Session
+        planes were never donated into the failed batch (the stack is a
+        copy) and _run_batched restored them if the batch had already
+        written back, so each snapshot equals the pre-batch state and
+        the replay is exact."""
+        from ..resilience.failover import fail_over_engine
+
+        if _tele._ENABLED:
+            _tele.inc("serve.batch.failovers")
+        for job in jobs:
+            sess = job.session
+            try:
+                target = planes_engine(sess.engine) or sess.engine
+                fallback = fail_over_engine(target, cause)
+                sess.engine = fallback
+                sess.failovers += 1
+                job.circuit.Run(fallback)
+            except BaseException as e:  # noqa: BLE001 — chain exhausted
+                job.handle._fail(e)
+                self._account(job, ok=False)
+            else:
+                self._complete(job, None)
+
+    # -- singleton path (non-batchable circuits, calls, admin) ---------
+
+    def _run_single(self, job: Job) -> None:
+        if job.kind == "admin":
+            try:
+                job.handle._complete(job.fn())
+            except BaseException as e:  # noqa: BLE001
+                job.handle._fail(e)
+            return
+        sess = job.session
+
+        def body():
+            if job.kind == "circuit":
+                job.circuit.Run(sess.engine)
+                return None
+            return job.fn(sess.engine)
+
+        try:
+            with _tele.span("serve.execute"):
+                result = body()
+        except FAILOVER_ERRORS as e:
+            # engine-internal guarded sites escalated: fail the session
+            # over and replay the one job on the fallback
+            from ..resilience.failover import fail_over_engine
+
+            try:
+                fallback = fail_over_engine(
+                    planes_engine(sess.engine) or sess.engine, e)
+                sess.engine = fallback
+                sess.failovers += 1
+                result = body()
+            except BaseException as e2:  # noqa: BLE001
+                job.handle._fail(e2)
+                self._account(job, ok=False)
+                return
+            self._complete(job, result)
+        except BaseException as e:  # noqa: BLE001
+            job.handle._fail(e)
+            self._account(job, ok=False)
+        else:
+            self._complete(job, result)
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _complete(self, job: Job, result) -> None:
+        job.handle._complete(result)
+        self._account(job, ok=True)
+
+    def _account(self, job: Job, ok: bool) -> None:
+        if job.session is not None:
+            job.session.end_job(ok)
+        if _tele._ENABLED:
+            _tele.inc("serve.jobs.completed" if ok else "serve.jobs.failed")
+            h = job.handle
+            if h.queue_wait_s is not None:
+                _tele.observe("serve.queue_wait", h.queue_wait_s)
+            if h.latency_s is not None:
+                _tele.observe("serve.latency", h.latency_s)

@@ -1,0 +1,261 @@
+"""Priority job queue with admission control and backpressure.
+
+Admission (all checks at submit(), synchronous, typed — errors.py):
+
+* bounded depth — past QRACK_SERVE_MAX_DEPTH jobs, QueueFull;
+* breaker-aware load shedding — while the resilience breaker is OPEN
+  and still cooling down, jobs whose session would dispatch over the
+  tunnel are refused with LoadShed (+ retry hint).  CPU-backed
+  sessions, including already-failed-over ones, keep flowing;
+* queue-time budget — a job queued past QRACK_SERVE_QUEUE_BUDGET_MS
+  is expired with QueueBudgetExceeded instead of executing stale.
+
+Dispatch order is (-priority, submit sequence): higher priority first,
+FIFO within a priority — so two jobs from one session at equal
+priority always execute in submit order (the batcher additionally
+never co-batches one session twice).
+
+next_batch() is the executor's only entry point: it pops the best
+runnable job and, when the job is batchable, holds the door open up to
+QRACK_SERVE_BATCH_WINDOW_MS for same-shape jobs from OTHER sessions,
+up to QRACK_SERVE_MAX_BATCH.  The window closes early once the batch
+is full, so a saturated queue pays no added latency.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Callable, List, Optional
+
+from .. import telemetry as _tele
+from ..resilience import breaker as _breaker
+from .errors import (LoadShed, QueueBudgetExceeded, QueueFull,
+                     ServiceStopped)
+from .session import Session
+
+
+class JobHandle:
+    """Caller's view of a submitted job: wait, result, and the
+    timestamps serve_bench derives queue/execute latency from."""
+
+    __slots__ = ("sid", "kind", "t_submit", "t_start", "t_done",
+                 "_event", "_result", "_error")
+
+    def __init__(self, sid: str, kind: str):
+        self.sid = sid
+        self.kind = kind
+        self.t_submit = time.perf_counter()
+        self.t_start: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"job on session {self.sid} still pending "
+                               f"after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        return None if self.t_start is None else self.t_start - self.t_submit
+
+    @property
+    def execute_s(self) -> Optional[float]:
+        if self.t_start is None or self.t_done is None:
+            return None
+        return self.t_done - self.t_start
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return None if self.t_done is None else self.t_done - self.t_submit
+
+    # executor-side completion
+    def _start(self) -> None:
+        self.t_start = time.perf_counter()
+
+    def _complete(self, result) -> None:
+        self.t_done = time.perf_counter()
+        self._result = result
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self.t_done = time.perf_counter()
+        self._error = error
+        self._event.set()
+
+
+class Job:
+    __slots__ = ("session", "kind", "circuit", "fn", "shape_key",
+                 "priority", "seq", "handle")
+
+    def __init__(self, session: Optional[Session], kind: str, *,
+                 circuit=None, fn: Optional[Callable] = None,
+                 shape_key=None, priority: int = 0):
+        self.session = session
+        self.kind = kind          # "circuit" | "call" | "admin"
+        self.circuit = circuit
+        self.fn = fn
+        self.shape_key = shape_key  # non-None => vmap-batchable
+        self.priority = priority
+        self.seq = 0              # assigned by the scheduler
+        self.handle = JobHandle(session.sid if session else "-", kind)
+
+    @property
+    def batchable(self) -> bool:
+        return self.kind == "circuit" and self.shape_key is not None
+
+
+class Scheduler:
+    def __init__(self, max_depth: int, queue_budget_s: float,
+                 batch_window_s: float, max_batch: int):
+        self.max_depth = max(1, max_depth)
+        self.queue_budget_s = queue_budget_s
+        self.batch_window_s = max(0.0, batch_window_s)
+        self.max_batch = max(1, max_batch)
+        self._heap: List[tuple] = []   # (-priority, seq, Job)
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._stopped = False
+
+    # -- submit side ---------------------------------------------------
+
+    def submit(self, job: Job) -> JobHandle:
+        with self._cond:
+            if self._stopped:
+                raise ServiceStopped("service is shut down")
+            if _tele._ENABLED:
+                _tele.inc("serve.jobs.submitted")
+            if len(self._heap) >= self.max_depth:
+                if _tele._ENABLED:
+                    _tele.inc("serve.jobs.rejected_full")
+                raise QueueFull(len(self._heap), self.max_depth)
+            if job.session is not None:
+                remaining = _breaker.get_breaker().open_remaining_s()
+                if remaining > 0 and job.session.touches_tunnel():
+                    if _tele._ENABLED:
+                        _tele.inc("serve.jobs.shed")
+                    raise LoadShed(job.session.sid, remaining)
+            self._seq += 1
+            job.seq = self._seq
+            heapq.heappush(self._heap, (-job.priority, job.seq, job))
+            if _tele._ENABLED:
+                _tele.inc("serve.jobs.admitted")
+                _tele.gauge("serve.queue.depth", len(self._heap))
+            self._cond.notify()
+        return job.handle
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._heap)
+
+    def stop(self) -> None:
+        """Refuse new submissions and drain queued jobs with
+        ServiceStopped so no caller blocks forever on a handle."""
+        with self._cond:
+            self._stopped = True
+            drained = [entry[2] for entry in self._heap]
+            self._heap.clear()
+            self._cond.notify_all()
+        for job in drained:
+            job.handle._fail(ServiceStopped("service shut down with job "
+                                            "still queued"))
+            if job.session is not None:
+                job.session.end_job(ok=False)
+
+    # -- executor side -------------------------------------------------
+
+    def _expire_locked(self, now: float) -> None:
+        """Complete over-budget queued jobs exceptionally (bounded
+        queueing latency).  Caller holds the lock."""
+        if self.queue_budget_s <= 0 or not self._heap:
+            return
+        live, expired = [], []
+        for entry in self._heap:
+            job = entry[2]
+            waited = now - job.handle.t_submit
+            (expired if waited > self.queue_budget_s else live).append(entry)
+        if not expired:
+            return
+        self._heap = live
+        heapq.heapify(self._heap)
+        for entry in expired:
+            job = entry[2]
+            waited = now - job.handle.t_submit
+            job.handle._fail(QueueBudgetExceeded(waited, self.queue_budget_s))
+            if job.session is not None:
+                job.session.end_job(ok=False)
+            if _tele._ENABLED:
+                _tele.inc("serve.jobs.expired")
+        if _tele._ENABLED:
+            _tele.gauge("serve.queue.depth", len(self._heap))
+
+    def _take_matching_locked(self, key, exclude_sids: set,
+                              limit: int) -> List[Job]:
+        """Remove up to `limit` queued batchable jobs with shape `key`,
+        at most one per session AND only a session's earliest queued job
+        (a session's jobs must stay ordered: co-batching a later circuit
+        past an earlier queued op would reorder that tenant's stream).
+        Caller holds the lock."""
+        first_seq: dict = {}
+        for entry in self._heap:
+            job = entry[2]
+            if job.session is not None:
+                sid = job.session.sid
+                if sid not in first_seq or job.seq < first_seq[sid]:
+                    first_seq[sid] = job.seq
+        taken: List[Job] = []
+        keep: List[tuple] = []
+        for entry in sorted(self._heap):  # priority order
+            job = entry[2]
+            if (len(taken) < limit and job.batchable
+                    and job.shape_key == key
+                    and job.session.sid not in exclude_sids
+                    and job.seq == first_seq.get(job.session.sid)):
+                taken.append(job)
+                exclude_sids.add(job.session.sid)
+            else:
+                keep.append(entry)
+        if taken:
+            self._heap = keep
+            heapq.heapify(self._heap)
+        return taken
+
+    def next_batch(self, timeout: float = 0.25) -> Optional[List[Job]]:
+        """Block up to `timeout` for work; returns one batch (singleton
+        for non-batchable jobs) or None on idle timeout / stop."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                self._expire_locked(time.perf_counter())
+                if self._heap:
+                    break
+                remaining = deadline - time.monotonic()
+                if self._stopped or remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            _, _, job = heapq.heappop(self._heap)
+            batch = [job]
+            if job.batchable and self.max_batch > 1:
+                sids = {job.session.sid}
+                window_end = time.monotonic() + self.batch_window_s
+                while len(batch) < self.max_batch:
+                    batch.extend(self._take_matching_locked(
+                        job.shape_key, sids, self.max_batch - len(batch)))
+                    if len(batch) >= self.max_batch:
+                        break
+                    remaining = window_end - time.monotonic()
+                    if remaining <= 0 or self._stopped:
+                        break
+                    self._cond.wait(remaining)
+            if _tele._ENABLED:
+                _tele.gauge("serve.queue.depth", len(self._heap))
+        return batch

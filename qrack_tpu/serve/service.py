@@ -1,0 +1,182 @@
+"""QrackService: the thin in-process front API over the serving stack.
+
+    with QrackService(engine_layers="tpu") as svc:
+        sid = svc.create_session(width=16, seed=7)
+        svc.apply(sid, circuit)              # submit + wait
+        bits = svc.measure_all(sid)
+        svc.destroy_session(sid)
+
+Everything that touches a device — session construction included —
+runs on the executor's dispatch-owner thread; the caller only ever
+blocks on a JobHandle.  Env knobs (constructor args override):
+
+* ``QRACK_SERVE_MAX_DEPTH``        queue depth bound (default 64)
+* ``QRACK_SERVE_BATCH_WINDOW_MS``  batch collection window (default 2)
+* ``QRACK_SERVE_MAX_BATCH``        max jobs per vmapped batch (default 8)
+* ``QRACK_SERVE_QUEUE_BUDGET_MS``  max queued age before a job expires
+                                   (default 2000; 0 disables)
+* ``QRACK_SERVE_IDLE_EVICT_S``     idle-session eviction (default 0=off)
+* ``QRACK_SERVE_SYNC``             "devget" (default, honest completion)
+                                   or "none"
+
+See docs/SERVING.md for the architecture and the load-shedding
+semantics; serving is NOT imported by ``import qrack_tpu`` so the
+library path costs nothing when this subsystem is unused.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence, Union
+
+from ..resilience import breaker as _breaker
+from .batcher import stats as _batch_stats
+from .errors import SessionNotFound
+from .executor import Executor
+from .scheduler import Job, JobHandle, Scheduler
+from .session import SessionManager, planes_engine
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class QrackService:
+    def __init__(self, engine_layers: Union[str, Sequence[str]] = "tpu",
+                 *, max_depth: Optional[int] = None,
+                 batch_window_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 queue_budget_ms: Optional[float] = None,
+                 idle_evict_s: Optional[float] = None,
+                 tick_s: float = 0.25,
+                 **engine_kwargs):
+        if max_depth is None:
+            max_depth = int(_env_float("QRACK_SERVE_MAX_DEPTH", 64))
+        if batch_window_ms is None:
+            batch_window_ms = _env_float("QRACK_SERVE_BATCH_WINDOW_MS", 2.0)
+        if max_batch is None:
+            max_batch = int(_env_float("QRACK_SERVE_MAX_BATCH", 8))
+        if queue_budget_ms is None:
+            queue_budget_ms = _env_float("QRACK_SERVE_QUEUE_BUDGET_MS", 2000.0)
+        if idle_evict_s is None:
+            idle_evict_s = _env_float("QRACK_SERVE_IDLE_EVICT_S", 0.0)
+        self.default_layers = engine_layers
+        self.default_engine_kwargs = engine_kwargs
+        self.sessions = SessionManager(idle_evict_s=idle_evict_s)
+        self.scheduler = Scheduler(max_depth=max_depth,
+                                   queue_budget_s=queue_budget_ms / 1e3,
+                                   batch_window_s=batch_window_ms / 1e3,
+                                   max_batch=max_batch)
+        sync = os.environ.get("QRACK_SERVE_SYNC", "devget") != "none"
+        self.executor = Executor(self.scheduler, self.sessions,
+                                 tick_s=tick_s, sync=sync)
+        self.executor.start()
+        self._closed = False
+
+    # -- session lifecycle ---------------------------------------------
+
+    def create_session(self, width: int, layers=None,
+                       seed: Optional[int] = None, timeout: float = 60.0,
+                       **engine_kwargs) -> str:
+        """Build a tenant session (engine constructed on the dispatch
+        owner — construction is device traffic) and return its id."""
+        layers = self.default_layers if layers is None else layers
+        kwargs = {**self.default_engine_kwargs, **engine_kwargs}
+        job = Job(None, "admin",
+                  fn=lambda: self.sessions.create(width, layers=layers,
+                                                  seed=seed, **kwargs))
+        self.scheduler.submit(job)
+        return job.handle.result(timeout).sid
+
+    def destroy_session(self, sid: str, timeout: float = 60.0) -> None:
+        self.sessions.get(sid)  # typed SessionNotFound before queueing
+        job = Job(None, "admin", fn=lambda: self.sessions.destroy(sid))
+        self.scheduler.submit(job)
+        job.handle.result(timeout)
+
+    # -- job submission ------------------------------------------------
+
+    def submit(self, sid: str, circuit, priority: int = 0) -> JobHandle:
+        """Queue `circuit` against session `sid`; returns immediately
+        with a JobHandle.  Raises typed admission errors (QueueFull /
+        LoadShed / ServiceStopped) synchronously."""
+        sess = self.sessions.get(sid)
+        shape_key = None
+        if planes_engine(sess.engine) is not None and circuit.gates:
+            shape_key = circuit.shape_key(sess.width)
+        job = Job(sess, "circuit", circuit=circuit, shape_key=shape_key,
+                  priority=priority)
+        sess.begin_job()
+        try:
+            return self.scheduler.submit(job)
+        except BaseException:
+            sess.end_job(ok=False)
+            raise
+
+    def call(self, sid: str, fn: Callable, priority: int = 0) -> JobHandle:
+        """Queue an arbitrary engine call `fn(engine)` — the escape
+        hatch every synchronous read routes through, so reads share the
+        dispatch owner with circuit traffic."""
+        sess = self.sessions.get(sid)
+        job = Job(sess, "call", fn=fn, priority=priority)
+        sess.begin_job()
+        try:
+            return self.scheduler.submit(job)
+        except BaseException:
+            sess.end_job(ok=False)
+            raise
+
+    def apply(self, sid: str, circuit, priority: int = 0,
+              timeout: Optional[float] = 120.0):
+        return self.submit(sid, circuit, priority=priority).result(timeout)
+
+    # -- synchronous reads (all via the dispatch owner) ----------------
+
+    def get_state(self, sid: str, timeout: Optional[float] = 120.0):
+        return self.call(sid, lambda eng: eng.GetQuantumState()
+                         ).result(timeout)
+
+    def measure_all(self, sid: str, timeout: Optional[float] = 120.0) -> int:
+        return self.call(sid, lambda eng: eng.MAll()).result(timeout)
+
+    def sample(self, sid: str, shots: int, qubits=None,
+               timeout: Optional[float] = 120.0):
+        def do(eng):
+            qs = range(eng.qubit_count) if qubits is None else qubits
+            return eng.MultiShotMeasureMask([1 << q for q in qs], shots)
+
+        return self.call(sid, do).result(timeout)
+
+    def prob(self, sid: str, qubit: int,
+             timeout: Optional[float] = 120.0) -> float:
+        return self.call(sid, lambda eng: eng.Prob(qubit)).result(timeout)
+
+    # -- introspection / lifecycle -------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "sessions": self.sessions.stats(),
+            "queue_depth": self.scheduler.depth(),
+            "breaker": _breaker.get_breaker().snapshot(),
+            "batch_programs": _batch_stats(),
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.scheduler.stop()
+        self.executor.stop()
+
+    def __enter__(self) -> "QrackService":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+__all__ = ["QrackService", "SessionNotFound"]

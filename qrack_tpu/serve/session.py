@@ -1,0 +1,210 @@
+"""Multi-tenant simulator sessions.
+
+A Session owns one engine stack (built through the factory, so
+resilience wrapping and telemetry counting apply unchanged) plus the
+bookkeeping the scheduler and evictor need: a private seeded rng
+stream (utils/rng.py — tenant measurement streams must never couple),
+idle timestamps, an in-flight counter, and per-session stats that back
+the `serve.*` telemetry attribution.
+
+Engine CONSTRUCTION is device traffic (SetPermutation dispatches), so
+SessionManager.create is only ever called on the executor thread —
+the service routes it there as an admin job (executor.py is the single
+dispatch owner).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import telemetry as _tele
+from ..factory import create_quantum_interface, touches_accelerator
+from ..utils.rng import QrackRandom
+from .errors import SessionNotFound
+
+
+def planes_engine(engine):
+    """Unwrap `engine` to its plane-backed dense core (QEngineTPU) if it
+    has one — through the ResilientEngine proxy and QHybrid's width
+    switch — else None.  Only such engines can join a vmapped batch:
+    their whole ket is one (2, 2^n) device array the batcher can stack.
+    Paged/compressed/CPU engines run as singleton jobs."""
+    from ..engines.tpu import QEngineTPU
+
+    seen = 0
+    while seen < 4:  # proxy -> hybrid -> engine chains are short
+        seen += 1
+        from ..resilience.failover import ResilientEngine
+
+        if isinstance(engine, ResilientEngine):
+            engine = engine.engine
+            continue
+        from ..engines.hybrid import QHybrid
+
+        if isinstance(engine, QHybrid):
+            engine = engine._engine
+            continue
+        break
+    return engine if isinstance(engine, QEngineTPU) else None
+
+
+def engine_touches_tunnel(engine) -> bool:
+    """True when `engine`'s current core dispatches over the TPU tunnel.
+    Re-evaluated per submit: a session that failed over to QEngineCPU
+    stops being sheddable the moment the failover lands."""
+    from ..engines.cpu import QEngineCPU
+
+    inner = engine
+    seen = 0
+    while seen < 4:
+        seen += 1
+        from ..resilience.failover import ResilientEngine
+
+        if isinstance(inner, ResilientEngine):
+            inner = inner.engine
+            continue
+        from ..engines.hybrid import QHybrid
+
+        if isinstance(inner, QHybrid):
+            inner = inner._engine
+            continue
+        break
+    if isinstance(inner, QEngineCPU):
+        return False
+    kind = type(inner).__name__
+    return kind in ("QEngineTPU", "QPager", "QEngineTurboQuant",
+                    "QPagerTurboQuant")
+
+
+class Session:
+    """One tenant's simulator plus scheduling bookkeeping."""
+
+    def __init__(self, sid: str, width: int, layers, engine,
+                 seed: Optional[int]):
+        self.sid = sid
+        self.width = width
+        self.layers = layers
+        self.engine = engine
+        self.seed = seed
+        now = time.perf_counter()
+        self.created_s = now
+        self.last_used_s = now
+        self.inflight = 0          # queued + executing jobs (evict guard)
+        self.jobs_completed = 0
+        self.jobs_failed = 0
+        self.failovers = 0
+        self._lock = threading.Lock()
+
+    def touch(self) -> None:
+        self.last_used_s = time.perf_counter()
+
+    def begin_job(self) -> None:
+        with self._lock:
+            self.inflight += 1
+            self.last_used_s = time.perf_counter()
+
+    def end_job(self, ok: bool) -> None:
+        with self._lock:
+            self.inflight -= 1
+            self.last_used_s = time.perf_counter()
+            if ok:
+                self.jobs_completed += 1
+            else:
+                self.jobs_failed += 1
+
+    def touches_tunnel(self) -> bool:
+        return engine_touches_tunnel(self.engine)
+
+    def stats(self) -> dict:
+        return {
+            "sid": self.sid,
+            "width": self.width,
+            "layers": self.layers,
+            "engine": type(planes_engine(self.engine)
+                           or getattr(self.engine, "engine", self.engine)
+                           ).__name__,
+            "idle_s": time.perf_counter() - self.last_used_s,
+            "inflight": self.inflight,
+            "jobs_completed": self.jobs_completed,
+            "jobs_failed": self.jobs_failed,
+            "failovers": self.failovers,
+        }
+
+
+class SessionManager:
+    """Thread-safe registry: create / get / destroy / idle-evict."""
+
+    def __init__(self, idle_evict_s: float = 0.0):
+        self.idle_evict_s = idle_evict_s
+        self._sessions: Dict[str, Session] = {}
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    def create(self, width: int, layers="tpu", seed: Optional[int] = None,
+               **engine_kwargs) -> Session:
+        """Build a session's engine (EXECUTOR THREAD ONLY — see module
+        doc) and register it.  Each session gets its own QrackRandom so
+        tenant measurement streams are independent and, when seeded,
+        exactly reproducible."""
+        rng = QrackRandom(seed)
+        engine = create_quantum_interface(layers, width, rng=rng,
+                                          **engine_kwargs)
+        with self._lock:
+            self._counter += 1
+            sid = f"s{self._counter:06d}"
+            sess = Session(sid, width, layers, engine, seed)
+            self._sessions[sid] = sess
+        if _tele._ENABLED:
+            _tele.inc("serve.session.created")
+            _tele.event("serve.session.create", sid=sid, width=width,
+                        accel=touches_accelerator(layers))
+            _tele.gauge("serve.sessions.active", len(self._sessions))
+        return sess
+
+    def get(self, sid: str) -> Session:
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is None:
+            raise SessionNotFound(sid)
+        return sess
+
+    def destroy(self, sid: str) -> None:
+        with self._lock:
+            sess = self._sessions.pop(sid, None)
+        if sess is None:
+            raise SessionNotFound(sid)
+        if _tele._ENABLED:
+            _tele.inc("serve.session.destroyed")
+            _tele.gauge("serve.sessions.active", len(self._sessions))
+
+    def evict_idle(self) -> List[str]:
+        """Drop sessions idle past the budget with nothing in flight.
+        Called from the executor's idle ticks so the engine teardown
+        happens on the dispatch-owner thread."""
+        if self.idle_evict_s <= 0:
+            return []
+        now = time.perf_counter()
+        with self._lock:
+            dead = [sid for sid, s in self._sessions.items()
+                    if s.inflight == 0
+                    and now - s.last_used_s > self.idle_evict_s]
+            for sid in dead:
+                del self._sessions[sid]
+        if dead and _tele._ENABLED:
+            _tele.inc("serve.session.evicted", len(dead))
+            _tele.gauge("serve.sessions.active", len(self._sessions))
+        return dead
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return list(self._sessions)
+
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+    def stats(self) -> List[dict]:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        return [s.stats() for s in sessions]

@@ -42,9 +42,10 @@ from collections import OrderedDict
 from typing import Dict, List, Optional
 
 __all__ = [
-    "enabled", "enable", "disable", "inc", "event", "span", "snapshot",
-    "reset", "write_jsonl", "chrome_trace", "write_chrome_trace",
-    "xplane_bracket", "instrument_jit", "ProgramCache",
+    "enabled", "enable", "disable", "inc", "event", "span", "observe",
+    "gauge", "snapshot", "reset", "write_jsonl", "chrome_trace",
+    "write_chrome_trace", "xplane_bracket", "instrument_jit",
+    "ProgramCache",
 ]
 
 # single hot-path gate: instrumentation sites read this module attribute
@@ -56,6 +57,7 @@ _LOCK = threading.Lock()
 _EPOCH = time.perf_counter()  # trace timestamps are relative to import
 
 _COUNTERS: Dict[str, float] = {}
+_GAUGES: Dict[str, float] = {}        # name -> last observed value
 _SPANS: Dict[str, List[float]] = {}   # name -> [count, total_s, min_s, max_s]
 _TRACE: List[dict] = []               # chrome-trace "X" complete events
 _EVENTS: List[dict] = []              # discrete annotated events
@@ -88,6 +90,7 @@ def reset() -> None:
     """Drop all recorded data (counters, spans, traces, events)."""
     with _LOCK:
         _COUNTERS.clear()
+        _GAUGES.clear()
         _SPANS.clear()
         _TRACE.clear()
         _EVENTS.clear()
@@ -103,6 +106,32 @@ def inc(name: str, n: float = 1) -> None:
         return
     with _LOCK:
         _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def gauge(name: str, value: float) -> None:
+    """Record the latest value of a named gauge (last-write-wins; the
+    serving layer uses these for queue depth / p50-p99 latencies)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _GAUGES[name] = float(value)
+
+
+def observe(name: str, seconds: float) -> None:
+    """Feed one measured duration into the named span aggregate without
+    a context manager — for durations measured externally (queue waits,
+    per-job latencies) where enter/exit bracketing does not fit."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        agg = _SPANS.get(name)
+        if agg is None:
+            _SPANS[name] = [1, seconds, seconds, seconds]
+        else:
+            agg[0] += 1
+            agg[1] += seconds
+            agg[2] = min(agg[2], seconds)
+            agg[3] = max(agg[3], seconds)
 
 
 def event(name: str, **fields) -> None:
@@ -349,6 +378,7 @@ def snapshot(include_events: bool = True) -> dict:
             "enabled": _ENABLED,
             "pid": os.getpid(),
             "counters": dict(_COUNTERS),
+            "gauges": dict(_GAUGES),
             "spans": {
                 name: {"count": int(agg[0]), "total_s": agg[1],
                        "min_s": agg[2], "max_s": agg[3]}

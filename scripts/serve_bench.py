@@ -1,0 +1,219 @@
+"""Serving throughput/latency bench: scheduler+batcher vs the library
+path, devget-honest end to end.
+
+The LIBRARY baseline models N independent callers the way they really
+hit the library: each request builds its OWN QCircuit object and its
+own engine, runs RunFused, and completes with a device->host read.
+The fused-program jit cache is per-circuit-OBJECT, so every caller
+pays its own trace+compile — that is the "N users running the same
+circuit pay N full dispatch round-trips" cost the serving subsystem
+exists to collapse.
+
+The SERVE path keeps N long-lived sessions; each round every session
+submits a FRESH circuit object (tenants build their own circuits too)
+and the digest-keyed batch ProgramCache recognizes them as the same
+program, vmaps the N kets into one stacked dispatch, and completes all
+N handles after one one-element device_get of the batched output.
+
+Also reported, for honesty: the WARM single-object sequential baseline
+(one pre-traced circuit run N times).  On the CPU backend batching
+does NOT beat that number — same FLOPs, bigger cache footprint — the
+serving win is compile + dispatch-round-trip amortization across
+tenants, not per-gate arithmetic.  docs/SERVING.md records both.
+
+Usage:
+    python scripts/serve_bench.py [--width 16] [--jobs 8] [--rounds 4]
+                                  [--layers tpu] [--window-ms 50] [--json]
+
+Exit 0 when the acceptance bar holds (cold AND steady-state serve
+rounds < 0.6x the sequential library wall), 1 otherwise.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
+
+pin_host_cpu(8)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import telemetry as tele  # noqa: E402
+from qrack_tpu.factory import create_quantum_interface  # noqa: E402
+from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
+from qrack_tpu.serve import QrackService  # noqa: E402
+from qrack_tpu.serve.session import planes_engine  # noqa: E402
+
+
+def _devget_read(engine) -> None:
+    """Honest completion: a real one-element device->host read (relay
+    acks dispatch on block_until_ready; only device_get is proof)."""
+    import jax
+
+    core = planes_engine(engine)
+    if core is not None:
+        np.asarray(jax.device_get(core.device_planes[:1, :1]))
+    else:
+        engine.Prob(0)
+
+
+def _pctl(vals, q):
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals, dtype=np.float64), q))
+
+
+def measure_library_cold(width, jobs, layers, **engine_kwargs):
+    """N sequential fresh-caller requests: own circuit object (own jit
+    cache), own engine, RunFused, devget."""
+    t0 = time.perf_counter()
+    for _ in range(jobs):
+        circ = qft_qcircuit(width)
+        eng = create_quantum_interface(layers, width, **engine_kwargs)
+        circ.RunFused(eng)
+        _devget_read(eng)
+    return time.perf_counter() - t0
+
+
+def measure_library_warm(width, jobs, layers, **engine_kwargs):
+    """N sequential requests sharing ONE pre-traced circuit object —
+    the best case the plain library offers a single caller."""
+    circ = qft_qcircuit(width)
+    engines = [create_quantum_interface(layers, width, **engine_kwargs)
+               for _ in range(jobs)]
+    circ.RunFused(engines[0])  # trace+compile outside the timed region
+    _devget_read(engines[0])
+    t0 = time.perf_counter()
+    for eng in engines:
+        circ.RunFused(eng)
+        _devget_read(eng)
+    return time.perf_counter() - t0
+
+
+def measure_serve(width, jobs, rounds, layers, window_ms, **engine_kwargs):
+    """`rounds` rounds of `jobs` concurrent fresh-circuit submissions
+    through the scheduler.  Round 0 is cold (pays the one shared batch
+    compile); later rounds are steady state."""
+    svc = QrackService(engine_layers=layers, max_depth=4 * jobs + 8,
+                       batch_window_ms=window_ms, max_batch=jobs,
+                       queue_budget_ms=120_000.0, **engine_kwargs)
+    walls, handles_steady = [], []
+    try:
+        sids = [svc.create_session(width, seed=i) for i in range(jobs)]
+        for r in range(rounds):
+            circs = [qft_qcircuit(width) for _ in sids]
+            t0 = time.perf_counter()
+            handles = [svc.submit(sid, c) for sid, c in zip(sids, circs)]
+            for h in handles:
+                h.result(timeout=600)
+            walls.append(time.perf_counter() - t0)
+            if r > 0:
+                handles_steady.extend(handles)
+    finally:
+        svc.close()
+    return walls, handles_steady
+
+
+def run(args) -> dict:
+    tele.enable()
+    tele.reset()
+    kw = {}
+    lib_cold = measure_library_cold(args.width, args.jobs, args.layers, **kw)
+    lib_warm = measure_library_warm(args.width, args.jobs, args.layers, **kw)
+    walls, handles = measure_serve(args.width, args.jobs, args.rounds,
+                                   args.layers, args.window_ms, **kw)
+    serve_cold = walls[0]
+    steady = walls[1:] or walls
+    serve_steady = float(np.median(steady))
+
+    q_waits = [h.queue_wait_s for h in handles if h.queue_wait_s is not None]
+    execs = [h.execute_s for h in handles if h.execute_s is not None]
+    lats = [h.latency_s for h in handles if h.latency_s is not None]
+    snap = tele.snapshot()
+    dispatches = snap["counters"].get("serve.batch.dispatches", 0)
+    batched = snap["counters"].get("serve.batch.jobs", 0)
+
+    res = {
+        "width": args.width, "jobs": args.jobs, "rounds": args.rounds,
+        "layers": args.layers, "batch_window_ms": args.window_ms,
+        "lib_cold_wall_s": round(lib_cold, 6),
+        "lib_warm_wall_s": round(lib_warm, 6),
+        "serve_cold_wall_s": round(serve_cold, 6),
+        "serve_steady_wall_s": round(serve_steady, 6),
+        "ratio_cold_vs_lib": round(serve_cold / lib_cold, 4),
+        "ratio_steady_vs_lib": round(serve_steady / lib_cold, 4),
+        "ratio_steady_vs_warm_lib": round(serve_steady / lib_warm, 4),
+        "jobs_per_s_steady": round(args.jobs / serve_steady, 2),
+        "queue_wait_p50_s": _pctl(q_waits, 50),
+        "queue_wait_p99_s": _pctl(q_waits, 99),
+        "execute_p50_s": _pctl(execs, 50),
+        "execute_p99_s": _pctl(execs, 99),
+        "latency_p50_s": _pctl(lats, 50),
+        "latency_p99_s": _pctl(lats, 99),
+        "batch_occupancy": round(batched / dispatches, 3) if dispatches else 0,
+        "compile_misses": snap["counters"].get("compile.serve_batch.miss", 0),
+        "compile_hits": snap["counters"].get("compile.serve_batch.hit", 0),
+    }
+    # into serve.* telemetry so the atexit JSONL (QRACK_TPU_TELEMETRY_OUT)
+    # and scripts/telemetry_report.py carry the bench verdict
+    tele.gauge("serve.bench.jobs_per_s", res["jobs_per_s_steady"])
+    tele.gauge("serve.bench.ratio_steady_vs_lib", res["ratio_steady_vs_lib"])
+    for key in ("queue_wait_p50_s", "queue_wait_p99_s", "latency_p50_s",
+                "latency_p99_s", "execute_p50_s", "execute_p99_s"):
+        if res[key] is not None:
+            tele.gauge(f"serve.bench.{key}", res[key])
+    res["pass_0p6x"] = bool(res["ratio_cold_vs_lib"] < 0.6
+                            and res["ratio_steady_vs_lib"] < 0.6)
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--width", type=int, default=16)
+    ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="serve rounds; round 0 is the cold round")
+    ap.add_argument("--layers", default="tpu",
+                    help="engine stack (default tpu = plane-holding dense "
+                         "engine on whatever backend jax selects)")
+    ap.add_argument("--window-ms", type=float, default=50.0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    res = run(args)
+    if args.json:
+        print(json.dumps(res, indent=1, sort_keys=True))
+    else:
+        print(f"w={res['width']} jobs={res['jobs']} layers={res['layers']} "
+              f"(devget-honest)")
+        print(f"  library, fresh caller x{res['jobs']} (each pays its own "
+              f"compile): {res['lib_cold_wall_s'] * 1e3:9.1f} ms")
+        print(f"  library, warm shared program x{res['jobs']}:"
+              f"              {res['lib_warm_wall_s'] * 1e3:9.1f} ms")
+        print(f"  serve cold round   (incl. one shared batch compile): "
+              f"{res['serve_cold_wall_s'] * 1e3:9.1f} ms")
+        print(f"  serve steady round (median of {res['rounds'] - 1}):"
+              f"           {res['serve_steady_wall_s'] * 1e3:9.1f} ms")
+        print(f"  ratio vs library: cold {res['ratio_cold_vs_lib']:.3f}x, "
+              f"steady {res['ratio_steady_vs_lib']:.3f}x "
+              f"(vs warm-lib {res['ratio_steady_vs_warm_lib']:.3f}x)")
+        print(f"  throughput {res['jobs_per_s_steady']} jobs/s | "
+              f"queue p50/p99 {res['queue_wait_p50_s'] * 1e3:.1f}/"
+              f"{res['queue_wait_p99_s'] * 1e3:.1f} ms | "
+              f"latency p50/p99 {res['latency_p50_s'] * 1e3:.1f}/"
+              f"{res['latency_p99_s'] * 1e3:.1f} ms")
+        print(f"  batch occupancy {res['batch_occupancy']} "
+              f"(compile miss={res['compile_misses']:.0f} "
+              f"hit={res['compile_hits']:.0f})")
+        print(f"  acceptance (<0.6x library): "
+              f"{'PASS' if res['pass_0p6x'] else 'FAIL'}")
+    return 0 if res["pass_0p6x"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Randomized serving soak: many concurrent tenant sessions through
+the full scheduler/batcher path, vs per-session CPU oracles — with
+randomized fault injection on half the trials.
+
+Each trial builds a QrackService, creates 2-4 sessions on rotating
+engine stacks (tpu / pager / hybrid), and drives every session with an
+independent stream from the tests/test_fuzz_api.py op vocabulary
+(SetBit excluded — cross-stack rng streams legitimately diverge on
+measuring ops, CLAUDE.md) plus occasional full QFT circuit submits
+(the batchable path).  Streams are interleaved ACROSS sessions in a
+random order, so the scheduler sees contended multi-tenant traffic and
+same-shape circuits from different tenants co-batch.
+
+Half the trials inject one randomized fault spec (serve/dispatch
+family sites x kind x after_n) after the sessions exist.  Whatever the
+stack does — retry, trip the breaker (submits that get LoadShed/
+QueueFull are retried after the hint), fail over mid-batch — every
+session's final state must stay oracle-equivalent: faults and
+scheduling may cost time, never correctness, and tenant isolation
+means one session's fault never corrupts another's ket.
+
+Usage:
+    python scripts/serve_soak.py [trials] [seed]
+
+Defaults: 60 trials, seed 0.  Exit 0 = all trials oracle-equivalent.
+One JSON line per trial; a failing trial's line holds the spec, so
+`python scripts/serve_soak.py 1 <seed>` reproduces it.  The slow-marked
+tests/test_serve.py::test_serve_soak_smoke runs a 9-trial slice in CI.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
+
+pin_host_cpu(8)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import QEngineCPU  # noqa: E402
+from qrack_tpu import resilience as res  # noqa: E402
+from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
+from qrack_tpu.resilience.breaker import CircuitBreaker  # noqa: E402
+from qrack_tpu.serve import QrackService  # noqa: E402
+from qrack_tpu.serve.errors import LoadShed, QueueFull  # noqa: E402
+from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
+
+STACKS = [
+    ("tpu", {}),
+    ("pager", {"n_pages": 4}),
+    ("hybrid", {"tpu_threshold_qubits": 3}),
+]
+SITES = ["*", "serve.dispatch", "serve.device_get", "dispatch",
+         "device_get", "tpu.compile", "pager.exchange"]
+# hang exercised by the watchdog tests, not the soak (see fault_soak.py)
+KINDS = ["timeout", "raise", "nan-poison", "device-loss"]
+
+
+def _submit_retry(fn, tries: int = 200):
+    """Admission rejections are the CONTRACT under an open breaker —
+    honor the retry hint instead of treating them as failures."""
+    for _ in range(tries):
+        try:
+            return fn()
+        except (LoadShed, QueueFull) as e:
+            time.sleep(min(getattr(e, "retry_in_s", 0.0) or 0.02, 0.1))
+    raise RuntimeError(f"admission retries exhausted after {tries} tries")
+
+
+def run_trial(trial: int, seed: int) -> dict:
+    rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
+    n_sessions = 2 + trial % 3
+    with_fault = bool(trial % 2)
+    site = SITES[int(rng.integers(0, len(SITES)))]
+    kind = KINDS[int(rng.integers(0, len(KINDS)))]
+    after_n = int(rng.integers(0, 10))
+    persistent = bool(rng.integers(0, 2))
+    info = {"trial": trial, "sessions": n_sessions, "fault": with_fault}
+    if with_fault:
+        info.update(site=site, kind=kind, after_n=after_n,
+                    persistent=persistent)
+
+    res.faults.clear()
+    # short cooldown so a tripped breaker half-opens within the soak's
+    # retry budget instead of shedding for the default 30s
+    res.reset_breaker(CircuitBreaker(threshold=2, cooldown_s=0.05))
+    res.configure(max_retries=2, backoff_s=0.0, timeout_s=0.0)
+    res.enable()
+    svc = None
+    try:
+        svc = QrackService(batch_window_ms=5.0, max_batch=n_sessions,
+                           max_depth=64, queue_budget_ms=60_000.0,
+                           tick_s=0.05)
+        oracles, sids, streams = [], [], []
+        for k in range(n_sessions):
+            stack, kw = STACKS[k % len(STACKS)]
+            sess_seed = (trial << 4) + k
+            sids.append(svc.create_session(N, layers=stack, seed=sess_seed,
+                                           rand_global_phase=False, **kw))
+            oracles.append(QEngineCPU(N, rng=QrackRandom(sess_seed),
+                                      rand_global_phase=False))
+            # one independent op stream per tenant; ~1 in 4 items is a
+            # full QFT circuit submit (the batchable path)
+            stream = []
+            for _ in range(10):
+                if rng.random() < 0.25:
+                    stream.append(("circ", qft_qcircuit(N)))
+                else:
+                    name, args = _ops(rng)
+                    if name == "SetBit":
+                        continue
+                    stream.append(("op", name, args))
+            streams.append(stream)
+        # oracle side: per-session streams are independent, apply in order
+        for oracle, stream in zip(oracles, streams):
+            for item in stream:
+                if item[0] == "circ":
+                    item[1].Run(oracle)
+                else:
+                    getattr(oracle, item[1])(*item[2])
+        if with_fault:
+            res.faults.inject(site, kind, after_n=after_n,
+                              times=None if persistent else 1)
+        # serve side: interleave across sessions in random order
+        cursors = [0] * n_sessions
+        handles = []
+        live = [k for k in range(n_sessions) if streams[k]]
+        while live:
+            k = live[int(rng.integers(0, len(live)))]
+            item = streams[k][cursors[k]]
+            sid = sids[k]
+            if item[0] == "circ":
+                handles.append(_submit_retry(
+                    lambda s=sid, c=item[1]: svc.submit(s, c)))
+            else:
+                _, name, args = item
+
+                def do(eng, name=name, args=args):
+                    return getattr(eng, name)(*args)
+
+                handles.append(_submit_retry(
+                    lambda s=sid, f=do: svc.call(s, f)))
+            cursors[k] += 1
+            if cursors[k] >= len(streams[k]):
+                live.remove(k)
+        for h in handles:
+            h.result(timeout=120)
+        fidelities = []
+        for sid, oracle in zip(sids, oracles):
+            b = np.asarray(_submit_retry(
+                lambda s=sid: svc.call(s, lambda eng: eng.GetQuantumState())
+            ).result(timeout=120))
+            with res.faults.suspended():
+                a = np.asarray(oracle.GetQuantumState())
+            f = abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
+                                           * np.vdot(b, b).real)
+            fidelities.append(float(f))
+        info["n_jobs"] = len(handles)
+        info["fired"] = sum(sp.fired for sp in res.faults.specs())
+        info["breaker"] = res.get_breaker().snapshot()["state"]
+        info["failovers"] = sum(s["failovers"] for s in svc.sessions.stats())
+        info["fidelity_min"] = min(fidelities)
+        info["ok"] = bool(min(fidelities) > 1 - 1e-6)
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if svc is not None:
+            svc.close()
+        res.faults.clear()
+        res.reset_breaker()
+        res.disable()
+    return info
+
+
+def main(argv) -> int:
+    trials = int(argv[1]) if len(argv) > 1 else 60
+    seed = int(argv[2]) if len(argv) > 2 else 0
+    failures = 0
+    for t in range(trials):
+        info = run_trial(t, seed)
+        print(json.dumps(info), flush=True)
+        if not info["ok"]:
+            failures += 1
+    print(f"SOAK {'FAILED' if failures else 'OK'}: "
+          f"{trials - failures}/{trials} trials oracle-equivalent",
+          flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -8,8 +8,13 @@ sum; spans merge).  Sections:
   * top gate counters (gate.<engine>.<kind>.w<width>), grouped and raw
   * compile-cache traffic: hit/miss/eviction per cache, miss ratio
   * exchange traffic: pager/ICI event counts and bytes
+  * serving: jobs admitted/shed/expired/completed, batch occupancy
+    (batched jobs per dispatch), queue-depth / latency gauges
   * layer events (qunit/stabilizer/qbdt/hybrid/factory escalations)
   * spans: count, total, mean
+
+A missing or empty input is a one-line message + exit 2, never a
+traceback (campaigns glob for files that may not exist yet).
 
 Usage: python scripts/telemetry_report.py tele.jsonl [--all] [--top N]
        python scripts/telemetry_report.py tele.jsonl --json
@@ -23,19 +28,28 @@ from collections import defaultdict
 
 def load(path: str, aggregate: bool) -> dict:
     snaps = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                snaps.append(json.loads(line))
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    snaps.append(json.loads(line))
+    except OSError as e:
+        print(f"telemetry_report: cannot read {path}: {e.strerror}",
+              file=sys.stderr)
+        raise SystemExit(2)
     if not snaps:
-        raise SystemExit(f"no snapshot lines in {path}")
+        print(f"telemetry_report: no snapshot lines in {path}",
+              file=sys.stderr)
+        raise SystemExit(2)
     if not aggregate:
         return snaps[-1]
-    merged = {"counters": defaultdict(float), "spans": {}, "lines": len(snaps)}
+    merged = {"counters": defaultdict(float), "gauges": {}, "spans": {},
+              "lines": len(snaps)}
     for s in snaps:
         for k, v in s.get("counters", {}).items():
             merged["counters"][k] += v
+        merged["gauges"].update(s.get("gauges", {}))  # last-write-wins
         for name, agg in s.get("spans", {}).items():
             cur = merged["spans"].get(name)
             if cur is None:
@@ -65,6 +79,8 @@ def report(snap: dict, top: int) -> dict:
         "gates_total": sum(gates.values()),
         "compile": {},
         "exchange": {},
+        "serve": {},
+        "gauges": snap.get("gauges", {}),
         "layer_events": {},
         "spans": snap.get("spans", {}),
     }
@@ -76,14 +92,20 @@ def report(snap: dict, top: int) -> dict:
             out["compile"].setdefault(cache, {})[kind] = v
         elif k.startswith("exchange."):
             out["exchange"][k] = v
+        elif k.startswith("serve."):
+            out["serve"][k] = v
         elif k.split(".")[0] in ("qunit", "qunitmulti", "stabilizer",
                                  "qbdt", "hybrid", "factory", "engine",
-                                 "cluster"):
+                                 "cluster", "resilience"):
             out["layer_events"][k] = v
     for cache, kinds in out["compile"].items():
         total = kinds.get("hit", 0) + kinds.get("miss", 0)
         if total:
             kinds["miss_ratio"] = round(kinds.get("miss", 0) / total, 4)
+    dispatches = out["serve"].get("serve.batch.dispatches", 0)
+    if dispatches:
+        out["serve"]["batch_occupancy"] = round(
+            out["serve"].get("serve.batch.jobs", 0) / dispatches, 3)
     return out
 
 
@@ -114,6 +136,14 @@ def main(argv=None) -> int:
     for name, v in sorted(rep["exchange"].items()):
         shown = _fmt_bytes(v) if name.endswith("bytes") else f"{v:.0f}"
         print(f"  {name:<40s} {shown:>12s}")
+    if rep["serve"]:
+        print("== serve ==")
+        for name, v in sorted(rep["serve"].items()):
+            print(f"  {name:<40s} {v:>12.3f}")
+    if rep["gauges"]:
+        print("== gauges ==")
+        for name, v in sorted(rep["gauges"].items()):
+            print(f"  {name:<40s} {v:>12.6g}")
     print("== layer events ==")
     for name, v in sorted(rep["layer_events"].items()):
         print(f"  {name:<40s} {v:>12.0f}")
