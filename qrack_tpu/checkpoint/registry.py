@@ -1,0 +1,266 @@
+"""save_state / load_state: one registry over every representation.
+
+Each engine/layer class owns its serialization next to its state
+layout — a ``_ckpt_capture(capture_child)`` method returning a
+snapshot node and a ``_ckpt_restore(arrays, meta, children,
+restore_child)`` method rebuilding in place — and declares its kind
+tag as a ``_ckpt_kind`` class attribute.  The registry composes them
+into whole-stack snapshot TREES (QUnit recurses into its Schmidt
+factors, the hybrids into their live half) and flattens each tree into
+one container file (container.py).
+
+Restore is **restore-INTO**: layered stacks hold unserializable
+factory closures (layer wiring built by factory.py), so the natural
+recovery path builds a fresh stack through the same factory and then
+loads the snapshot into it — child engines are constructed by the
+LIVE object's own factory and only their state is overwritten.
+``load_state(path)`` without a target builds default-wired objects
+from the snapshot's recorded constructor metadata, which round-trips
+every preset the engine matrix tests.
+
+rng stream positions (PCG64 bit-generator state, utils/rng.py) ride in
+every node's meta and are restored LAST, after any child-spawning the
+restore itself performed — a restored stack continues bit-identically,
+measurement streams included.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .container import (CheckpointError, load_container, save_container)
+
+STATE_KIND_PREFIX = "qrack-state:"
+
+
+# -- rng stream position -----------------------------------------------
+
+
+def rng_state(rng) -> dict:
+    """JSON-able PCG64 position for a utils.rng.QrackRandom."""
+    return {"seed": int(rng._seed), "state": rng._gen.bit_generator.state}
+
+
+def restore_rng(rng, st: dict) -> None:
+    rng.seed(int(st["seed"]))
+    bg = dict(st["state"])
+    inner = dict(bg.get("state", {}))
+    # JSON round-trips ints losslessly (arbitrary precision), but
+    # normalize key types defensively
+    bg["state"] = {k: int(v) for k, v in inner.items()}
+    rng._gen.bit_generator.state = bg
+
+
+def _maybe_rng_meta(obj, meta: dict) -> None:
+    if "rng" in meta:
+        return
+    rng = getattr(obj, "rng", None)
+    if rng is not None and hasattr(rng, "_gen"):
+        meta["rng"] = rng_state(rng)
+
+
+# -- capture / restore -------------------------------------------------
+
+
+def kind_of(obj) -> Optional[str]:
+    """The object's snapshot kind tag (forwarded through proxies)."""
+    return getattr(obj, "_ckpt_kind", None)
+
+
+def capture(obj) -> dict:
+    """Snapshot `obj` (and its children, recursively) into a tree of
+    ``{"kind", "meta", "arrays", "children"}`` nodes.  Host-complete:
+    every device array is materialized via np.asarray before return."""
+    cap = getattr(obj, "_ckpt_capture", None)
+    if cap is None:
+        raise CheckpointError(
+            f"{type(obj).__name__} does not support checkpointing")
+    snap = cap(capture)
+    snap.setdefault("meta", {})
+    snap.setdefault("arrays", {})
+    snap.setdefault("children", {})
+    _maybe_rng_meta(obj, snap["meta"])
+    # base interface flags every stack level shares
+    for attr in ("do_normalize", "rand_global_phase"):
+        if attr not in snap["meta"] and hasattr(obj, attr):
+            snap["meta"][attr] = bool(getattr(obj, attr))
+    return snap
+
+
+def restore_into(obj, snap: dict):
+    """Load snapshot tree `snap` into live object `obj` in place (the
+    stack keeps its own factories/wiring; only state is overwritten).
+    Returns `obj`."""
+    if type(obj).__name__ == "ResilientEngine":
+        inner = obj.engine
+        if kind_of(inner) != snap["kind"]:
+            object.__setattr__(obj, "_engine", build(snap))
+        else:
+            restore_into(inner, snap)
+        return obj
+    if kind_of(obj) != snap["kind"]:
+        raise CheckpointError(
+            f"snapshot kind {snap['kind']!r} does not match live "
+            f"{type(obj).__name__} (kind {kind_of(obj)!r})")
+    meta = snap.get("meta", {})
+    obj._ckpt_restore(snap.get("arrays", {}), meta,
+                      snap.get("children", {}), restore_child)
+    for attr in ("do_normalize", "rand_global_phase"):
+        if attr in meta and hasattr(obj, attr):
+            setattr(obj, attr, bool(meta[attr]))
+    # LAST: pin the rng stream position (restore above may have spawned
+    # children off this stream; the snapshot position wins)
+    rng = getattr(obj, "rng", None)
+    if "rng" in meta and rng is not None and hasattr(rng, "_gen"):
+        restore_rng(rng, meta["rng"])
+    return obj
+
+
+def restore_child(snap: dict, into=None):
+    """Helper handed to _ckpt_restore implementations: restore a child
+    snapshot into `into` when it exists and matches, else build a
+    standalone object from the snapshot."""
+    if into is not None and kind_of(into) == snap["kind"]:
+        return restore_into(into, snap)
+    return build(snap)
+
+
+def build(snap: dict):
+    """Construct a default-wired object for `snap` from its recorded
+    constructor metadata, then restore the snapshot into it."""
+    kind = snap["kind"]
+    meta = snap.get("meta", {})
+    n = int(meta["n"])
+    if kind == "cpu":
+        from ..engines.cpu import QEngineCPU
+
+        obj = QEngineCPU(n, dtype=np.dtype(meta.get("dtype", "complex128")))
+    elif kind == "tpu":
+        from ..engines.tpu import QEngineTPU
+
+        obj = QEngineTPU(n, dtype=meta.get("dtype"))
+    elif kind == "sparse":
+        from ..engines.sparse import QEngineSparse
+
+        obj = QEngineSparse(n)
+    elif kind == "pager":
+        from ..parallel.pager import QPager
+
+        # honor the recorded page layout: MAll's per-page draw pattern
+        # depends on n_pages, and bit-identical continuation needs the
+        # same pattern (restore-INTO an existing pager may still remap)
+        n_pages = meta.get("n_pages")
+        try:
+            obj = QPager(n, n_pages=int(n_pages) if n_pages else None)
+        except ValueError:
+            obj = QPager(n)  # fewer devices here than at save time
+    elif kind == "turboquant":
+        from ..engines.turboquant import QEngineTurboQuant
+
+        obj = QEngineTurboQuant(n, bits=int(meta["bits"]),
+                                block_pow=int(meta["block_pow"]),
+                                seed_rot=int(meta["seed"]))
+    elif kind == "turboquant_pager":
+        from ..parallel.turboquant_pager import QPagerTurboQuant
+
+        obj = QPagerTurboQuant(n, bits=int(meta["bits"]),
+                               block_pow=int(meta["block_pow"]),
+                               seed_rot=int(meta["seed"]))
+    elif kind == "stabilizer":
+        from ..layers.stabilizer import QStabilizer
+
+        obj = QStabilizer(n)
+    elif kind == "unit":
+        from ..layers.qunit import QUnit
+
+        obj = QUnit(n)
+    elif kind == "unit_multi":
+        from ..layers.qunitmulti import QUnitMulti
+
+        obj = QUnitMulti(n)
+    elif kind == "unit_clifford":
+        from ..layers.qunitclifford import QUnitClifford
+
+        obj = QUnitClifford(n)
+    elif kind == "stabilizer_hybrid":
+        from ..layers.stabilizerhybrid import QStabilizerHybrid
+
+        obj = QStabilizerHybrid(n)
+    elif kind == "bdt":
+        from ..layers.qbdt import QBdt
+
+        obj = QBdt(n, attached_qubits=int(meta.get("attached_qubits", 0)))
+    elif kind == "bdt_hybrid":
+        from ..layers.qbdthybrid import QBdtHybrid
+
+        obj = QBdtHybrid(
+            n, attached_qubits=int(meta.get("attached_qubits", 0)))
+    elif kind == "hybrid":
+        from ..engines.hybrid import QHybrid
+
+        obj = QHybrid(
+            n,
+            tpu_threshold_qubits=int(meta["tpu_threshold"]),
+            pager_threshold_qubits=int(meta["pager_threshold"]))
+    else:
+        raise CheckpointError(f"unknown snapshot kind {kind!r}")
+    return restore_into(obj, snap)
+
+
+# -- tree <-> flat container -------------------------------------------
+
+
+def _flatten(snap: dict, prefix: str, out: Dict[str, np.ndarray]) -> dict:
+    node = {"kind": snap["kind"], "meta": snap.get("meta", {}),
+            "arrays": {}, "children": {}}
+    for name, arr in snap.get("arrays", {}).items():
+        key = f"{prefix}{name}"
+        out[key] = arr
+        node["arrays"][name] = key
+    for name, child in snap.get("children", {}).items():
+        node["children"][name] = _flatten(child, f"{prefix}{name}/", out)
+    return node
+
+
+def _unflatten(node: dict, arrays: Dict[str, np.ndarray]) -> dict:
+    return {
+        "kind": node["kind"], "meta": node.get("meta", {}),
+        "arrays": {name: arrays[key]
+                   for name, key in node.get("arrays", {}).items()},
+        "children": {name: _unflatten(child, arrays)
+                     for name, child in node.get("children", {}).items()},
+    }
+
+
+# -- public file API ---------------------------------------------------
+
+
+def save_state(obj, path: str) -> int:
+    """Snapshot `obj` (any supported engine/layer stack, resilience
+    proxy included) into one container file; returns bytes written."""
+    snap = capture(obj)
+    flat: Dict[str, np.ndarray] = {}
+    tree = _flatten(snap, "", flat)
+    return save_container(path, flat, meta={"tree": tree},
+                          kind=STATE_KIND_PREFIX + snap["kind"])
+
+
+def load_snapshot(path: str) -> dict:
+    """Read a state container back into a snapshot tree (no objects
+    constructed yet)."""
+    kind, meta, arrays = load_container(path)
+    if not (kind or "").startswith(STATE_KIND_PREFIX):
+        raise CheckpointError(f"{path}: not a state checkpoint ({kind!r})")
+    return _unflatten(meta["tree"], arrays)
+
+
+def load_state(path: str, into=None):
+    """Restore a saved stack: into a live object when given (the spill/
+    recovery path — state loads into the session's own factory-built
+    stack), else build default-wired objects from the snapshot."""
+    snap = load_snapshot(path)
+    if into is not None:
+        return restore_into(into, snap)
+    return build(snap)

@@ -1,0 +1,327 @@
+"""CheckpointStore: the bounded on-disk session store behind serving.
+
+One directory owns everything a serving process needs to survive an
+idle eviction or an outright crash:
+
+    <root>/manifest.json        live-session manifest (atomic rewrite)
+    <root>/sessions/<sid>.qckpt spilled / checkpointed session state
+    <root>/wal/<seq>-<sid>.qckpt pending-job journal (one circuit each)
+
+* **Spill/restore** — SessionManager's idle evictor hands the engine
+  here instead of discarding it; the state container (registry.py)
+  lands under ``sessions/`` and the session keeps only its manifest
+  entry until the next job faults it back in (restore-INTO a fresh
+  factory-built stack, so wiring closures survive).
+* **Crash recovery** — the manifest records every live session's
+  constructor recipe (width/layers/seed/engine kwargs) the moment it is
+  created, not just when it is spilled; QrackService(recover=True)
+  replays it into a fresh process and re-runs any journaled jobs.
+* **Bounded** — ``max_bytes`` caps the on-disk footprint; oldest
+  spilled state evicts first (the session itself survives — it just
+  loses its warm restore and recovery re-creates it cold).  The current
+  footprint is exported as the ``checkpoint.store.bytes`` gauge.
+
+All mutation happens on the serve executor thread (the same
+single-owner discipline as every other engine touch), so the store
+needs no locking beyond atomic manifest replacement for crash safety.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry as _tele
+from .container import (CheckpointCorrupt, CheckpointError, load_container,
+                        save_container)
+from .registry import load_state, save_state
+
+MANIFEST_VERSION = 1
+CIRCUIT_KIND = "qrack-circuit"
+
+
+# -- circuit <-> container (WAL entries + warm-start program manifest) --
+
+
+def circuit_payload(circuit) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """(meta, arrays) capturing a QCircuit exactly: per-gate payload
+    stacks keyed ``g<i>`` with targets/controls/perms in meta."""
+    meta_gates = []
+    arrays: Dict[str, np.ndarray] = {}
+    for i, g in enumerate(circuit.gates):
+        perms = sorted(g.payloads)
+        meta_gates.append({"target": int(g.target),
+                           "controls": [int(c) for c in g.controls],
+                           "perms": [int(p) for p in perms]})
+        arrays[f"g{i}"] = np.stack(
+            [np.asarray(g.payloads[p], dtype=np.complex128) for p in perms])
+    return {"n": int(circuit.qubit_count), "gates": meta_gates}, arrays
+
+
+def circuit_from_payload(meta: dict, arrays: Dict[str, np.ndarray]):
+    from ..layers.qcircuit import QCircuit, QCircuitGate
+
+    circ = QCircuit(int(meta["n"]))
+    for i, gm in enumerate(meta["gates"]):
+        stack = np.asarray(arrays[f"g{i}"], dtype=np.complex128)
+        payloads = {int(p): stack[j] for j, p in enumerate(gm["perms"])}
+        # bypass AppendGate: the journal replays the merged gate list
+        # verbatim, it must not re-merge
+        circ.gates.append(QCircuitGate(int(gm["target"]), payloads,
+                                       tuple(gm["controls"])))
+    return circ
+
+
+def save_circuit(path: str, circuit, extra_meta: Optional[dict] = None) -> int:
+    meta, arrays = circuit_payload(circuit)
+    if extra_meta:
+        meta.update(extra_meta)
+    return save_container(path, arrays, meta=meta, kind=CIRCUIT_KIND)
+
+
+def load_circuit(path: str):
+    """Returns (circuit, meta)."""
+    _, meta, arrays = load_container(path, expect_kind=CIRCUIT_KIND)
+    return circuit_from_payload(meta, arrays), meta
+
+
+def _json_safe(kwargs: dict) -> dict:
+    out = {}
+    for k, v in kwargs.items():
+        try:
+            json.dumps(v)
+        except (TypeError, ValueError):
+            continue
+        out[k] = v
+    return out
+
+
+class CheckpointStore:
+    def __init__(self, root: str, max_bytes: int = 512 * 1024 * 1024):
+        self.root = str(root)
+        self.max_bytes = int(max_bytes)
+        self._sessions_dir = os.path.join(self.root, "sessions")
+        self._wal_dir = os.path.join(self.root, "wal")
+        os.makedirs(self._sessions_dir, exist_ok=True)
+        os.makedirs(self._wal_dir, exist_ok=True)
+        self._manifest_path = os.path.join(self.root, "manifest.json")
+        self._manifest = self._read_manifest()
+        # WAL appends come from submitter threads (everything else is
+        # executor-thread-only); the sequence counter needs the lock
+        self._wal_lock = threading.Lock()
+        self._wal_seq = self._scan_wal_seq()
+        self._update_gauge()
+
+    # -- manifest ------------------------------------------------------
+
+    def _read_manifest(self) -> dict:
+        try:
+            with open(self._manifest_path) as f:
+                m = json.load(f)
+        except FileNotFoundError:
+            return {"version": MANIFEST_VERSION, "sessions": {}}
+        except (OSError, json.JSONDecodeError):
+            # a torn manifest must not kill recovery of the state files
+            return {"version": MANIFEST_VERSION, "sessions": {}}
+        if int(m.get("version", 0)) > MANIFEST_VERSION:
+            raise CheckpointError(
+                f"{self._manifest_path}: manifest version "
+                f"{m.get('version')} is newer than this reader")
+        m.setdefault("sessions", {})
+        return m
+
+    def _write_manifest(self) -> None:
+        fd, tmp = tempfile.mkstemp(prefix=".manifest-", suffix=".tmp",
+                                   dir=self.root)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._manifest, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._manifest_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def register(self, sid: str, width: int, layers, seed,
+                 engine_kwargs: Optional[dict] = None) -> None:
+        """Record a live session's constructor recipe for recovery."""
+        self._manifest["sessions"][sid] = {
+            "width": int(width),
+            "layers": layers if isinstance(layers, str) else list(layers),
+            "seed": None if seed is None else int(seed),
+            "engine_kwargs": _json_safe(engine_kwargs or {}),
+        }
+        self._write_manifest()
+
+    def unregister(self, sid: str) -> None:
+        if self._manifest["sessions"].pop(sid, None) is not None:
+            self._write_manifest()
+        self.drop_state(sid)
+        for path, _, wal_sid in self._wal_files():
+            if wal_sid == sid:
+                self._unlink(path)
+        self._update_gauge()
+
+    def sessions(self) -> Dict[str, dict]:
+        return dict(self._manifest["sessions"])
+
+    # -- session state (spill / checkpoint / restore) ------------------
+
+    def _state_path(self, sid: str) -> str:
+        return os.path.join(self._sessions_dir, f"{sid}.qckpt")
+
+    def has_state(self, sid: str) -> bool:
+        return os.path.exists(self._state_path(sid))
+
+    def save(self, sid: str, engine) -> str:
+        """Persist `engine`'s full state for `sid` (spill or explicit
+        checkpoint — the caller decides whether to drop residency)."""
+        path = self._state_path(sid)
+        save_state(engine, path)
+        self._enforce_budget(protect=path)
+        self._update_gauge()
+        return path
+
+    def load(self, sid: str, into=None):
+        """Restore `sid`'s state; raises CheckpointError when absent."""
+        path = self._state_path(sid)
+        if not os.path.exists(path):
+            raise CheckpointError(f"no spilled state for session {sid}")
+        return load_state(path, into=into)
+
+    def drop_state(self, sid: str) -> None:
+        self._unlink(self._state_path(sid))
+        self._update_gauge()
+
+    def _enforce_budget(self, protect: Optional[str] = None) -> List[str]:
+        """Evict oldest spilled state files until under max_bytes; the
+        just-written file is protected so a single oversized session
+        cannot evict itself into a lost update."""
+        if self.max_bytes <= 0:
+            return []
+        evicted = []
+        while self.total_bytes() > self.max_bytes:
+            victims = sorted(
+                (os.path.getmtime(p), p) for p in self._state_files()
+                if p != protect)
+            if not victims:
+                break
+            _, path = victims[0]
+            self._unlink(path)
+            evicted.append(path)
+        if evicted and _tele._ENABLED:
+            _tele.inc("checkpoint.store.evicted", len(evicted))
+        return evicted
+
+    # -- pending-job journal (WAL) -------------------------------------
+
+    def _scan_wal_seq(self) -> int:
+        seqs = [seq for _, seq, _ in self._wal_files()]
+        return max(seqs) + 1 if seqs else 0
+
+    def _wal_files(self) -> List[Tuple[str, int, str]]:
+        """[(path, seq, sid)] sorted by seq."""
+        out = []
+        try:
+            names = os.listdir(self._wal_dir)
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".qckpt"):
+                continue
+            stem = name[:-len(".qckpt")]
+            seq_s, _, sid = stem.partition("-")
+            try:
+                seq = int(seq_s)
+            except ValueError:
+                continue
+            out.append((os.path.join(self._wal_dir, name), seq, sid))
+        out.sort(key=lambda t: t[1])
+        return out
+
+    def wal_append(self, sid: str, circuit) -> str:
+        """Journal a submitted circuit; the executor deletes the entry
+        at job completion, so entries still present at startup are
+        exactly the jobs a crash interrupted."""
+        with self._wal_lock:
+            seq = self._wal_seq
+            self._wal_seq += 1
+        path = os.path.join(self._wal_dir, f"{seq:09d}-{sid}.qckpt")
+        save_circuit(path, circuit, extra_meta={"sid": sid, "seq": seq})
+        self._update_gauge()
+        return path
+
+    def wal_remove(self, path: str) -> None:
+        self._unlink(path)
+        self._update_gauge()
+
+    def wal_entries(self) -> List[Tuple[str, int, object]]:
+        """[(sid, seq, circuit)] in submit order; damaged entries (torn
+        writes at crash time) are skipped and removed."""
+        out = []
+        for path, seq, sid in self._wal_files():
+            try:
+                circ, _ = load_circuit(path)
+            except (CheckpointCorrupt, CheckpointError):
+                self._unlink(path)
+                continue
+            out.append((sid, seq, circ))
+        return out
+
+    def clear_wal(self) -> None:
+        for path, _, _ in self._wal_files():
+            self._unlink(path)
+        self._update_gauge()
+
+    # -- footprint -----------------------------------------------------
+
+    def _state_files(self) -> List[str]:
+        try:
+            return [os.path.join(self._sessions_dir, n)
+                    for n in os.listdir(self._sessions_dir)
+                    if n.endswith(".qckpt")]
+        except OSError:
+            return []
+
+    def total_bytes(self) -> int:
+        total = 0
+        for d in (self._sessions_dir, self._wal_dir):
+            try:
+                for name in os.listdir(d):
+                    try:
+                        total += os.path.getsize(os.path.join(d, name))
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+        return total
+
+    def stats(self) -> dict:
+        return {
+            "root": self.root,
+            "sessions": len(self._manifest["sessions"]),
+            "spilled": len(self._state_files()),
+            "wal_entries": len(self._wal_files()),
+            "bytes": self.total_bytes(),
+            "max_bytes": self.max_bytes,
+        }
+
+    @staticmethod
+    def _unlink(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def _update_gauge(self) -> None:
+        if _tele._ENABLED:
+            _tele.gauge("checkpoint.store.bytes", self.total_bytes())

@@ -1,0 +1,147 @@
+"""Persistent warm start: compile once per machine, not once per process.
+
+Two layers, both rooted in the checkpoint directory:
+
+* :func:`enable_warm_start` points JAX's persistent compilation cache
+  at ``<dir>/xla_cache`` with the thresholds zeroed, so every XLA
+  executable this process compiles — fused circuit programs, vmapped
+  batch programs, gate kernels — lands on disk and a later process
+  deserializes instead of recompiling.
+* :class:`ProgramManifest` records every circuit shape the serving
+  batcher compiles (digest-keyed by ``QCircuit.shape_key`` + batch
+  size, the exact program-cache identity) together with the circuit
+  itself in a container file.  A fresh process calls :meth:`prewarm`
+  BEFORE taking traffic: each recorded circuit re-traces and re-jits —
+  cheap, because the XLA cache supplies the compiled binary — so the
+  first real job is a program-cache hit instead of a cold compile.
+
+Nothing here imports jax at module load; both hooks are wired lazily
+by QrackService when QRACK_SERVE_CHECKPOINT_DIR is set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+from .. import telemetry as _tele
+from .container import CheckpointCorrupt, CheckpointError
+from .store import load_circuit, save_circuit
+
+_ENABLED_DIR: Optional[str] = None
+
+
+def enable_warm_start(cache_dir: str) -> str:
+    """Point the JAX persistent compilation cache at `cache_dir` (with
+    the size/time admission thresholds disabled — serving programs are
+    many and individually small).  Idempotent; returns the directory."""
+    global _ENABLED_DIR
+    cache_dir = str(cache_dir)
+    if _ENABLED_DIR == cache_dir:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _ENABLED_DIR = cache_dir
+    if _tele._ENABLED:
+        _tele.event("checkpoint.warmstart.enabled", dir=cache_dir)
+    return cache_dir
+
+
+class ProgramManifest:
+    """Digest-keyed record of every (circuit, width, batch) program the
+    batcher compiled, durable enough to pre-trace them next boot."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._index_path = os.path.join(self.root, "programs.json")
+        try:
+            with open(self._index_path) as f:
+                self._index = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            self._index = {}
+
+    @staticmethod
+    def _key(shape_key, batch: int) -> str:
+        n, bucket, digest = shape_key
+        return f"{n}:{batch}:{digest}"
+
+    def record(self, circuit, n: int, batch: int) -> None:
+        """Idempotent: a known (shape, batch) is a no-op, so the hot
+        batcher path costs one dict probe."""
+        key = self._key(circuit.shape_key(n), batch)
+        if key in self._index:
+            return
+        digest = key.rsplit(":", 1)[0].split(":", 2)[-1]
+        path = os.path.join(self.root, f"{digest}.qckpt")
+        if not os.path.exists(path):
+            save_circuit(path, circuit)
+        self._index[key] = {"width": int(n), "batch": int(batch),
+                            "circuit": os.path.basename(path)}
+        self._write_index()
+        if _tele._ENABLED:
+            _tele.inc("checkpoint.warmstart.recorded")
+
+    def _write_index(self) -> None:
+        fd, tmp = tempfile.mkstemp(prefix=".programs-", suffix=".tmp",
+                                   dir=self.root)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._index, f, sort_keys=True)
+            os.replace(tmp, self._index_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def prewarm(self, limit: Optional[int] = None) -> int:
+        """Re-trace + re-compile every recorded program and leave it hot
+        in the batcher's program cache AND jit's dispatch cache.  With
+        the persistent XLA cache enabled the compile step is a disk
+        read; returns how many programs were warmed.  Damaged circuit
+        files are dropped from the manifest, not fatal."""
+        import jax.numpy as jnp
+
+        from ..config import get_config
+        from ..serve import batcher as _batcher
+
+        dtype = get_config().device_real_dtype()
+        warmed = 0
+        dead = []
+        for key, rec in list(self._index.items()):
+            if limit is not None and warmed >= limit:
+                break
+            path = os.path.join(self.root, rec["circuit"])
+            try:
+                circ, _ = load_circuit(path)
+            except (CheckpointCorrupt, CheckpointError, OSError):
+                dead.append(key)
+                continue
+            n, batch = int(rec["width"]), int(rec["batch"])
+            fn = _batcher.batch_program(circ, n, batch)
+            # jax.jit is lazy — building the wrapper traces nothing.
+            # Run it once on a dummy |0..0> plane stack (same shape and
+            # dtype run_batch dispatches) so trace + compile happen
+            # HERE, not under the first tenant's job.
+            planes = (jnp.zeros((batch, 2, 1 << n), dtype=dtype)
+                      .at[:, 0, 0].set(1.0))
+            _batcher.sync_scalar(fn(planes))
+            warmed += 1
+        for key in dead:
+            self._index.pop(key, None)
+        if dead:
+            self._write_index()
+        if warmed and _tele._ENABLED:
+            _tele.inc("checkpoint.warmstart.prewarmed", warmed)
+        return warmed

@@ -207,3 +207,31 @@ class QHybrid:
     @property
     def qubit_count(self) -> int:
         return self._engine.qubit_count
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (checkpoint/registry.py): thresholds +
+    # failover ceiling + the live engine (restored INTO this stack's
+    # engine when the mode matches, else rebuilt standalone)
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "hybrid"
+
+    def _ckpt_capture(self, capture_child):
+        return {"kind": "hybrid",
+                "meta": {"n": self.qubit_count,
+                         "tpu_threshold": int(self._tpu_threshold),
+                         "pager_threshold": int(self._pager_threshold),
+                         "failed_over": self._failed_over},
+                "children": {"engine": capture_child(self._engine)}}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        self._tpu_threshold = int(meta["tpu_threshold"])
+        self._pager_threshold = int(meta["pager_threshold"])
+        self._failed_over = meta.get("failed_over")
+        self._engine = restore_child(children["engine"], self._engine)
+        rng = getattr(self._engine, "rng", None)
+        if rng is not None:
+            # future mode switches must carry the restored stream
+            self._kwargs["rng"] = rng

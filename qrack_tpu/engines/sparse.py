@@ -550,3 +550,25 @@ class QEngineSparse(QInterface):
                        phase_arg: float = 0.0) -> None:
         self.SparseRenorm()
         self.running_norm = 1.0
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (checkpoint/registry.py)
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "sparse"
+
+    def _ckpt_capture(self, capture_child):
+        return {"kind": "sparse",
+                "meta": {"n": self.qubit_count, "trunc": float(self.trunc),
+                         "max_entries": int(self.max_entries),
+                         "running_norm": float(self.running_norm)},
+                "arrays": {"idx": self._idx, "amp": self._amp}}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        self.trunc = float(meta.get("trunc", self.trunc))
+        self.max_entries = int(meta.get("max_entries", self.max_entries))
+        self._idx = np.ascontiguousarray(arrays["idx"], dtype=np.int64)
+        self._amp = np.ascontiguousarray(arrays["amp"], dtype=np.complex128)
+        self.running_norm = float(meta.get("running_norm", 1.0))

@@ -486,3 +486,32 @@ class QEngineTPU(QEngine):
         self._state = self._state.at[:, offset:offset + len(page)].set(
             gk.to_planes(page, self.dtype)
         )
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (checkpoint/registry.py)
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "tpu"
+
+    def _ckpt_capture(self, capture_child):
+        # bf16/f16 planes upcast losslessly to f32 for the archive; the
+        # device dtype string restores the resident representation
+        host_dt = (np.float64 if jnp.dtype(self.dtype).itemsize >= 8
+                   else np.float32)
+        planes = np.asarray(jax.device_get(self._state)).astype(host_dt)
+        return {"kind": "tpu",
+                "meta": {"n": self.qubit_count, "dtype": str(self.dtype),
+                         "gate_count": int(self._gate_count),
+                         "running_norm": float(self.running_norm)},
+                "arrays": {"planes": planes}}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        self.dtype = jnp.dtype(meta["dtype"])
+        if self.dtype == jnp.dtype("float64") and not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        self._state = self._put(jnp.asarray(np.asarray(arrays["planes"]),
+                                            dtype=self.dtype))
+        self._gate_count = int(meta.get("gate_count", 0))
+        self.running_norm = float(meta.get("running_norm", 1.0))

@@ -957,21 +957,75 @@ class QEngineTurboQuant(QEngineTPU):
     # ------------------------------------------------------------------
 
     def SaveTurboQuant(self, path: str) -> None:
-        np.savez_compressed(path, codes=np.asarray(self._codes),
-                            scales=np.asarray(self._scales),
-                            n=self.qubit_count, bits=self._tq_bits,
-                            block_pow=self._tq_block_pow, seed=self._tq_seed)
+        from ..checkpoint.container import save_container
+
+        p = path if str(path).endswith(".npz") else str(path) + ".npz"
+        # scalar members mirror the pre-container layout so older
+        # readers still load these archives as bare npz
+        save_container(p, {"codes": np.asarray(self._codes),
+                           "scales": np.asarray(self._scales),
+                           "n": np.asarray(self.qubit_count),
+                           "bits": np.asarray(self._tq_bits),
+                           "block_pow": np.asarray(self._tq_block_pow),
+                           "seed": np.asarray(self._tq_seed)},
+                       meta={"n": self.qubit_count, "bits": self._tq_bits,
+                             "block_pow": self._tq_block_pow,
+                             "seed": self._tq_seed},
+                       kind="turboquant-codes")
 
     @classmethod
     def LoadTurboQuant(cls, path: str, **kwargs):
-        with np.load(path if str(path).endswith(".npz")
-                     else str(path) + ".npz") as z:
-            eng = cls(int(z["n"]), bits=int(z["bits"]),
-                      block_pow=int(z["block_pow"]), seed_rot=int(z["seed"]),
-                      **kwargs)
-            eng._codes = jnp.asarray(z["codes"])
-            eng._scales = jnp.asarray(z["scales"])
+        from ..checkpoint.container import load_container
+
+        p = path if str(path).endswith(".npz") else str(path) + ".npz"
+        kind, meta, z = load_container(p, legacy_ok=True)
+        if kind is None:  # legacy bare-npz archive (pre-container)
+            meta = {k: int(z[k]) for k in ("n", "bits", "block_pow", "seed")}
+        eng = cls(int(meta["n"]), bits=int(meta["bits"]),
+                  block_pow=int(meta["block_pow"]), seed_rot=int(meta["seed"]),
+                  **kwargs)
+        eng._ckpt_place(np.asarray(z["codes"], dtype=eng._code_np),
+                        np.asarray(z["scales"], dtype=np.float32))
         return eng
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (checkpoint/registry.py)
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "turboquant"
+
+    def _ckpt_place(self, codes: np.ndarray, scales: np.ndarray) -> None:
+        """Land host (codes, scales) where this engine keeps them (the
+        sharded subclass overrides with its mesh placement)."""
+        self._codes = jnp.asarray(codes)
+        self._scales = jnp.asarray(scales)
+
+    def _ckpt_capture(self, capture_child):
+        return {"kind": self._ckpt_kind,
+                "meta": {"n": self.qubit_count, "bits": self._tq_bits,
+                         "block_pow": self._tq_block_pow,
+                         "chunk_pow": self._tq_chunk_pow,
+                         "seed": self._tq_seed,
+                         "running_norm": float(self.running_norm)},
+                "arrays": {"codes": np.asarray(self._codes),
+                           "scales": np.asarray(self._scales)}}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        if (int(meta["bits"]) != self._tq_bits
+                or int(meta["block_pow"]) != self._tq_block_pow
+                or int(meta["seed"]) != self._tq_seed):
+            raise ValueError(
+                "turboquant layout mismatch (bits/block_pow/seed)")
+        codes = np.asarray(arrays["codes"], dtype=self._code_np)
+        if self._codes is not None and codes.shape != tuple(self._codes.shape):
+            raise ValueError(
+                "turboquant chunk layout mismatch (QRACK_TURBOQUANT_CHUNK_QB "
+                "differs from the saving process)")
+        self._ckpt_place(codes, np.asarray(arrays["scales"],
+                                           dtype=np.float32))
+        self.running_norm = float(meta.get("running_norm", 1.0))
 
 
 @jax.jit

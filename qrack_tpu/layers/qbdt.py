@@ -1263,3 +1263,113 @@ class QBdt(QInterface):
 
             self.root = rebuild(self.root)
             self._t = fresh
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (checkpoint/registry.py): EXACT DAG capture —
+    # node weights, sharing structure, and leaf payloads verbatim.  A
+    # dense-ket round-trip would rebuild the tree with different node
+    # normalization round-off than the incrementally-grown original,
+    # and later gates would amplify that into non-identical amplitudes.
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "bdt"
+
+    def _ckpt_capture(self, capture_child):
+        arrays = {}
+        node_w: list = []  # (w0, w1) per interior node
+        node_c: list = []  # child refs per interior node
+        ids: dict = {}
+        n_leaves = [0]
+
+        # child ref encoding: >=0 node index (children precede parents),
+        # -1 absent branch, -2 the shared terminal, <=-3 leaf -(ref+3)
+        def ref(ch):
+            if ch is None:
+                return -1
+            if ch is _Tree.LEAF:
+                return -2
+            r = ids.get(id(ch))
+            if r is not None:
+                return r
+            if isinstance(ch, _EngLeaf):
+                i = n_leaves[0]
+                n_leaves[0] += 1
+                if ch.on_device:
+                    import jax
+
+                    arrays[f"leafpl_{i}"] = np.asarray(
+                        jax.device_get(ch.planes))
+                else:
+                    arrays[f"leafvec_{i}"] = np.asarray(
+                        ch.vec, dtype=np.complex128)
+                r = -(3 + i)
+            else:
+                c0 = ref(ch[1])
+                c1 = ref(ch[3])
+                node_w.append([ch[0], ch[2]])
+                node_c.append([c0, c1])
+                r = len(node_w) - 1
+            ids[id(ch)] = r
+            return r
+
+        root = ref(self.root)
+        if node_w:
+            arrays["node_w"] = np.asarray(node_w, dtype=np.complex128)
+            arrays["node_c"] = np.asarray(node_c, dtype=np.int64)
+        return {"kind": "bdt",
+                "meta": {"n": self.qubit_count,
+                         "attached_qubits": int(self.attached_qubits),
+                         "root": int(root),
+                         "scale": [self.scale.real, self.scale.imag]},
+                "arrays": arrays}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        self.attached_qubits = min(int(meta.get("attached_qubits", 0)),
+                                   self.qubit_count)
+        sc = meta.get("scale", [1.0, 0.0])
+        self.scale = complex(sc[0], sc[1])
+        self._t = _Tree()
+        built: dict = {}
+
+        def resolve(r):
+            r = int(r)
+            if r == -1:
+                return None
+            if r == -2:
+                return _Tree.LEAF
+            hit = built.get(r)
+            if hit is not None:
+                return hit
+            # only leaves land here: node refs always point at already-
+            # built lower indices
+            i = -3 - r
+            if f"leafpl_{i}" in arrays:
+                import jax.numpy as jnp
+
+                leaf = _EngLeaf(planes=jnp.asarray(
+                    np.asarray(arrays[f"leafpl_{i}"])))
+            else:
+                vec = np.ascontiguousarray(arrays[f"leafvec_{i}"],
+                                           dtype=np.complex128)
+                leaf = _EngLeaf(vec=vec)
+                key = (vec.shape[0], np.round(vec, _ROUND).tobytes())
+                self._t.leaves.setdefault(key, leaf)
+            built[r] = leaf
+            return leaf
+
+        node_w = arrays.get("node_w")
+        node_c = arrays.get("node_c")
+        for i in range(0 if node_w is None else node_w.shape[0]):
+            w0, w1 = complex(node_w[i][0]), complex(node_w[i][1])
+            c0, c1 = resolve(node_c[i][0]), resolve(node_c[i][1])
+            node = (w0, c0, w1, c1)
+            # re-intern so later node() calls deduplicate against the
+            # restored structure (identity keys rebuilt from new ids)
+            key = (round(w0.real, _ROUND), round(w0.imag, _ROUND),
+                   id(c0) if c0 is not None else 0,
+                   round(w1.real, _ROUND), round(w1.imag, _ROUND),
+                   id(c1) if c1 is not None else 0)
+            built[i] = self._t.table.setdefault(key, node)
+        self.root = resolve(meta["root"])

@@ -1638,63 +1638,72 @@ class QUnit(QInterface):
     def LossySaveStateVector(self, path: str, bits: int = 8, block_pow: int = 12) -> None:
         import json
 
-        from ..storage.turboquant import quantize_blocks
+        from ..checkpoint.container import save_container
+        from ..storage.turboquant import _npz_path, quantize_blocks
 
         self._flush_all()
         arrays = {}
-        meta = []
+        factors = []
         idx = 0
         for st, qs in self._factors():
             scales, codes, n = quantize_blocks(st, bits=bits, block_pow=block_pow)
             arrays[f"scales_{idx}"] = scales
             arrays[f"codes_{idx}"] = codes
-            meta.append({"qubits": [int(x) for x in qs], "n": int(n)})
+            factors.append({"qubits": [int(x) for x in qs], "n": int(n)})
             idx += 1
-        arrays["meta"] = np.frombuffer(
-            json.dumps({"format": "qunit-turboquant-v2", "bits": bits,
-                        "qubit_count": self.qubit_count,
-                        "factors": meta}).encode(), dtype=np.uint8)
-        np.savez_compressed(path, **arrays)
+        meta = {"format": "qunit-turboquant-v2", "bits": bits,
+                "qubit_count": self.qubit_count, "factors": factors}
+        # the json "meta" member keeps the pre-container layout readable
+        # by older loaders; the manifest adds checksums + versioning
+        arrays["meta"] = np.frombuffer(json.dumps(meta).encode(),
+                                       dtype=np.uint8)
+        save_container(_npz_path(path), arrays, meta=meta,
+                       kind="qunit-turboquant")
 
     def LossyLoadStateVector(self, path: str) -> None:
         import json
 
-        from ..storage.turboquant import (dequantize_blocks,
+        from ..checkpoint.container import load_container
+        from ..storage.turboquant import (_npz_path, dequantize_blocks,
                                           dequantize_blocks_v1, lossy_load)
 
-        p = path if str(path).endswith(".npz") else str(path) + ".npz"
-        with np.load(p) as z:
-            if "meta" not in z:
-                self.SetQuantumState(lossy_load(path))  # whole-ket fallback
-                return
+        kind, meta, z = load_container(_npz_path(path), legacy_ok=True)
+        if kind is None and "meta" in z:
+            # legacy (pre-container) per-factor archive: json-in-npz meta
             meta = json.loads(bytes(z["meta"]).decode())
-            fmt = meta.get("format")
-            if fmt == "qunit-turboquant-v1":
-                decode = dequantize_blocks_v1  # pre-rotation round-<=3 archive
-            elif fmt == "qunit-turboquant-v2":
-                decode = dequantize_blocks
+            kind = "qunit-turboquant"
+        if kind not in ("qunit-turboquant", None, "turboquant-lossy-ket"):
+            raise ValueError(f"unsupported QUnit checkpoint kind {kind!r}")
+        if kind != "qunit-turboquant":
+            self.SetQuantumState(lossy_load(path))  # whole-ket fallback
+            return
+        fmt = meta.get("format")
+        if fmt == "qunit-turboquant-v1":
+            decode = dequantize_blocks_v1  # pre-rotation round-<=3 archive
+        elif fmt == "qunit-turboquant-v2":
+            decode = dequantize_blocks
+        else:
+            # a per-factor archive in an unknown format can never be
+            # decoded by the whole-ket fallback (no top-level codes/
+            # scales keys) — fail with the real reason
+            raise ValueError(f"unsupported QUnit checkpoint format {fmt!r}")
+        if meta["qubit_count"] != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        self.shards = [_Shard() for _ in range(self.qubit_count)]
+        for i, fm in enumerate(meta["factors"]):
+            st = decode(z[f"scales_{i}"], z[f"codes_{i}"],
+                        fm["n"], meta["bits"])
+            qs = fm["qubits"]
+            if len(qs) == 1:
+                s = self.shards[qs[0]]
+                s.amp0, s.amp1 = complex(st[0]), complex(st[1])
             else:
-                # a per-factor archive in an unknown format can never be
-                # decoded by the whole-ket fallback (no top-level codes/
-                # scales keys) — fail with the real reason
-                raise ValueError(f"unsupported QUnit checkpoint format {fmt!r}")
-            if meta["qubit_count"] != self.qubit_count:
-                raise ValueError("checkpoint width mismatch")
-            self.shards = [_Shard() for _ in range(self.qubit_count)]
-            for i, fm in enumerate(meta["factors"]):
-                st = decode(z[f"scales_{i}"], z[f"codes_{i}"],
-                            fm["n"], meta["bits"])
-                qs = fm["qubits"]
-                if len(qs) == 1:
-                    s = self.shards[qs[0]]
-                    s.amp0, s.amp1 = complex(st[0]), complex(st[1])
-                else:
-                    unit = self._factory(len(qs), rng=self.rng.spawn(),
-                                         **self._unit_kwargs)
-                    unit.SetQuantumState(st)
-                    for pos, q in enumerate(qs):
-                        self.shards[q].unit = unit
-                        self.shards[q].mapped = pos
+                unit = self._factory(len(qs), rng=self.rng.spawn(),
+                                     **self._unit_kwargs)
+                unit.SetQuantumState(st)
+                for pos, q in enumerate(qs):
+                    self.shards[q].unit = unit
+                    self.shards[q].mapped = pos
 
     def Finish(self) -> None:
         seen = set()
@@ -1708,3 +1717,94 @@ class QUnit(QInterface):
             return all(s.cached or s.unit.isClifford() for s in self.shards)
         s = self.shards[q]
         return s.cached or s.unit.isClifford()
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (checkpoint/registry.py): EXACT structured
+    # capture — cached shards as amplitude pairs, each entangled unit
+    # recursing through its own protocol, and the fusion buffers
+    # (pending 1q unitaries + the phase-link bag) verbatim.  Unlike the
+    # lossy per-factor path above nothing is quantized, and unlike
+    # GetQuantumState nothing is FLUSHED: a capture must not change
+    # when units get created relative to an uninterrupted run, or the
+    # unit-spawning rng draws would land at different stream positions
+    # and measurement histories after restore would diverge.
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "unit"
+
+    def _ckpt_capture(self, capture_child):
+        arrays = {}
+        shards_meta = []
+        links_meta = []
+        children = {}
+        unit_names: Dict[int, str] = {}
+        qubit_of = {id(s): q for q, s in enumerate(self.shards)}
+        seen_links = set()
+        for q in range(self.qubit_count):
+            s = self.shards[q]
+            sm = {}
+            if s.cached:
+                sm["amp"] = [s.amp0.real, s.amp0.imag,
+                             s.amp1.real, s.amp1.imag]
+            else:
+                name = unit_names.get(id(s.unit))
+                if name is None:
+                    name = f"u{len(unit_names)}"
+                    unit_names[id(s.unit)] = name
+                    children[name] = capture_child(s.unit)
+                sm["unit"] = name
+                sm["mapped"] = int(s.mapped)
+            if s.pending is not None:
+                arrays[f"pending_{q}"] = np.asarray(s.pending,
+                                                    dtype=np.complex128)
+                sm["pending"] = True
+            shards_meta.append(sm)
+            for link in s.links.values():
+                if id(link) in seen_links:
+                    continue
+                seen_links.add(id(link))
+                i = len(links_meta)
+                arrays[f"link_{i}_d"] = np.asarray(link.d,
+                                                   dtype=np.complex128)
+                links_meta.append({
+                    "a": qubit_of[id(link.a)], "b": qubit_of[id(link.b)],
+                    "xt": (None if link.xt is None
+                           else qubit_of[id(link.xt)]),
+                    "x": [int(link.x[0]), int(link.x[1])]})
+        return {"kind": self._ckpt_kind,
+                "meta": {"n": self.qubit_count, "shards": shards_meta,
+                         "links": links_meta,
+                         "log_fidelity": float(self.log_fidelity)},
+                "arrays": arrays, "children": children}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        self.shards = [_Shard() for _ in range(self.qubit_count)]
+        units = {}
+        for name, snap in children.items():
+            fresh = self._factory(int(snap["meta"]["n"]),
+                                  rng=self.rng.spawn(), **self._unit_kwargs)
+            units[name] = restore_child(snap, fresh)
+        for q, sm in enumerate(meta["shards"]):
+            s = self.shards[q]
+            if "unit" in sm:
+                s.unit = units[sm["unit"]]
+                s.mapped = int(sm["mapped"])
+            else:
+                a = sm["amp"]
+                s.amp0 = complex(a[0], a[1])
+                s.amp1 = complex(a[2], a[3])
+            if sm.get("pending"):
+                s.pending = np.ascontiguousarray(arrays[f"pending_{q}"],
+                                                 dtype=np.complex128)
+        for i, lm in enumerate(meta.get("links", [])):
+            sa, sb = self.shards[lm["a"]], self.shards[lm["b"]]
+            link = _PhaseLink(sa, sb, np.ascontiguousarray(
+                arrays[f"link_{i}_d"], dtype=np.complex128))
+            if lm.get("xt") is not None:
+                link.xt = self.shards[lm["xt"]]
+                link.x = [int(lm["x"][0]), int(lm["x"][1])]
+            sa.links[sb] = link
+            sb.links[sa] = link
+        self.log_fidelity = float(meta.get("log_fidelity", 0.0))

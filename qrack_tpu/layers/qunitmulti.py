@@ -294,3 +294,8 @@ class QUnitMulti(QUnit):
                     break
             else:
                 self._raise_no_fit(need)
+
+    # checkpoint protocol: QUnit's structured capture/restore applies
+    # unchanged; restored units land on devices via the usual
+    # redistribution on the next gate
+    _ckpt_kind = "unit_multi"

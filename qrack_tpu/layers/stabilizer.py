@@ -1274,3 +1274,26 @@ class QStabilizer(QInterface):
 
     def GetQubitCount(self) -> int:
         return self.qubit_count
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol (checkpoint/registry.py): the whole tableau
+    # plus the tracked global phase
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "stabilizer"
+
+    def _ckpt_capture(self, capture_child):
+        return {"kind": "stabilizer",
+                "meta": {"n": self.qubit_count,
+                         "phase_offset": [self.phase_offset.real,
+                                          self.phase_offset.imag]},
+                "arrays": {"x": self.x, "z": self.z, "r": self.r}}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        self.x = np.ascontiguousarray(arrays["x"], dtype=np.uint8)
+        self.z = np.ascontiguousarray(arrays["z"], dtype=np.uint8)
+        self.r = np.ascontiguousarray(arrays["r"], dtype=np.uint8)
+        po = meta.get("phase_offset", [1.0, 0.0])
+        self.phase_offset = complex(po[0], po[1])

@@ -849,7 +849,8 @@ class QPager(QEngine):
     def LossySaveStateVector(self, path: str, bits: int = 8, block_pow: int = 12) -> None:
         import json
 
-        from ..storage.turboquant import quantize_blocks
+        from ..checkpoint.container import save_container
+        from ..storage.turboquant import _npz_path, quantize_blocks
 
         L = self.local_bits
         arrays = {}
@@ -858,49 +859,57 @@ class QPager(QEngine):
             scales, codes, n = quantize_blocks(page, bits=bits, block_pow=block_pow)
             arrays[f"scales_{p}"] = scales
             arrays[f"codes_{p}"] = codes
-        arrays["meta"] = np.frombuffer(json.dumps({
-            "format": "qpager-turboquant-v2", "bits": bits,
-            "qubit_count": self.qubit_count, "n_pages": self.n_pages,
-            "page_len": 1 << L, "device_ids": self.GetDeviceList(),
-        }).encode(), dtype=np.uint8)
-        np.savez_compressed(path, **arrays)
+        meta = {"format": "qpager-turboquant-v2", "bits": bits,
+                "qubit_count": self.qubit_count, "n_pages": self.n_pages,
+                "page_len": 1 << L, "device_ids": self.GetDeviceList()}
+        # the json "meta" member keeps the pre-container layout readable
+        # by older loaders; the manifest adds checksums + versioning
+        arrays["meta"] = np.frombuffer(json.dumps(meta).encode(),
+                                       dtype=np.uint8)
+        save_container(_npz_path(path), arrays, meta=meta,
+                       kind="qpager-turboquant")
 
     def LossyLoadStateVector(self, path: str) -> None:
         import json
 
-        from ..storage.turboquant import (dequantize_blocks,
+        from ..checkpoint.container import load_container
+        from ..storage.turboquant import (_npz_path, dequantize_blocks,
                                           dequantize_blocks_v1, lossy_load)
 
-        p = path if str(path).endswith(".npz") else str(path) + ".npz"
-        with np.load(p) as z:
-            if "meta" not in z:
-                self.SetQuantumState(lossy_load(path))  # whole-ket fallback
-                return
+        kind, meta, z = load_container(_npz_path(path), legacy_ok=True)
+        if kind is None and "meta" in z:
+            # legacy (pre-container) per-page archive: json-in-npz meta
             meta = json.loads(bytes(z["meta"]).decode())
-            fmt = meta.get("format")
-            if fmt == "qpager-turboquant-v1":
-                decode = dequantize_blocks_v1  # pre-rotation round-<=3 archive
-            elif fmt == "qpager-turboquant-v2":
-                decode = dequantize_blocks
-            else:
-                raise ValueError(f"unsupported QPager checkpoint format {fmt!r}")
-            if meta["qubit_count"] != self.qubit_count:
-                raise ValueError("checkpoint width mismatch")
-            plen = meta["page_len"]
-            if meta["n_pages"] * plen != (1 << self.qubit_count):
-                raise ValueError("checkpoint page layout inconsistent")
-            total = 0.0
-            for i in range(meta["n_pages"]):
-                # keep raw magnitudes: the stored scales carry each
-                # page's weight, so only ONE global renormalization runs.
-                # Offsets are checkpoint-relative (i * plen), so a pager
-                # with a different page count loads the same ket.
-                page = decode(z[f"scales_{i}"], z[f"codes_{i}"],
-                              plen, meta["bits"], normalize=False)
-                total += float(np.sum(np.abs(page) ** 2))
-                self.SetAmplitudePage(page, i * plen)
-            if total > 0:
-                self._k_normalize(total)
+            kind = "qpager-turboquant"
+        if kind not in ("qpager-turboquant", None, "turboquant-lossy-ket"):
+            raise ValueError(f"unsupported QPager checkpoint kind {kind!r}")
+        if kind != "qpager-turboquant":
+            self.SetQuantumState(lossy_load(path))  # whole-ket fallback
+            return
+        fmt = meta.get("format")
+        if fmt == "qpager-turboquant-v1":
+            decode = dequantize_blocks_v1  # pre-rotation round-<=3 archive
+        elif fmt == "qpager-turboquant-v2":
+            decode = dequantize_blocks
+        else:
+            raise ValueError(f"unsupported QPager checkpoint format {fmt!r}")
+        if meta["qubit_count"] != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        plen = meta["page_len"]
+        if meta["n_pages"] * plen != (1 << self.qubit_count):
+            raise ValueError("checkpoint page layout inconsistent")
+        total = 0.0
+        for i in range(meta["n_pages"]):
+            # keep raw magnitudes: the stored scales carry each
+            # page's weight, so only ONE global renormalization runs.
+            # Offsets are checkpoint-relative (i * plen), so a pager
+            # with a different page count loads the same ket.
+            page = decode(z[f"scales_{i}"], z[f"codes_{i}"],
+                          plen, meta["bits"], normalize=False)
+            total += float(np.sum(np.abs(page) ** 2))
+            self.SetAmplitudePage(page, i * plen)
+        if total > 0:
+            self._k_normalize(total)
 
     # ------------------------------------------------------------------
     # state access
@@ -1028,3 +1037,34 @@ class QPager(QEngine):
 
         prog = _program(self._key("setpage", len(page)), build)
         self._state = prog(self._state, gk.to_planes(page, self.dtype), offset)
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol: exact per-page shards, staged through the
+    # host one page per array (checkpoint/registry.py).  Offsets on
+    # restore are checkpoint-relative, so a pager with a different page
+    # count (device layout changed between save and restore) loads the
+    # same ket.
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "pager"
+
+    def _ckpt_capture(self, capture_child):
+        L = self.local_bits
+        arrays = {f"page_{p}": self.GetAmplitudePage(p << L, 1 << L)
+                  for p in range(self.n_pages)}
+        return {"kind": "pager",
+                "meta": {"n": self.qubit_count, "dtype": str(self.dtype),
+                         "n_pages": self.n_pages, "page_len": 1 << L,
+                         "running_norm": float(self.running_norm)},
+                "arrays": arrays}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        plen = int(meta["page_len"])
+        if int(meta["n_pages"]) * plen != (1 << self.qubit_count):
+            raise ValueError("checkpoint page layout inconsistent")
+        for i in range(int(meta["n_pages"])):
+            self.SetAmplitudePage(np.asarray(arrays[f"page_{i}"],
+                                             dtype=np.complex128), i * plen)
+        self.running_norm = float(meta.get("running_norm", 1.0))

@@ -387,3 +387,15 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
             return jax.jit(f, donate_argnums=(0,))
 
         return tqe._program(("tqp_collapse_s", self._layout_key()), build)
+
+    # ------------------------------------------------------------------
+    # checkpoint protocol: capture inherits (codes come to the host via
+    # np.asarray — a real devget); restore re-lands them on the mesh
+    # ------------------------------------------------------------------
+
+    _ckpt_kind = "turboquant_pager"
+
+    def _ckpt_place(self, codes: np.ndarray, scales: np.ndarray) -> None:
+        self._codes = jax.device_put(jnp.asarray(codes), self._code_sharding)
+        self._scales = jax.device_put(jnp.asarray(scales),
+                                      self._scale_sharding)

@@ -33,12 +33,46 @@ Two consumers:
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional
 
 from .. import telemetry as _tele
 from . import breaker as _breaker
 from . import faults as _faults
 from .errors import FAILOVER_ERRORS
+
+_persist_seq = 0
+
+
+def _persist_snapshot(engine, cause) -> Optional[str]:
+    """Durable post-mortem evidence: with QRACK_TPU_FAILOVER_PERSIST set
+    to a directory, write the failing engine's full checkpoint container
+    (ket + rng stream) there before rehydrating, so the pre-call state
+    survives even if the fallback itself dies.  Best-effort: a persist
+    failure must never block the failover it documents."""
+    global _persist_seq
+    root = os.environ.get("QRACK_TPU_FAILOVER_PERSIST")
+    if not root:
+        return None
+    try:
+        from ..checkpoint.registry import save_state
+
+        os.makedirs(root, exist_ok=True)
+        _persist_seq += 1
+        name = (f"failover-{int(time.time())}-{os.getpid()}"
+                f"-{_persist_seq:03d}.qckpt")
+        path = os.path.join(root, name)
+        save_state(engine, path)
+    except Exception:  # noqa: BLE001
+        if _tele._ENABLED:
+            _tele.inc("resilience.failover.persist_failed")
+        return None
+    if _tele._ENABLED:
+        _tele.event("resilience.failover.persisted", path=path,
+                    cause=type(cause).__name__ if cause else "")
+        _tele.inc("resilience.failover.persisted")
+    return path
 
 # attributes that live on the proxy itself, never forwarded
 _SELF_ATTRS = ("_engine", "_chain_pos")
@@ -78,6 +112,7 @@ def fail_over_engine(engine, cause: Optional[BaseException] = None):
     Raises the original `cause` (or RuntimeError) when the whole chain
     is exhausted — e.g. a pager wider than QRACK_MAX_CPU_QB."""
     with _faults.suspended():
+        _persist_snapshot(engine, cause)
         state = engine.GetQuantumState()
         rng = getattr(engine, "rng", None)
         src = _engine_kind(engine)

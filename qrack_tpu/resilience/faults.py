@@ -46,7 +46,8 @@ from typing import List, Optional
 from .. import telemetry as _tele
 from .errors import (DeviceLost, DispatchFailure, InjectedFault, NaNPoisoned)
 
-KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss")
+KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss",
+         "torn-write")
 
 # every call_guarded site in the tree (grep '"<name>"' call_guarded /
 # instrument_dispatch / guard_callable call sites when adding one) —
@@ -60,6 +61,7 @@ SITES = (
     "pager.dispatch", "pager.exchange", "pager.device_get",
     "turboquant.dispatch", "turboquant_pager.exchange",
     "serve.dispatch", "serve.device_get",
+    "checkpoint.save", "checkpoint.restore",
 )
 # bare last-segment categories that match the site family on any engine
 CATEGORIES = ("discover", "compile", "dispatch", "device_get", "exchange")
@@ -206,8 +208,11 @@ def check(site: str) -> Optional[str]:
 
     Raises the matching :class:`DispatchFailure` subclass for the
     ``timeout``/``raise``/``nan-poison``/``device-loss`` kinds, returns
-    the directive string ``"hang"`` (the dispatch wrapper swaps in a
-    sleeping stub), or returns None (no fault).
+    a directive string for the kinds the SITE must act out itself —
+    ``"hang"`` (the dispatch wrapper swaps in a sleeping stub) and
+    ``"torn-write"`` (checkpoint.save truncates the payload mid-write,
+    proving load-side corruption detection rejects the file) — or
+    returns None (no fault).
     """
     with _LOCK:
         if not _SPECS or _SUSPENDED:
@@ -221,8 +226,8 @@ def check(site: str) -> Optional[str]:
         return None
     if _tele._ENABLED:
         _tele.event(f"resilience.fault.{site}.{fired_kind}")
-    if fired_kind == "hang":
-        return "hang"
+    if fired_kind in ("hang", "torn-write"):
+        return fired_kind
     if fired_kind == "timeout":
         from .errors import DispatchTimeout
 

@@ -29,6 +29,15 @@ _PROGRAMS = _tele.ProgramCache("serve_batch",
                                cap_env="QRACK_SERVE_PROGRAM_CACHE_CAP",
                                default_cap=128)
 
+# optional warm-start hook: a checkpoint.warmstart.ProgramManifest that
+# records every compiled shape so the next process can prewarm it
+_MANIFEST = None
+
+
+def set_manifest(manifest) -> None:
+    global _MANIFEST
+    _MANIFEST = manifest
+
 
 def batch_program(circuit, n: int, batch: int):
     """The jitted (B, 2, 2^n) -> (B, 2, 2^n) program applying `circuit`
@@ -42,7 +51,10 @@ def batch_program(circuit, n: int, batch: int):
 
         return jax.jit(circuit.compile_batched_fn(n), donate_argnums=(0,))
 
-    return _PROGRAMS.get_or_build(key, build)
+    fn = _PROGRAMS.get_or_build(key, build)
+    if _MANIFEST is not None:
+        _MANIFEST.record(circuit, n, batch)
+    return fn
 
 
 def run_batch(jobs: List, engines: List):

@@ -82,6 +82,11 @@ class Executor:
     def _run(self, batch: List[Job]) -> None:
         for job in batch:
             job.handle._start()
+        # fault spilled sessions back in before their jobs touch engines
+        # (idle spill can land between queueing and execution)
+        for job in batch:
+            if job.session is not None and job.session.spilled:
+                self.sessions.ensure_resident(job.session)
         if batch[0].batchable:
             self._run_batched(batch)
         else:
@@ -212,6 +217,11 @@ class Executor:
     def _account(self, job: Job, ok: bool) -> None:
         if job.session is not None:
             job.session.end_job(ok)
+        wal_path = getattr(job, "wal_path", None)
+        if wal_path is not None and self.sessions.spill_store is not None:
+            # settled either way: a failed job must not replay at recovery
+            self.sessions.spill_store.wal_remove(wal_path)
+            job.wal_path = None
         if _tele._ENABLED:
             _tele.inc("serve.jobs.completed" if ok else "serve.jobs.failed")
             h = job.handle

@@ -95,7 +95,7 @@ class JobHandle:
 
 class Job:
     __slots__ = ("session", "kind", "circuit", "fn", "shape_key",
-                 "priority", "seq", "handle")
+                 "priority", "seq", "handle", "wal_path")
 
     def __init__(self, session: Optional[Session], kind: str, *,
                  circuit=None, fn: Optional[Callable] = None,
@@ -108,6 +108,7 @@ class Job:
         self.priority = priority
         self.seq = 0              # assigned by the scheduler
         self.handle = JobHandle(session.sid if session else "-", kind)
+        self.wal_path = None      # journal entry to settle (checkpointing)
 
     @property
     def batchable(self) -> bool:

@@ -18,10 +18,23 @@ blocks on a JobHandle.  Env knobs (constructor args override):
 * ``QRACK_SERVE_IDLE_EVICT_S``     idle-session eviction (default 0=off)
 * ``QRACK_SERVE_SYNC``             "devget" (default, honest completion)
                                    or "none"
+* ``QRACK_SERVE_CHECKPOINT_DIR``   enable the checkpoint subsystem
+                                   rooted at this directory (default
+                                   off): idle eviction spills instead
+                                   of discarding, submissions journal
+                                   to a WAL, compiled programs persist
+                                   for warm start (docs/CHECKPOINT.md)
+* ``QRACK_SERVE_SPILL_MAX_MB``     spill-store size bound (default 512)
+* ``QRACK_SERVE_RECOVER``          "1": replay the live-session
+                                   manifest + WAL from a crashed
+                                   process at startup
+* ``QRACK_SERVE_PREWARM``          "1": pre-trace recorded programs at
+                                   startup (warm time-to-first-result)
 
 See docs/SERVING.md for the architecture and the load-shedding
 semantics; serving is NOT imported by ``import qrack_tpu`` so the
-library path costs nothing when this subsystem is unused.
+library path costs nothing when this subsystem is unused — and the
+checkpoint package only loads when a checkpoint dir is configured.
 """
 
 from __future__ import annotations
@@ -52,6 +65,10 @@ class QrackService:
                  queue_budget_ms: Optional[float] = None,
                  idle_evict_s: Optional[float] = None,
                  tick_s: float = 0.25,
+                 checkpoint_dir: Optional[str] = None,
+                 spill_max_mb: Optional[float] = None,
+                 recover: Optional[bool] = None,
+                 prewarm: Optional[bool] = None,
                  **engine_kwargs):
         if max_depth is None:
             max_depth = int(_env_float("QRACK_SERVE_MAX_DEPTH", 64))
@@ -63,9 +80,35 @@ class QrackService:
             queue_budget_ms = _env_float("QRACK_SERVE_QUEUE_BUDGET_MS", 2000.0)
         if idle_evict_s is None:
             idle_evict_s = _env_float("QRACK_SERVE_IDLE_EVICT_S", 0.0)
+        if checkpoint_dir is None:
+            checkpoint_dir = os.environ.get(
+                "QRACK_SERVE_CHECKPOINT_DIR") or None
+        if recover is None:
+            recover = os.environ.get("QRACK_SERVE_RECOVER", "0") == "1"
+        if prewarm is None:
+            prewarm = os.environ.get("QRACK_SERVE_PREWARM", "0") == "1"
         self.default_layers = engine_layers
         self.default_engine_kwargs = engine_kwargs
-        self.sessions = SessionManager(idle_evict_s=idle_evict_s)
+        self.store = None
+        self.program_manifest = None
+        if checkpoint_dir:
+            # the only import of qrack_tpu.checkpoint on the serve path —
+            # the subsystem costs nothing unless a dir is configured
+            from ..checkpoint.store import CheckpointStore
+            from ..checkpoint.warmstart import (ProgramManifest,
+                                                enable_warm_start)
+            from . import batcher as _batcher_mod
+
+            if spill_max_mb is None:
+                spill_max_mb = _env_float("QRACK_SERVE_SPILL_MAX_MB", 512.0)
+            self.store = CheckpointStore(
+                checkpoint_dir, max_bytes=int(spill_max_mb * 1024 * 1024))
+            enable_warm_start(os.path.join(checkpoint_dir, "xla_cache"))
+            self.program_manifest = ProgramManifest(
+                os.path.join(checkpoint_dir, "programs"))
+            _batcher_mod.set_manifest(self.program_manifest)
+        self.sessions = SessionManager(idle_evict_s=idle_evict_s,
+                                       spill_store=self.store)
         self.scheduler = Scheduler(max_depth=max_depth,
                                    queue_budget_s=queue_budget_ms / 1e3,
                                    batch_window_s=batch_window_ms / 1e3,
@@ -75,6 +118,10 @@ class QrackService:
                                  tick_s=tick_s, sync=sync)
         self.executor.start()
         self._closed = False
+        if self.store is not None and recover:
+            self.recover()
+        if self.program_manifest is not None and prewarm:
+            self.prewarm()
 
     # -- session lifecycle ---------------------------------------------
 
@@ -109,11 +156,21 @@ class QrackService:
             shape_key = circuit.shape_key(sess.width)
         job = Job(sess, "circuit", circuit=circuit, shape_key=shape_key,
                   priority=priority)
+        if self.store is not None:
+            # journal BEFORE admission (the executor may settle the job
+            # the instant it is queued); the executor deletes the entry
+            # at completion, a refusal deletes it below — so entries
+            # still on disk at startup are exactly the crash-interrupted
+            # jobs recover() re-runs.
+            job.wal_path = self.store.wal_append(sid, circuit)
         sess.begin_job()
         try:
             return self.scheduler.submit(job)
         except BaseException:
             sess.end_job(ok=False)
+            if job.wal_path is not None:
+                self.store.wal_remove(job.wal_path)
+                job.wal_path = None
             raise
 
     def call(self, sid: str, fn: Callable, priority: int = 0) -> JobHandle:
@@ -154,15 +211,90 @@ class QrackService:
              timeout: Optional[float] = 120.0) -> float:
         return self.call(sid, lambda eng: eng.Prob(qubit)).result(timeout)
 
+    # -- checkpoint / recovery -----------------------------------------
+
+    def checkpoint_session(self, sid: str, timeout: float = 120.0) -> str:
+        """Persist `sid`'s full state (rng stream included) without
+        evicting it — capture is non-mutating, the session keeps
+        serving.  Returns the container path."""
+        if self.store is None:
+            raise RuntimeError("checkpointing is not enabled "
+                               "(QRACK_SERVE_CHECKPOINT_DIR)")
+        sess = self.sessions.get(sid)
+
+        def do():
+            if sess.spilled:  # already durable
+                return self.store._state_path(sid)
+            return self.store.save(sid, sess.engine)
+
+        job = Job(None, "admin", fn=do)
+        self.scheduler.submit(job)
+        return job.handle.result(timeout)
+
+    def checkpoint_all(self, timeout: float = 600.0) -> list:
+        """Persist every live session (one admin job: a consistent
+        point-in-time set, since the executor owns all dispatch)."""
+        return [self.checkpoint_session(sid, timeout=timeout)
+                for sid in self.sessions.ids()]
+
+    def recover(self, timeout: float = 600.0) -> dict:
+        """Rebuild the previous process's sessions from the store's
+        live-session manifest (under their original ids), load any
+        persisted state, and re-run crash-interrupted WAL jobs in
+        submit order.  Runs as one admin job on the dispatch owner."""
+        if self.store is None:
+            raise RuntimeError("checkpointing is not enabled "
+                               "(QRACK_SERVE_CHECKPOINT_DIR)")
+
+        def do():
+            recovered, replayed = [], 0
+            for sid, rec in sorted(self.store.sessions().items()):
+                kwargs = {**self.default_engine_kwargs,
+                          **rec.get("engine_kwargs", {})}
+                sess = self.sessions.create(
+                    rec["width"], layers=rec["layers"], seed=rec["seed"],
+                    sid=sid, **kwargs)
+                if self.store.has_state(sid):
+                    sess.engine = self.store.load(sid, into=sess.engine)
+                    self.store.drop_state(sid)
+                recovered.append(sid)
+            for sid, _seq, circuit in self.store.wal_entries():
+                try:
+                    sess = self.sessions.get(sid)
+                except SessionNotFound:
+                    continue
+                circuit.Run(sess.engine)
+                replayed += 1
+            self.store.clear_wal()
+            return {"sessions": recovered, "wal_replayed": replayed}
+
+        job = Job(None, "admin", fn=do)
+        self.scheduler.submit(job)
+        return job.handle.result(timeout)
+
+    def prewarm(self, timeout: float = 600.0) -> int:
+        """Pre-trace every program the manifest recorded (admin job —
+        compilation is device traffic).  With the persistent XLA cache
+        the compile is a disk read, so a recovered process reaches its
+        first result without paying cold compiles."""
+        if self.program_manifest is None:
+            return 0
+        job = Job(None, "admin", fn=self.program_manifest.prewarm)
+        self.scheduler.submit(job)
+        return job.handle.result(timeout)
+
     # -- introspection / lifecycle -------------------------------------
 
     def stats(self) -> dict:
-        return {
+        out = {
             "sessions": self.sessions.stats(),
             "queue_depth": self.scheduler.depth(),
             "breaker": _breaker.get_breaker().snapshot(),
             "batch_programs": _batch_stats(),
         }
+        if self.store is not None:
+            out["checkpoint_store"] = self.store.stats()
+        return out
 
     def close(self) -> None:
         if self._closed:

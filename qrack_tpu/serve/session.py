@@ -82,12 +82,14 @@ class Session:
     """One tenant's simulator plus scheduling bookkeeping."""
 
     def __init__(self, sid: str, width: int, layers, engine,
-                 seed: Optional[int]):
+                 seed: Optional[int], engine_kwargs: Optional[dict] = None):
         self.sid = sid
         self.width = width
         self.layers = layers
         self.engine = engine
         self.seed = seed
+        self.engine_kwargs = dict(engine_kwargs or {})  # restore recipe
+        self.spilled = False       # engine persisted to disk, not resident
         now = time.perf_counter()
         self.created_s = now
         self.last_used_s = now
@@ -95,6 +97,8 @@ class Session:
         self.jobs_completed = 0
         self.jobs_failed = 0
         self.failovers = 0
+        self.spills = 0
+        self.restores = 0
         self._lock = threading.Lock()
 
     def touch(self) -> None:
@@ -115,6 +119,8 @@ class Session:
                 self.jobs_failed += 1
 
     def touches_tunnel(self) -> bool:
+        if self.engine is None:
+            return False
         return engine_touches_tunnel(self.engine)
 
     def stats(self) -> dict:
@@ -122,40 +128,64 @@ class Session:
             "sid": self.sid,
             "width": self.width,
             "layers": self.layers,
-            "engine": type(planes_engine(self.engine)
-                           or getattr(self.engine, "engine", self.engine)
-                           ).__name__,
+            "engine": ("<spilled>" if self.engine is None else
+                       type(planes_engine(self.engine)
+                            or getattr(self.engine, "engine", self.engine)
+                            ).__name__),
             "idle_s": time.perf_counter() - self.last_used_s,
             "inflight": self.inflight,
             "jobs_completed": self.jobs_completed,
             "jobs_failed": self.jobs_failed,
             "failovers": self.failovers,
+            "spilled": self.spilled,
+            "spills": self.spills,
+            "restores": self.restores,
         }
 
 
 class SessionManager:
-    """Thread-safe registry: create / get / destroy / idle-evict."""
+    """Thread-safe registry: create / get / destroy / idle-evict.
 
-    def __init__(self, idle_evict_s: float = 0.0):
+    With a ``spill_store`` (checkpoint.CheckpointStore), idle eviction
+    SPILLS instead of discarding — the engine's full state lands on
+    disk and the session stays addressable; the executor faults it back
+    in (:meth:`ensure_resident`) when its next job runs.  The store's
+    live-session manifest doubles as the crash-recovery record."""
+
+    def __init__(self, idle_evict_s: float = 0.0, spill_store=None):
         self.idle_evict_s = idle_evict_s
+        self.spill_store = spill_store
         self._sessions: Dict[str, Session] = {}
         self._lock = threading.Lock()
         self._counter = 0
 
     def create(self, width: int, layers="tpu", seed: Optional[int] = None,
-               **engine_kwargs) -> Session:
+               sid: Optional[str] = None, **engine_kwargs) -> Session:
         """Build a session's engine (EXECUTOR THREAD ONLY — see module
         doc) and register it.  Each session gets its own QrackRandom so
         tenant measurement streams are independent and, when seeded,
-        exactly reproducible."""
+        exactly reproducible.  `sid` is only passed by crash recovery,
+        which must rebuild sessions under their original ids."""
         rng = QrackRandom(seed)
         engine = create_quantum_interface(layers, width, rng=rng,
                                           **engine_kwargs)
         with self._lock:
-            self._counter += 1
-            sid = f"s{self._counter:06d}"
-            sess = Session(sid, width, layers, engine, seed)
+            if sid is None:
+                self._counter += 1
+                sid = f"s{self._counter:06d}"
+            else:
+                # keep the counter ahead of recovered ids so new sessions
+                # never collide with them
+                try:
+                    self._counter = max(self._counter, int(sid.lstrip("s")))
+                except ValueError:
+                    pass
+            sess = Session(sid, width, layers, engine, seed,
+                           engine_kwargs=engine_kwargs)
             self._sessions[sid] = sess
+        if self.spill_store is not None:
+            self.spill_store.register(sid, width, layers, seed,
+                                      engine_kwargs)
         if _tele._ENABLED:
             _tele.inc("serve.session.created")
             _tele.event("serve.session.create", sid=sid, width=width,
@@ -175,27 +205,66 @@ class SessionManager:
             sess = self._sessions.pop(sid, None)
         if sess is None:
             raise SessionNotFound(sid)
+        if self.spill_store is not None:
+            self.spill_store.unregister(sid)
         if _tele._ENABLED:
             _tele.inc("serve.session.destroyed")
             _tele.gauge("serve.sessions.active", len(self._sessions))
 
     def evict_idle(self) -> List[str]:
-        """Drop sessions idle past the budget with nothing in flight.
-        Called from the executor's idle ticks so the engine teardown
-        happens on the dispatch-owner thread."""
+        """Spill (with a store) or drop sessions idle past the budget
+        with nothing in flight.  Called from the executor's idle ticks
+        so engine teardown/serialization happens on the dispatch-owner
+        thread."""
         if self.idle_evict_s <= 0:
             return []
         now = time.perf_counter()
         with self._lock:
-            dead = [sid for sid, s in self._sessions.items()
-                    if s.inflight == 0
+            idle = [s for s in self._sessions.values()
+                    if s.inflight == 0 and not s.spilled
                     and now - s.last_used_s > self.idle_evict_s]
-            for sid in dead:
-                del self._sessions[sid]
-        if dead and _tele._ENABLED:
-            _tele.inc("serve.session.evicted", len(dead))
+            if self.spill_store is None:
+                for s in idle:
+                    del self._sessions[s.sid]
+        evicted = []
+        for s in idle:
+            if self.spill_store is not None:
+                try:
+                    self.spill_store.save(s.sid, s.engine)
+                except Exception:  # noqa: BLE001 — spill failure = plain evict
+                    with self._lock:
+                        self._sessions.pop(s.sid, None)
+                else:
+                    s.engine = None
+                    s.spilled = True
+                    s.spills += 1
+            evicted.append(s.sid)
+        if evicted and _tele._ENABLED:
+            _tele.inc("serve.session.evicted", len(evicted))
+            if self.spill_store is not None:
+                _tele.inc("serve.session.spilled", len(evicted))
             _tele.gauge("serve.sessions.active", len(self._sessions))
-        return dead
+        return evicted
+
+    def ensure_resident(self, sess: Session) -> None:
+        """Fault a spilled session back in (EXECUTOR THREAD ONLY): build
+        a fresh stack through the same factory recipe and restore the
+        spilled state into it — rng stream position included, so the
+        tenant's measurement stream continues as if never evicted."""
+        if not sess.spilled:
+            return
+        if self.spill_store is None:
+            raise SessionNotFound(sess.sid)
+        engine = create_quantum_interface(
+            sess.layers, sess.width, rng=QrackRandom(sess.seed),
+            **sess.engine_kwargs)
+        sess.engine = self.spill_store.load(sess.sid, into=engine)
+        sess.spilled = False
+        sess.restores += 1
+        self.spill_store.drop_state(sess.sid)
+        if _tele._ENABLED:
+            _tele.inc("serve.session.restored")
+            _tele.event("serve.session.restore", sid=sess.sid)
 
     def ids(self) -> List[str]:
         with self._lock:

@@ -126,20 +126,46 @@ def dequantize_blocks_v1(scales: np.ndarray, codes: np.ndarray, n: int,
     return out
 
 
+LOSSY_KIND = "turboquant-lossy-ket"
+
+
+def _npz_path(path) -> str:
+    # np.savez_compressed appended .npz to bare paths; keep that naming
+    # so existing callers' paths stay valid across the container switch
+    return path if str(path).endswith(".npz") else str(path) + ".npz"
+
+
 def lossy_save(state: np.ndarray, path: str, bits: int = DEFAULT_BITS,
                block_pow: int = 12, seed: int = DEFAULT_SEED) -> None:
+    from ..checkpoint.container import save_container
+
     scales, codes, n = quantize_blocks(state, bits=bits,
                                        block_pow=block_pow, seed=seed)
-    np.savez_compressed(path, scales=scales, codes=codes, n=n, bits=bits,
-                        seed=seed)
+    # the payload keeps the pre-container member layout (scales/codes/
+    # n/bits/seed), so readers that predate the manifest still load
+    # these files as bare npz; the manifest adds checksums + versioning
+    save_container(_npz_path(path),
+                   {"scales": scales, "codes": codes,
+                    "n": np.asarray(n), "bits": np.asarray(bits),
+                    "seed": np.asarray(seed)},
+                   meta={"n": int(n), "bits": int(bits), "seed": int(seed)},
+                   kind=LOSSY_KIND)
 
 
 def lossy_load(path: str) -> np.ndarray:
-    with np.load(path if str(path).endswith(".npz") else str(path) + ".npz") as z:
-        if "seed" in z:
-            return dequantize_blocks(z["scales"], z["codes"], int(z["n"]),
-                                     int(z["bits"]), seed=int(z["seed"]))
-        # pre-rotation checkpoint format (round <=3): per-plane max-abs
-        # int codes with (2, B) scales, no decorrelating rotation
-        return dequantize_blocks_v1(z["scales"], z["codes"], int(z["n"]),
-                                    int(z["bits"]))
+    from ..checkpoint.container import load_container
+
+    # container files verify checksums here; bare legacy npz (kind None)
+    # loads unverified — both carry the same member layout
+    _, _, z = load_container(_npz_path(path), legacy_ok=True)
+
+    def scalar(key):  # container members are at-least-1-d
+        return int(np.ravel(z[key])[0])
+
+    if "seed" in z:
+        return dequantize_blocks(z["scales"], z["codes"], scalar("n"),
+                                 scalar("bits"), seed=scalar("seed"))
+    # pre-rotation checkpoint format (round <=3): per-plane max-abs
+    # int codes with (2, B) scales, no decorrelating rotation
+    return dequantize_blocks_v1(z["scales"], z["codes"], scalar("n"),
+                                scalar("bits"))

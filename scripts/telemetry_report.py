@@ -10,6 +10,8 @@ sum; spans merge).  Sections:
   * exchange traffic: pager/ICI event counts and bytes
   * serving: jobs admitted/shed/expired/completed, batch occupancy
     (batched jobs per dispatch), queue-depth / latency gauges
+  * checkpoint: save/restore counts + bytes, spill-store footprint,
+    warm-start programs recorded/prewarmed
   * layer events (qunit/stabilizer/qbdt/hybrid/factory escalations)
   * spans: count, total, mean
 
@@ -80,6 +82,7 @@ def report(snap: dict, top: int) -> dict:
         "compile": {},
         "exchange": {},
         "serve": {},
+        "checkpoint": {},
         "gauges": snap.get("gauges", {}),
         "layer_events": {},
         "spans": snap.get("spans", {}),
@@ -94,6 +97,8 @@ def report(snap: dict, top: int) -> dict:
             out["exchange"][k] = v
         elif k.startswith("serve."):
             out["serve"][k] = v
+        elif k.startswith("checkpoint."):
+            out["checkpoint"][k] = v
         elif k.split(".")[0] in ("qunit", "qunitmulti", "stabilizer",
                                  "qbdt", "hybrid", "factory", "engine",
                                  "cluster", "resilience"):
@@ -140,6 +145,11 @@ def main(argv=None) -> int:
         print("== serve ==")
         for name, v in sorted(rep["serve"].items()):
             print(f"  {name:<40s} {v:>12.3f}")
+    if rep["checkpoint"]:
+        print("== checkpoint ==")
+        for name, v in sorted(rep["checkpoint"].items()):
+            shown = _fmt_bytes(v) if name.endswith("bytes") else f"{v:.0f}"
+            print(f"  {name:<40s} {shown:>12s}")
     if rep["gauges"]:
         print("== gauges ==")
         for name, v in sorted(rep["gauges"].items()):

@@ -1,0 +1,112 @@
+"""Subprocess phases for tests/test_checkpoint.py's serve-level tests.
+
+Three entry points (argv[1]):
+
+* ``spill <ckdir> <out.npy>`` — run c1, wait for idle eviction to spill
+  the session, run c2 (transparent restore), dump the final state.
+* ``crash <ckdir>`` — run c1, checkpoint, journal c2 as a WAL entry the
+  way submit() would, then die via os._exit: no close(), no atexit —
+  exactly the on-disk state a hard crash leaves behind.
+* ``recover <ckdir> <out.npy>`` — start with recover=True, assert the
+  session came back under its original id, dump its state.
+
+Kept out of test collection (leading underscore); the oracle the parent
+test compares against lives in test_checkpoint.py.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def circuits(width):
+    from qrack_tpu import matrices as mat
+    from qrack_tpu.layers.qcircuit import QCircuit, QCircuitGate
+
+    t_gate = np.diag([1.0, np.exp(1j * np.pi / 4)])
+    s_gate = np.diag([1.0, 1j])
+    c1 = QCircuit(width)
+    for q in range(width):
+        c1.AppendGate(QCircuitGate.single(q, mat.H2))
+    for q in range(width - 1):
+        c1.AppendGate(QCircuitGate.controlled([q], q + 1, mat.X2, 1))
+    c1.AppendGate(QCircuitGate.single(0, t_gate))
+    c2 = QCircuit(width)
+    c2.AppendGate(QCircuitGate.single(1, s_gate))
+    c2.AppendGate(QCircuitGate.single(2, mat.H2))
+    c2.AppendGate(QCircuitGate.controlled([0], width - 1, mat.X2, 1))
+    c2.AppendGate(QCircuitGate.single(3, t_gate))
+    return c1, c2
+
+
+W = 6
+SEED = 7
+
+
+def phase_spill(ckdir: str, out: str) -> None:
+    import time
+
+    from qrack_tpu.serve import QrackService
+
+    c1, c2 = circuits(W)
+    with QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                      idle_evict_s=0.2, tick_s=0.02,
+                      batch_window_ms=2.0) as svc:
+        sid = svc.create_session(W, seed=SEED, rand_global_phase=False)
+        svc.apply(sid, c1)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = [s for s in svc.stats()["sessions"] if s["sid"] == sid][0]
+            if st["spilled"]:
+                break
+            time.sleep(0.05)
+        else:
+            print("session never spilled")
+            sys.exit(1)
+        assert svc.stats()["checkpoint_store"]["spilled"] == 1
+        svc.apply(sid, c2)  # faults the session back in transparently
+        st = [s for s in svc.stats()["sessions"] if s["sid"] == sid][0]
+        assert st["restores"] == 1, st
+        np.save(out, np.asarray(svc.get_state(sid)))
+
+
+def phase_crash(ckdir: str) -> None:
+    from qrack_tpu.serve import QrackService
+
+    c1, c2 = circuits(W)
+    svc = QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                       tick_s=0.02, batch_window_ms=2.0)
+    sid = svc.create_session(W, seed=SEED, rand_global_phase=False)
+    assert sid == "s000001", sid
+    svc.apply(sid, c1)
+    svc.checkpoint_session(sid)
+    # journal c2 exactly as submit() would, then crash before it runs
+    svc.store.wal_append(sid, c2)
+    os._exit(0)
+
+
+def phase_recover(ckdir: str, out: str) -> None:
+    from qrack_tpu.serve import QrackService
+
+    with QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                      recover=True, prewarm=True, tick_s=0.02,
+                      batch_window_ms=2.0) as svc:
+        sids = [s["sid"] for s in svc.stats()["sessions"]]
+        assert sids == ["s000001"], sids
+        np.save(out, np.asarray(svc.get_state("s000001")))
+        # new sessions must not collide with recovered ids
+        sid2 = svc.create_session(W, seed=1)
+        assert sid2 == "s000002", sid2
+        svc.destroy_session(sid2)  # keep the manifest single-session
+
+
+if __name__ == "__main__":
+    if sys.argv[1] == "spill":
+        phase_spill(sys.argv[2], sys.argv[3])
+    elif sys.argv[1] == "crash":
+        phase_crash(sys.argv[2])
+    elif sys.argv[1] == "recover":
+        phase_recover(sys.argv[2], sys.argv[3])
+    else:
+        sys.exit(f"unknown phase {sys.argv[1]!r}")
