@@ -17,9 +17,12 @@ idle eviction or an outright crash:
   created, not just when it is spilled; QrackService(recover=True)
   replays it into a fresh process and re-runs any journaled jobs.
 * **Bounded** — ``max_bytes`` caps the on-disk footprint; oldest
-  spilled state evicts first (the session itself survives — it just
-  loses its warm restore and recovery re-creates it cold).  The current
-  footprint is exported as the ``checkpoint.store.bytes`` gauge.
+  state files evict first, EXCEPT those of currently-spilled live
+  sessions (``protected_sids``, wired by SessionManager): deleting one
+  of those would strand a session that can no longer be faulted back
+  in.  Checkpoint snapshots of resident sessions are fair game — the
+  live engine still holds the state.  The current footprint is
+  exported as the ``checkpoint.store.bytes`` gauge.
 
 All mutation happens on the serve executor thread (the same
 single-owner discipline as every other engine touch), so the store
@@ -32,7 +35,7 @@ import json
 import os
 import tempfile
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -105,6 +108,10 @@ class CheckpointStore:
     def __init__(self, root: str, max_bytes: int = 512 * 1024 * 1024):
         self.root = str(root)
         self.max_bytes = int(max_bytes)
+        # liveness callback: sids whose state files the budget evictor
+        # must never touch (live spilled sessions — SessionManager wires
+        # this); None means nothing is protected beyond the fresh write
+        self.protected_sids: Optional[Callable[[], Iterable[str]]] = None
         self._sessions_dir = os.path.join(self.root, "sessions")
         self._wal_dir = os.path.join(self.root, "wal")
         os.makedirs(self._sessions_dir, exist_ok=True)
@@ -159,8 +166,31 @@ class CheckpointStore:
             "layers": layers if isinstance(layers, str) else list(layers),
             "seed": None if seed is None else int(seed),
             "engine_kwargs": _json_safe(engine_kwargs or {}),
+            # True once the session's state has advanced beyond what the
+            # on-disk snapshot (or a fresh |0..0>) captures — recovery
+            # must not replay WAL jobs onto a base that is wrong
+            "dirty": False,
         }
         self._write_manifest()
+
+    def mark_dirty(self, sid: str) -> None:
+        """Record that `sid`'s live state is no longer captured on disk
+        (a job completed, or its snapshot was consumed).  No-op when
+        already dirty, so the per-job cost is one dict probe."""
+        rec = self._manifest["sessions"].get(sid)
+        if rec is not None and not rec.get("dirty", False):
+            rec["dirty"] = True
+            self._write_manifest()
+
+    def _mark_clean(self, sid: str) -> None:
+        rec = self._manifest["sessions"].get(sid)
+        if rec is not None and rec.get("dirty", True):
+            rec["dirty"] = False
+            self._write_manifest()
+
+    def is_dirty(self, sid: str) -> bool:
+        rec = self._manifest["sessions"].get(sid)
+        return bool(rec.get("dirty", False)) if rec else False
 
     def unregister(self, sid: str) -> None:
         if self._manifest["sessions"].pop(sid, None) is not None:
@@ -187,6 +217,7 @@ class CheckpointStore:
         checkpoint — the caller decides whether to drop residency)."""
         path = self._state_path(sid)
         save_state(engine, path)
+        self._mark_clean(sid)  # disk now captures the state exactly
         self._enforce_budget(protect=path)
         self._update_gauge()
         return path
@@ -203,16 +234,20 @@ class CheckpointStore:
         self._update_gauge()
 
     def _enforce_budget(self, protect: Optional[str] = None) -> List[str]:
-        """Evict oldest spilled state files until under max_bytes; the
-        just-written file is protected so a single oversized session
-        cannot evict itself into a lost update."""
+        """Evict oldest state files until under max_bytes.  Protected:
+        the just-written file (a single oversized session must not evict
+        itself into a lost update) and every live spilled session's
+        state (the only copy of that session — deleting it would make
+        its next restore fail for the life of the process)."""
         if self.max_bytes <= 0:
             return []
+        live = set(self.protected_sids()) if self.protected_sids else set()
         evicted = []
         while self.total_bytes() > self.max_bytes:
             victims = sorted(
                 (os.path.getmtime(p), p) for p in self._state_files()
-                if p != protect)
+                if p != protect
+                and os.path.basename(p)[:-len(".qckpt")] not in live)
             if not victims:
                 break
             _, path = victims[0]

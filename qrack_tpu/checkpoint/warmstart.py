@@ -75,10 +75,13 @@ class ProgramManifest:
     def record(self, circuit, n: int, batch: int) -> None:
         """Idempotent: a known (shape, batch) is a no-op, so the hot
         batcher path costs one dict probe."""
-        key = self._key(circuit.shape_key(n), batch)
+        shape = circuit.shape_key(n)
+        key = self._key(shape, batch)
         if key in self._index:
             return
-        digest = key.rsplit(":", 1)[0].split(":", 2)[-1]
+        # circuit files are keyed by the structure digest alone: the
+        # same circuit served at several widths/batches is stored once
+        digest = shape[2]
         path = os.path.join(self.root, f"{digest}.qckpt")
         if not os.path.exists(path):
             save_circuit(path, circuit)

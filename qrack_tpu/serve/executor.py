@@ -217,6 +217,12 @@ class Executor:
     def _account(self, job: Job, ok: bool) -> None:
         if job.session is not None:
             job.session.end_job(ok)
+            if ok and self.sessions.spill_store is not None:
+                # the session's live state has advanced past whatever is
+                # (or isn't) on disk; recovery keys off this flag to
+                # refuse WAL replay onto a wrong base (no-op when
+                # already dirty, so the steady-state cost is a probe)
+                self.sessions.spill_store.mark_dirty(job.session.sid)
         wal_path = getattr(job, "wal_path", None)
         if wal_path is not None and self.sessions.spill_store is not None:
             # settled either way: a failed job must not replay at recovery

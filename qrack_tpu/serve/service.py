@@ -232,23 +232,52 @@ class QrackService:
         return job.handle.result(timeout)
 
     def checkpoint_all(self, timeout: float = 600.0) -> list:
-        """Persist every live session (one admin job: a consistent
-        point-in-time set, since the executor owns all dispatch)."""
-        return [self.checkpoint_session(sid, timeout=timeout)
-                for sid in self.sessions.ids()]
-
-    def recover(self, timeout: float = 600.0) -> dict:
-        """Rebuild the previous process's sessions from the store's
-        live-session manifest (under their original ids), load any
-        persisted state, and re-run crash-interrupted WAL jobs in
-        submit order.  Runs as one admin job on the dispatch owner."""
+        """Persist every live session as ONE admin job, so no tenant job
+        interleaves between snapshots: the set is a consistent
+        point-in-time cut (the executor owns all dispatch)."""
         if self.store is None:
             raise RuntimeError("checkpointing is not enabled "
                                "(QRACK_SERVE_CHECKPOINT_DIR)")
 
         def do():
-            recovered, replayed = [], 0
+            paths = []
+            for sid in self.sessions.ids():
+                sess = self.sessions.get(sid)
+                if sess.spilled:  # already durable
+                    paths.append(self.store._state_path(sid))
+                else:
+                    paths.append(self.store.save(sid, sess.engine))
+            return paths
+
+        job = Job(None, "admin", fn=do)
+        self.scheduler.submit(job)
+        return job.handle.result(timeout)
+
+    def recover(self, timeout: float = 600.0) -> dict:
+        """Rebuild the previous process's sessions from the store's
+        live-session manifest (under their original ids), load any
+        persisted state, and re-run crash-interrupted WAL jobs in
+        submit order.  Runs as one admin job on the dispatch owner.
+
+        WAL replay is only exact when the rebuilt base provably matches
+        the state the job was submitted against: either the on-disk
+        snapshot captures everything the session completed (manifest
+        ``dirty`` flag clear), or the session never completed a job
+        (fresh |0..0> IS the base).  A session whose completed work was
+        never persisted is rebuilt cold with its WAL entries dropped and
+        its sid reported under ``recovered_stale`` so the caller can
+        reset or notify the tenant instead of silently serving a state
+        that matches neither pre-crash nor fresh."""
+        if self.store is None:
+            raise RuntimeError("checkpointing is not enabled "
+                               "(QRACK_SERVE_CHECKPOINT_DIR)")
+
+        def do():
+            recovered, stale, replayed, skipped = [], [], 0, 0
+            # snapshot the manifest first: re-creating a session below
+            # re-registers it, which resets its dirty flag
             for sid, rec in sorted(self.store.sessions().items()):
+                dirty = bool(rec.get("dirty", False))
                 kwargs = {**self.default_engine_kwargs,
                           **rec.get("engine_kwargs", {})}
                 sess = self.sessions.create(
@@ -257,16 +286,28 @@ class QrackService:
                 if self.store.has_state(sid):
                     sess.engine = self.store.load(sid, into=sess.engine)
                     self.store.drop_state(sid)
+                    # the disk copy was just consumed; the restored
+                    # state now lives only in memory
+                    self.store.mark_dirty(sid)
+                if dirty:
+                    stale.append(sid)
+                    self.store.mark_dirty(sid)
                 recovered.append(sid)
+            stale_set = set(stale)
             for sid, _seq, circuit in self.store.wal_entries():
                 try:
                     sess = self.sessions.get(sid)
                 except SessionNotFound:
                     continue
+                if sid in stale_set:
+                    skipped += 1  # base is wrong — replay would be too
+                    continue
                 circuit.Run(sess.engine)
+                self.store.mark_dirty(sid)
                 replayed += 1
             self.store.clear_wal()
-            return {"sessions": recovered, "wal_replayed": replayed}
+            return {"sessions": recovered, "wal_replayed": replayed,
+                    "wal_skipped": skipped, "recovered_stale": stale}
 
         job = Job(None, "admin", fn=do)
         self.scheduler.submit(job)

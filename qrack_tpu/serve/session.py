@@ -158,6 +158,14 @@ class SessionManager:
         self._sessions: Dict[str, Session] = {}
         self._lock = threading.Lock()
         self._counter = 0
+        if spill_store is not None:
+            # the store's budget evictor must never delete the only copy
+            # of a live spilled session's state
+            spill_store.protected_sids = self._spilled_sids
+
+    def _spilled_sids(self) -> List[str]:
+        with self._lock:
+            return [s.sid for s in self._sessions.values() if s.spilled]
 
     def create(self, width: int, layers="tpu", seed: Optional[int] = None,
                sid: Optional[str] = None, **engine_kwargs) -> Session:
@@ -227,6 +235,7 @@ class SessionManager:
                 for s in idle:
                     del self._sessions[s.sid]
         evicted = []
+        spilled = 0
         for s in idle:
             if self.spill_store is not None:
                 try:
@@ -238,11 +247,12 @@ class SessionManager:
                     s.engine = None
                     s.spilled = True
                     s.spills += 1
+                    spilled += 1
             evicted.append(s.sid)
         if evicted and _tele._ENABLED:
             _tele.inc("serve.session.evicted", len(evicted))
-            if self.spill_store is not None:
-                _tele.inc("serve.session.spilled", len(evicted))
+            if spilled:  # failed spills were plain evictions, not spills
+                _tele.inc("serve.session.spilled", spilled)
             _tele.gauge("serve.sessions.active", len(self._sessions))
         return evicted
 
@@ -255,13 +265,30 @@ class SessionManager:
             return
         if self.spill_store is None:
             raise SessionNotFound(sess.sid)
+        from ..checkpoint.container import CheckpointError
+
         engine = create_quantum_interface(
             sess.layers, sess.width, rng=QrackRandom(sess.seed),
             **sess.engine_kwargs)
-        sess.engine = self.spill_store.load(sess.sid, into=engine)
+        try:
+            sess.engine = self.spill_store.load(sess.sid, into=engine)
+        except CheckpointError:
+            # spill file missing or corrupt (e.g. another process
+            # sharing the store evicted it): keep the fresh cold engine
+            # so the session survives instead of failing every future
+            # job, and say so loudly in telemetry
+            sess.engine = engine
+            sess.spilled = False
+            if _tele._ENABLED:
+                _tele.inc("serve.session.restore_lost")
+                _tele.event("serve.session.restore_lost", sid=sess.sid)
+            return
         sess.spilled = False
         sess.restores += 1
         self.spill_store.drop_state(sess.sid)
+        # the disk copy is gone; the live state it held is now only in
+        # memory, so recovery must not treat this session as clean
+        self.spill_store.mark_dirty(sess.sid)
         if _tele._ENABLED:
             _tele.inc("serve.session.restored")
             _tele.event("serve.session.restore", sid=sess.sid)

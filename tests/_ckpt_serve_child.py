@@ -9,6 +9,10 @@ Three entry points (argv[1]):
   exactly the on-disk state a hard crash leaves behind.
 * ``recover <ckdir> <out.npy>`` — start with recover=True, assert the
   session came back under its original id, dump its state.
+* ``stale <ckdir>`` — run c1 (completed but never checkpointed),
+  journal c2, die: recovery has no base matching pre-crash state.
+* ``recover-stale <ckdir> <out.npy>`` — recover, print the result dict
+  as JSON on the last stdout line, dump the (cold) session state.
 
 Kept out of test collection (leading underscore); the oracle the parent
 test compares against lives in test_checkpoint.py.
@@ -86,6 +90,33 @@ def phase_crash(ckdir: str) -> None:
     os._exit(0)
 
 
+def phase_stale(ckdir: str) -> None:
+    from qrack_tpu.serve import QrackService
+
+    c1, c2 = circuits(W)
+    svc = QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                       tick_s=0.02, batch_window_ms=2.0)
+    sid = svc.create_session(W, seed=SEED, rand_global_phase=False)
+    svc.apply(sid, c1)
+    # a follow-up read guarantees c1's completion accounting (the dirty
+    # flag write) landed before we crash — the executor is serial
+    svc.get_state(sid)
+    svc.store.wal_append(sid, c2)
+    os._exit(0)
+
+
+def phase_recover_stale(ckdir: str, out: str) -> None:
+    import json
+
+    from qrack_tpu.serve import QrackService
+
+    with QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                      tick_s=0.02, batch_window_ms=2.0) as svc:
+        res = svc.recover()
+        np.save(out, np.asarray(svc.get_state("s000001")))
+        print(json.dumps(res))
+
+
 def phase_recover(ckdir: str, out: str) -> None:
     from qrack_tpu.serve import QrackService
 
@@ -108,5 +139,9 @@ if __name__ == "__main__":
         phase_crash(sys.argv[2])
     elif sys.argv[1] == "recover":
         phase_recover(sys.argv[2], sys.argv[3])
+    elif sys.argv[1] == "stale":
+        phase_stale(sys.argv[2])
+    elif sys.argv[1] == "recover-stale":
+        phase_recover_stale(sys.argv[2], sys.argv[3])
     else:
         sys.exit(f"unknown phase {sys.argv[1]!r}")

@@ -336,6 +336,42 @@ def test_store_spill_budget_evicts_oldest(tmp_path):
     assert store.has_state("s2")
 
 
+def test_store_budget_never_evicts_live_spilled(tmp_path):
+    """The budget evictor must not delete the ONLY copy of a live
+    spilled session — that would strand the session unrestorable for
+    the life of the process."""
+    from qrack_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "store"), max_bytes=1)
+    store.protected_sids = lambda: ["s1"]  # s1 is live and spilled
+    store.save("s1", QEngineCPU(4, rng=QrackRandom(1)))
+    time.sleep(0.05)
+    store.save("s2", QEngineCPU(4, rng=QrackRandom(2)))
+    # s1 is the oldest but protected; s2 is the fresh write
+    assert store.has_state("s1") and store.has_state("s2")
+    time.sleep(0.05)
+    store.save("s3", QEngineCPU(4, rng=QrackRandom(3)))
+    # the oldest UNPROTECTED file (s2) is the victim
+    assert store.has_state("s1") and store.has_state("s3")
+    assert not store.has_state("s2")
+
+
+def test_store_dirty_flag_lifecycle(tmp_path):
+    from qrack_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "store"))
+    store.register("s1", 4, "cpu", 1)
+    assert not store.is_dirty("s1")
+    store.mark_dirty("s1")
+    assert store.is_dirty("s1")
+    store.mark_dirty("unknown")  # unregistered sid: no-op, no crash
+    store.save("s1", QEngineCPU(4, rng=QrackRandom(1)))
+    assert not store.is_dirty("s1")  # disk captures the state again
+    # the flag survives a manifest re-read (it is what recovery sees)
+    store.mark_dirty("s1")
+    assert CheckpointStore(store.root).is_dirty("s1")
+
+
 def test_store_wal_round_trip_and_damage_skip(tmp_path):
     from qrack_tpu.checkpoint.store import CheckpointStore
     from qrack_tpu.layers.qcircuit import QCircuit, QCircuitGate
@@ -360,6 +396,50 @@ def test_store_wal_round_trip_and_damage_skip(tmp_path):
                           np.asarray(eng_b.GetQuantumState()))
     store.wal_remove(p1)
     assert store.wal_entries() == []
+
+
+# ---------------------------------------------------------------------------
+# warm start: ProgramManifest round-trips every recorded shape
+# ---------------------------------------------------------------------------
+
+def _manifest_circuit(n):
+    from qrack_tpu import matrices as mat
+    from qrack_tpu.layers.qcircuit import QCircuit, QCircuitGate
+
+    c = QCircuit(n)
+    for q in range(n):
+        c.AppendGate(QCircuitGate.single(q, mat.H2))
+    c.AppendGate(QCircuitGate.controlled([0], n - 1, mat.X2, 1))
+    return c
+
+
+def test_program_manifest_multi_shape_prewarm(tmp_path):
+    """Every recorded (width, batch) must map to ITS circuit — the
+    regression was the digest parse returning the batch size, so all
+    programs with one batch size collapsed onto one circuit file and
+    prewarm warmed the wrong (or an impossible) program."""
+    from qrack_tpu.checkpoint.store import load_circuit
+    from qrack_tpu.checkpoint.warmstart import ProgramManifest
+
+    root = str(tmp_path / "programs")
+    m = ProgramManifest(root)
+    shapes = [(4, 2), (4, 3), (5, 2), (6, 2)]  # shared batch sizes
+    for n, batch in shapes:
+        m.record(_manifest_circuit(n), n, batch)
+        m.record(_manifest_circuit(n), n, batch)  # idempotent
+    assert len(m) == len(shapes)
+    for key, rec in m._index.items():
+        digest = key.rsplit(":", 1)[1]
+        assert rec["circuit"] == f"{digest}.qckpt"
+        circ, _ = load_circuit(os.path.join(root, rec["circuit"]))
+        # the stored circuit really is the one the key describes
+        assert circ.shape_key(rec["width"])[2] == digest
+    # one file per distinct circuit: (4,2) and (4,3) share one
+    stored = [f for f in os.listdir(root) if f.endswith(".qckpt")]
+    assert len(stored) == 3
+    # a fresh process view re-traces every shape without error
+    m2 = ProgramManifest(root)
+    assert m2.prewarm() == len(shapes)
 
 
 # ---------------------------------------------------------------------------
@@ -405,6 +485,24 @@ def test_serve_kill_and_recover(tmp_path):
     assert os.listdir(os.path.join(ck, "wal"))
     _serve_phase(["recover", ck, out], tmp_path)
     assert np.array_equal(np.load(out), _serve_oracle(6, 7))
+
+
+def test_recover_refuses_wal_on_unpersisted_base(tmp_path):
+    """A session whose completed work was never persisted has no
+    recoverable base: recovery must rebuild it cold, DROP its WAL entry
+    (replaying onto the wrong base would yield a state matching neither
+    pre-crash nor fresh), and report the sid so callers can reset it."""
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "state.npy")
+    _serve_phase(["stale", ck], tmp_path)
+    stdout = _serve_phase(["recover-stale", ck, out], tmp_path)
+    res = json.loads(stdout.strip().splitlines()[-1])
+    assert res["sessions"] == ["s000001"]
+    assert res["recovered_stale"] == ["s000001"]
+    assert res["wal_replayed"] == 0 and res["wal_skipped"] == 1
+    fresh = np.zeros(1 << 6, dtype=np.complex128)
+    fresh[0] = 1.0  # cold = |0..0>, not a half-replayed hybrid
+    assert np.array_equal(np.load(out), fresh)
 
 
 @pytest.mark.slow
