@@ -78,6 +78,40 @@ def _bench_dtype():
     return jnp.bfloat16 if DTYPE == "bfloat16" else jnp.float32
 
 
+def _qft_form(width: int) -> str:
+    """Which QFT program form this run measures.  QRACK_BENCH_QFT_FORM
+    pins it (fused|unrolled|fast); otherwise the model's platform-aware
+    default applies (see qft.default_fast)."""
+    form = os.environ.get("QRACK_BENCH_QFT_FORM", "")
+    if form:
+        if form not in ("fused", "unrolled", "fast"):
+            raise ValueError(f"unknown QRACK_BENCH_QFT_FORM {form!r}")
+        return form
+    from qrack_tpu.models import qft as qftm
+
+    return "fast" if qftm.default_fast(width) else "unrolled"
+
+
+def _make_fused_qft_fn(width: int, dtype):
+    """The gate-stream fuser's own window program over the whole QFT:
+    qft_qcircuit -> neighbor-merged ops -> ONE structure-keyed compiled
+    program taking every rotation as a runtime operand (constant-free;
+    qrack_tpu/ops/fusion.py).  This is literally what the engine fuser
+    dispatches, so its wall-clock is the fused-path headline."""
+    from qrack_tpu.models import qft as qftm
+    from qrack_tpu.ops import fusion as fu
+
+    ops = fu.lower_gates(qftm.qft_qcircuit(width).gates)
+    prog = fu.dense_window_program(width, fu.structure_of(ops), dtype)
+    operands = fu.dense_operands(ops, dtype)
+
+    def fn(planes):
+        return prog(planes, *operands)
+
+    fn.already_compiled = True  # _measure must not re-wrap in jax.jit
+    return fn
+
+
 def _make_fn(width: int):
     from qrack_tpu.models import qft as qftm
 
@@ -97,8 +131,13 @@ def _make_fn(width: int):
         # test/benchmarks.cpp:542-568)
         fn, _ = grm.make_grover_fn(width, 3)
         return fn, qftm.basis_planes(width, 0, dtype=dt)
-    return (qftm.make_qft_fn(width),
-            qftm.basis_planes(width, 12345 & ((1 << width) - 1), dtype=dt))
+    perm = 12345 & ((1 << width) - 1)
+    form = _qft_form(width)
+    if form == "fused":
+        return (_make_fused_qft_fn(width, dt),
+                qftm.basis_planes(width, perm, dtype=dt))
+    return (qftm.make_qft_fn(width, fast=(form == "fast")),
+            qftm.basis_planes(width, perm, dtype=dt))
 
 
 def _xeb_from_planes(planes, width: int, shots: int = 2000) -> float:
@@ -186,7 +225,10 @@ def _measure(width: int, samples: int):
         "QRACK_BENCH_CHAIN", "1" if sync_mode == "block" else "4"))
 
     body, planes = _make_fn(width)
-    fn = jax.jit(body, donate_argnums=(0,))
+    if getattr(body, "already_compiled", False):
+        fn = body  # fused window program: jitted with donation already
+    else:
+        fn = jax.jit(body, donate_argnums=(0,))
     planes = fn(planes)
     sync_s = 0.0
     if sync_mode == "devget":
@@ -222,13 +264,13 @@ def _measure(width: int, samples: int):
         st["sync_overhead_s"] = round(sync_s, 6)
     if WORKLOAD == "qft":
         # the sweep silently switches program forms at FAST_COMPILE_QB
-        # (accelerators only); record which one this width ran so
-        # scaling curves attribute any discontinuity to the form
-        # change, not the hardware
-        from qrack_tpu.models import qft as qftm
-
-        st["qft_form"] = ("fast" if qftm.default_fast(width)
-                          else "unrolled")
+        # (accelerators only) and QRACK_BENCH_QFT_FORM pins the fused
+        # window form; record which one this width ran so scaling curves
+        # attribute any discontinuity to the form change, not the
+        # hardware ("fused" = the gate-stream fuser's parametric
+        # window program, fusion ON; "unrolled"/"fast" = per-stage
+        # traced circuits, the pre-fusion forms)
+        st["qft_form"] = _qft_form(width)
     if WORKLOAD == "xeb":
         st["xeb_fidelity"] = round(_xeb_from_planes(planes, width), 6)
     return st
@@ -331,7 +373,7 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
 
 
 def _run_child(width: int, samples: int, timeout_s: float, platform: str = "",
-               workload: str = ""):
+               workload: str = "", extra_env: dict | None = None):
     """Measure in a watchdogged subprocess (the TPU tunnel can wedge)."""
     import subprocess
 
@@ -341,6 +383,8 @@ def _run_child(width: int, samples: int, timeout_s: float, platform: str = "",
                QRACK_BENCH_SAMPLES=str(samples))
     if workload:
         env["QRACK_BENCH"] = workload
+    if extra_env:
+        env.update(extra_env)
     if platform:
         env["QRACK_BENCH_PLATFORM"] = platform
         if platform == "cpu":
@@ -358,6 +402,17 @@ def _run_child(width: int, samples: int, timeout_s: float, platform: str = "",
                              capture_output=True, text=True,
                              timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
+        # fail-soft: a lost child must still leave a parseable record
+        # (BENCH_r05 lost BOTH default-platform lines to 420s/332s
+        # timeouts with nothing emitted) — never a measurement, so the
+        # metric name can't masquerade as a wall-clock line
+        print(json.dumps({
+            "metric": (f"{workload or _workload_key()}_w{width}"
+                       f"_{platform or 'default'}_timed_out"),
+            "timed_out": True,
+            "timeout_s": round(timeout_s, 1),
+            "samples_requested": samples,
+        }), flush=True)
         print(f"bench child (w={width}, plat={platform or 'default'}) "
               f"timed out after {timeout_s:.0f}s", file=sys.stderr)
         return None
@@ -454,11 +509,29 @@ def main() -> None:
     #    healthy window is too precious for a known-good CPU rerun.)
     if not tpu_only:
         fb_width = min(WIDTH, 22)
+        # qft headline rides the gate-stream fuser's parametric window
+        # program (qft_form: fused) unless the operator pinned a form;
+        # a second child at the SAME width/sync records the pre-fusion
+        # unrolled form so the fusion-on/off A/B lives in one output
+        ab = (WORKLOAD == "qft"
+              and not os.environ.get("QRACK_BENCH_QFT_FORM"))
         st = _run_child(fb_width, min(SAMPLES, 3),
-                        min(180.0, _remaining() - 20), platform="cpu")
+                        min(180.0, _remaining() - 20), platform="cpu",
+                        extra_env=({"QRACK_BENCH_QFT_FORM": "fused"}
+                                   if ab else None))
         if st:
             _emit(fb_width, st, label_suffix="_cpu_xla_fallback")
             emitted = True
+        if ab:
+            st_off = _run_child(fb_width, min(SAMPLES, 3),
+                                min(180.0, _remaining() - 20),
+                                platform="cpu",
+                                extra_env={"QRACK_BENCH_QFT_FORM":
+                                           "unrolled"})
+            if st_off:
+                _emit(fb_width, st_off,
+                      label_suffix="_cpu_xla_fallback_fuse_off")
+                emitted = True
 
         # 1a) Second CPU anchor on the OTHER reference headline workload
         #     (nearest-neighbour RCS, test_random_circuit_sampling_nn):

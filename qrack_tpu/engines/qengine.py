@@ -63,6 +63,18 @@ class QEngine(QInterface):
     # engine label in telemetry counter names (gate.<label>.<kind>.w<n>)
     _tele_name = "engine"
 
+    # lazy gate-stream fusion (ops/fusion.py): engines that can lower a
+    # pending gate window into one parametric program set _fuse_capable
+    # and install a GateStreamFuser in __init__; the base class stays
+    # eager (the CPU oracle must dispatch gate-at-a-time so fused stacks
+    # can be differenced against it)
+    _fuser = None
+    _fuse_capable = False
+
+    def _fuse_tick(self) -> None:
+        """Per-logical-gate hook from GateStreamFuser.queue (drift
+        accounting on the dense TPU engine; no-op elsewhere)."""
+
     # ------------------------------------------------------------------
     # gate primitive dispatch
     # ------------------------------------------------------------------
@@ -74,13 +86,21 @@ class QEngine(QInterface):
         m = np.asarray(mtrx, dtype=np.complex128).reshape(2, 2)
         if mat.is_identity(m) and abs(m[0, 0] - 1.0) <= 1e-14:
             return
+        # gate.* counters record logical gates REQUESTED; the fused path
+        # accounts its (fewer) physical sweeps under fuse.*/compile.fuse
         if mat.is_phase(m):
             if _tele._ENABLED:
                 _tele.inc(f"gate.{self._tele_name}.diag.w{self.qubit_count}")
+            fuser = self._fuser
+            if fuser is not None and fuser.queue(tuple(controls), m, target, perm):
+                return
             self._k_apply_diag(m[0, 0], m[1, 1], target, tuple(controls), perm)
         else:
             if _tele._ENABLED:
                 _tele.inc(f"gate.{self._tele_name}.2x2.w{self.qubit_count}")
+            fuser = self._fuser
+            if fuser is not None and fuser.queue(tuple(controls), m, target, perm):
+                return
             self._k_apply_2x2(m, target, tuple(controls), perm)
 
     # fast paths: X on many bits is one gather; Z/phase masks are diagonal

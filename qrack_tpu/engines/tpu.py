@@ -141,12 +141,44 @@ class QEngineTPU(QEngine):
         self._gate_count = 0
         self._device = _discover(device_id)
         self._device_id = device_id
-        self._state = None  # (2, 2^n) planes
+        # lazy gate-stream fusion (ops/fusion.py): install BEFORE the
+        # first _state write so the property sees a fuser from day one
+        from ..ops import fusion as _fusion
+
+        self._fuser = _fusion.make_fuser(self)
+        self._state_raw = None  # (2, 2^n) planes
         self.SetPermutation(init_state)
 
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------
+
+    _fuse_capable = True
+
+    @property
+    def _state(self):
+        """Resident planes.  EVERY read is a fusion boundary: a pending
+        gate window flushes before the value escapes (Prob*/M*/sample/
+        device_get/checkpoint capture/failover snapshot/serve batch edge
+        all land here), so no reader can observe a ket that is behind
+        the gate stream."""
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.flush("read")
+        return self._state_raw
+
+    @_state.setter
+    def _state(self, planes) -> None:
+        # a direct write while gates are pending is a blind overwrite
+        # (SetPermutation/SetQuantumState/restore): the queued gates
+        # acted on state that no longer exists — drop them.  Kernel
+        # read-modify-writes never hit this: their RHS read flushed the
+        # window first, and the flush's own write-back is re-entrant
+        # (_flushing) so it passes straight through.
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.drop("overwritten")
+        self._state_raw = planes
 
     @property
     def device_planes(self):
@@ -199,10 +231,17 @@ class QEngineTPU(QEngine):
         which is its precision-escalation policy).  Ticked from every
         MIXING kernel (2x2/invert/diag/4x4/uc); swaps and gathers are
         exact permutations and cannot drift the norm."""
+        self._drift_tick_n(1)
+
+    def _drift_tick_n(self, k: int) -> None:
+        """Advance the drift accounting by `k` gates at once (a fused
+        window applies its whole gate run in one dispatch)."""
         if self._drift_thresh <= 0 or self.dtype == jnp.dtype("float64"):
             return
-        self._gate_count += 1
-        if self._gate_count % self._drift_check_every:
+        before = self._gate_count
+        self._gate_count += k
+        if (before // self._drift_check_every) == (
+                self._gate_count // self._drift_check_every):
             return
         nrm = float(_j_prob_mask(self._state, 0, 0))  # total probability
         if abs(1.0 - nrm) > self._drift_thresh:
@@ -257,6 +296,55 @@ class QEngineTPU(QEngine):
         self.dtype = jnp.dtype(jnp.float64)
         if self._state is not None:
             self._state = self._state.astype(jnp.float64)
+
+    # ------------------------------------------------------------------
+    # fusion hooks (ops/fusion.py)
+    # ------------------------------------------------------------------
+
+    def _fuse_admit(self, m, target, controls) -> bool:
+        # every 2x2 gate lowers into a dense parametric window
+        return True
+
+    def _fuse_tick(self) -> None:
+        # drift accounting advances per LOGICAL gate at queue time (the
+        # eager kernels tick per dispatch; a fused window would otherwise
+        # under-count merged-away gates).  A boundary crossing reads the
+        # state norm, which flushes the pending window first.
+        self._drift_tick()
+
+    def _fuse_flush(self, gates) -> int:
+        """Lower the pending window into ONE parametric program dispatch
+        (guarded site tpu.fuse.flush).  A window that merged down to a
+        single op reuses the shared per-gate program families instead of
+        minting a one-op window program."""
+        from ..ops import fusion as fu
+
+        ops = fu.lower_gates(gates)
+        if not ops:
+            return 0
+        n = self.qubit_count
+        if len(ops) == 1:
+            op = ops[0]
+            m = op.m
+            if op.kind in ("cphase", "diag"):
+                d0, d1 = complex(m[0, 0]), complex(m[1, 1])
+                self._state = _j_apply_diag(
+                    self._state, d0.real, d0.imag, d1.real, d1.imag,
+                    n, 1 << op.target, op.cmask, op.cval)
+            elif op.kind == "inv":
+                tr, bl = complex(m[0, 1]), complex(m[1, 0])
+                self._state = _j_apply_invert(
+                    self._state, tr.real, tr.imag, bl.real, bl.imag,
+                    n, op.target, op.cmask, op.cval)
+            else:
+                mp = gk.mtrx_planes(m, self.dtype)
+                self._state = _j_apply_2x2(
+                    self._state, mp, n, op.target, op.cmask, op.cval)
+            return 1
+        prog = fu.dense_window_program(n, fu.structure_of(ops), self.dtype)
+        operands = fu.dense_operands(ops, self.dtype)
+        self._state = prog(self._state, *operands)
+        return 1
 
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:
         cmask, cval = self._cmask_cval(controls, perm)

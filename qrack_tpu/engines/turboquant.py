@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from ..ops import gatekernels as gk
 from ..storage import turboquant as tq
+from .. import matrices as mat
 from .. import telemetry as _tele
 from .tpu import QEngineTPU
 
@@ -254,6 +255,85 @@ def _mk_diag(ca, block, cdt, qmax):
     return run
 
 
+def _mk_fuse_window(ca, block, cdt, qmax, structure):
+    """Fused gate window on the compressed ket: ONE decompress -> every
+    window op -> ONE recompress per chunk, inside one lax.map program.
+    This is where fusion pays double on this engine — each eager gate
+    costs a full decompress/recompress round trip AND a requantization;
+    a W-op window amortizes both to 1/W.  Payloads/masks are runtime
+    operands in the ops/fusion.py sharded layout with the chunk axis
+    standing in for the page axis (lo = in-chunk index, hi = chunk id).
+    Non-diagonal targets at/above the chunk axis never reach here
+    (_fuse_admit routes them to the eager pair-mixing program).  A chunk
+    no window op acted on keeps its codes bit-for-bit — same exactness
+    contract as the per-gate kernels."""
+    lbits = (1 << ca) - 1
+
+    def run(codes3, scales2, rot, rot_t, cid0, *operands):
+        def body(args):
+            cid, cc, ss = args
+            pl = _rows_to_planes(_dec_rows_f(cc, ss, rot_t, qmax), block)
+            lidx = gk.iota_for(pl)
+            dirty = jnp.zeros((), jnp.bool_)
+            i = 0
+            for kind, target, has_ctrl in structure:
+                p = operands[i]
+                i += 1
+                if kind == "cphase":
+                    if has_ctrl:
+                        clo, chi = operands[i], operands[i + 1]
+                        i += 2
+                    else:
+                        comb = 1 << target
+                        clo, chi = comb & lbits, comb >> ca
+                    # chi carries the target's high bit too, so hi_ok
+                    # is already exact per chunk (factor-1 chunks stay)
+                    hi_ok = (cid & chi) == chi
+                    hit = ((lidx & clo) == clo) & hi_ok
+                    pl = gk.cmul(jnp.where(hit, p[0], 1.0),
+                                 jnp.where(hit, p[1], 0.0), pl)
+                    dirty = dirty | hi_ok
+                    continue
+                if has_ctrl:
+                    lo_cm, lo_cv, hi_cm, hi_cv = operands[i:i + 4]
+                    i += 4
+                else:
+                    lo_cm = lo_cv = hi_cm = hi_cv = 0
+                hi_ok = (cid & hi_cm) == hi_cv
+                if kind == "diag":
+                    tmask_lo = (1 << target) if target < ca else 0
+                    tb_hi = 0 if target < ca else (1 << (target - ca))
+                    hi_bit = (cid & tb_hi) != 0
+                    bit = ((lidx & tmask_lo) != 0) | hi_bit
+                    fre = jnp.where(bit, p[1, 0], p[0, 0])
+                    fim = jnp.where(bit, p[1, 1], p[0, 1])
+                    active = ((lidx & lo_cm) == lo_cv) & hi_ok
+                    pl = gk.cmul(jnp.where(active, fre, 1.0),
+                                 jnp.where(active, fim, 0.0), pl)
+                    if tmask_lo == 0:
+                        # whole-chunk constant factor: exact-keep chunks
+                        # whose factor is identically 1 (_mk_diag ident)
+                        cf_re = jnp.where(hi_bit, p[1, 0], p[0, 0])
+                        cf_im = jnp.where(hi_bit, p[1, 1], p[0, 1])
+                        ident = ((lo_cm == 0) & (cf_re == 1.0)
+                                 & (cf_im == 0.0))
+                        dirty = dirty | (hi_ok & ~ident)
+                    else:
+                        dirty = dirty | hi_ok
+                else:  # gen: target < ca guaranteed by _fuse_admit
+                    out = gk.apply_2x2(pl, p, ca, target, lo_cm, lo_cv)
+                    pl = jnp.where(hi_ok, out, pl)
+                    dirty = dirty | hi_ok
+            nc, ns = _comp_rows_f(_planes_to_rows(pl, block), rot,
+                                  qmax, cdt)
+            return jnp.where(dirty, nc, cc), jnp.where(dirty, ns, ss)
+
+        cids = cid0 + jnp.arange(codes3.shape[0], dtype=gk.IDX_DTYPE)
+        return jax.lax.map(body, (cids, codes3, scales2))
+
+    return run
+
+
 def _mk_phase_split(ca, block, cdt, qmax, body_fn):
     def run(codes3, scales2, rot, rot_t, cid0, *targs):
         def body(args):
@@ -406,6 +486,40 @@ class QEngineTurboQuant(QEngineTPU):
         if self._codes is None:
             return 0
         return self._codes.nbytes + self._scales.nbytes
+
+    # resident-form access: every read of the code/scale arrays (gate
+    # kernels, prob/collapse, Dump, checkpoint capture) flushes the
+    # pending gate window first, and a blind write drops it — the same
+    # laziness boundary the dense engines put on `_state`
+    # (ops/fusion.py).  The `_state` fallback plane inherits the
+    # discipline for free: its getter/setter go through these.
+    @property
+    def _codes(self):
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.flush("read")
+        return self._codes_raw
+
+    @_codes.setter
+    def _codes(self, v) -> None:
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.drop("overwritten")
+        self._codes_raw = v
+
+    @property
+    def _scales(self):
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.flush("read")
+        return self._scales_raw
+
+    @_scales.setter
+    def _scales(self, v) -> None:
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.drop("overwritten")
+        self._scales_raw = v
 
     def _compress_planes(self, planes):
         rows = _planes_to_rows(jnp.asarray(planes, jnp.float32), self._block)
@@ -654,6 +768,63 @@ class QEngineTurboQuant(QEngineTPU):
                                 tmask_lo, tb_hi, cmask & (cs - 1),
                                 cval & (cs - 1), cmask >> ca, cval >> ca)
         self._store3(nc, ns)
+
+    # ------------------------------------------------------------------
+    # gate-stream fusion hooks (ops/fusion.py GateStreamFuser)
+    # ------------------------------------------------------------------
+
+    def _fuse_admit(self, m, target, controls) -> bool:
+        # the Pallas path stays per-gate (its kernels fuse decompress/
+        # gate/recompress already); cross-chunk pair mixing (non-diagonal
+        # target at/above the chunk axis) can't join a single-chunk
+        # window body
+        if self._use_pallas():
+            return False
+        return mat.is_phase(m) or target < self._tq_chunk_pow
+
+    def _fuse_tick(self) -> None:
+        # the chunked kernels never ticked drift accounting (norm checks
+        # would force a full decompress); keep that contract under fusion
+        pass
+
+    def _p_fuse_window(self, structure):
+        run = _mk_fuse_window(self._tq_chunk_pow, self._block,
+                              self._code_np, self._qmax, structure)
+
+        def build():
+            return _tele.instrument_jit("fuse.window", jax.jit(
+                lambda c3, s2, rot, rot_t, *ops:
+                run(c3, s2, rot, rot_t, _ZERO, *ops),
+                donate_argnums=(0, 1)))
+
+        return _program(("tq_fusewin", self._layout_key(), structure),
+                        build, site="tpu.fuse.flush")
+
+    def _fuse_flush(self, gates) -> int:
+        from ..ops import fusion as fu
+
+        ops = fu.lower_gates(gates)
+        if len(ops) == 1:
+            # merged down to one op: the per-gate chunk programs already
+            # exist and skip the recompress of untouched chunk pairs
+            op = ops[0]
+            controls, perm = fu.controls_perm(op)
+            m = np.asarray(op.m)
+            if op.kind in ("cphase", "diag"):
+                self._k_apply_diag(m[0, 0], m[1, 1], op.target,
+                                   controls, perm)
+            else:
+                self._k_apply_2x2(m, op.target, controls, perm)
+            return 1
+        structure = fu.sharded_structure_of(ops)
+        operands = fu.sharded_operands(ops, self._tq_chunk_pow,
+                                       jnp.float32)
+        self._note_transient(1)
+        prog = self._p_fuse_window(structure)
+        c3, s2 = self._chunk3()
+        nc, ns = prog(c3, s2, self._rot, self._rot_t, *operands)
+        self._store3(nc, ns)
+        return 1
 
     def _p_phase_split(self, key, body_fn, n_targs: int):
         run = _mk_phase_split(self._tq_chunk_pow, self._block, self._code_np,

@@ -72,7 +72,6 @@ class QCircuit:
     def __init__(self, qubit_count: int = 0):
         self.qubit_count = qubit_count
         self.gates: List[QCircuitGate] = []
-        self._fused_cache: Dict[int, object] = {}  # width -> jitted program
 
     # ------------------------------------------------------------------
 
@@ -81,7 +80,6 @@ class QCircuit:
         algebraic combining of same-target/controls neighbors and
         commuting past disjoint gates)."""
         self.qubit_count = max(self.qubit_count, max(gate.qubits()) + 1)
-        self._fused_cache.clear()
         # walk back past gates on disjoint qubits to find a merge partner
         i = len(self.gates) - 1
         gset = set(gate.qubits())
@@ -134,13 +132,20 @@ class QCircuit:
 
     def RunFused(self, qsim) -> None:
         """Execute, preferring one fused XLA program when the target is a
-        plane-backed dense engine: single-chip TPU kets lower through
-        `compile_fn`, paged kets through `compile_sharded_fn` (the whole
-        circuit as one shard_map executable over the 'pages' mesh) —
-        per-gate dispatch otherwise. The TPU-native analogue of the
-        reference's queued kernel chain collapsing into one submission."""
+        plane-backed dense engine: the circuit lowers through the
+        PARAMETRIC window compiler (ops/fusion.py) — gate payloads ride
+        the operand vector, so the compiled program is keyed only by the
+        circuit's structure and lives in the bounded shared
+        fusion.PROGRAMS / pager program cache.  Two circuits with the
+        same gate skeleton but different rotation angles (every
+        QFT width, every VQE sweep) dispatch through ONE executable, and
+        an engine's own gate-stream fuser windows hit the same entries
+        where structures coincide.  Per-gate dispatch otherwise (which
+        on a fuse-capable engine still windows through its fuser)."""
         from ..engines.hybrid import QHybrid
         from ..engines.tpu import QEngineTPU
+        from ..engines.turboquant import QEngineTurboQuant
+        from ..ops import fusion as fu
         from ..parallel.pager import QPager
 
         if isinstance(qsim, QHybrid):
@@ -148,38 +153,53 @@ class QCircuit:
             inner = qsim._engine
             if isinstance(inner, (QEngineTPU, QPager)):
                 return self.RunFused(inner)
+        if isinstance(qsim, QEngineTurboQuant):
+            # the compressed engine fuses chunk-wise through its own
+            # gate-window funnel (engines/turboquant.py _fuse_flush);
+            # materializing full f32 planes here would defeat it and is
+            # unsound past the dense width cap
+            return self.Run(qsim)
         if isinstance(qsim, QEngineTPU) and self.gates:
-            import jax
+            import os
 
             n = qsim.qubit_count
             self._check_fused_range(n)
-            import os
+            if os.environ.get("QRACK_USE_PALLAS") == "1":
+                import jax
 
-            use_pallas = os.environ.get("QRACK_USE_PALLAS") == "1"
-            key = (n, use_pallas)
-            fn = self._fused_cache.get(key)
-            if fn is None:
-                if use_pallas:
+                # the pallas segment sweep bakes matrices as kernel
+                # constants, so its cache key needs payload VALUES
+                # (digest), not just structure
+                key = ("pallas", n, self.structure_digest())
+
+                def build():
                     # pallas lowers natively on TPU; elsewhere (tests,
                     # CPU installs) run the same kernel interpreted
                     body = self.compile_fn_pallas(
                         n,
                         interpret=jax.default_backend() not in ("tpu", "axon"))
-                else:
-                    body = self.compile_fn(n)
-                fn = jax.jit(body, donate_argnums=(0,))
-                self._fused_cache[key] = fn
-            qsim._state = fn(qsim._state)
+                    return jax.jit(body, donate_argnums=(0,))
+
+                fn = fu.PROGRAMS.get_or_build(key, build)
+                qsim._state = fn(qsim._state)
+                return
+            ops = fu.lower_gates(self.gates)
+            if not ops:
+                return
+            prog = fu.dense_window_program(n, fu.structure_of(ops),
+                                           qsim.dtype)
+            qsim._state = prog(qsim._state, *fu.dense_operands(ops, qsim.dtype))
             return
         if isinstance(qsim, QPager) and self.gates:
             n = qsim.qubit_count
             self._check_fused_range(n)
-            key = (n, id(qsim.mesh))
-            fn = self._fused_cache.get(key)
-            if fn is None:
-                fn, _ = self.compile_sharded_fn(qsim.mesh, n)
-                self._fused_cache[key] = fn
-            qsim._state = fn(qsim._state)
+            ops = fu.lower_gates(self.gates)
+            if not ops:
+                return
+            structure = fu.sharded_structure_of(ops)
+            operands = fu.sharded_operands(ops, qsim.local_bits, qsim.dtype)
+            prog = qsim._p_fuse_window(structure, len(operands))
+            qsim._state = prog(qsim._state, *operands)
             return
         self.Run(qsim)
 

@@ -1,0 +1,438 @@
+"""Lazy gate-stream fusion: windowed op-queues lowered to parametric
+(constant-free) compiled windows.
+
+The eager engine path mirrors the reference's per-gate dispatch chain:
+every Mtrx/MCMtrx is its own jitted full-ket sweep (engines/tpu.py:88),
+so an N-gate circuit pays N HBM round trips and N dispatches.  Gate
+fusion into multi-op windows is the standard lever in large-scale ket
+simulators (mpiQulacs fuses gate runs to cut inter-node sweeps,
+arXiv:2203.16044; single-GPU simulators take their headline speedups
+from the same transform, arXiv:2304.14969).  This module makes fusion
+the *default* execution mode of the dense engines:
+
+* :class:`GateStreamFuser` — a bounded pending window of gate
+  descriptors (``QRACK_TPU_FUSE_WINDOW``, default 16) attached to an
+  engine.  Gate ops append instead of dispatching; every read/boundary
+  (Prob*/M*/device_get/checkpoint capture/failover snapshot/serror
+  batch edge) lands on the engine's ``_state`` property, whose getter
+  flushes the window first.  Neighbor gates on the same target+controls
+  merge algebraically before lowering (QCircuit.AppendGate's peephole,
+  reference src/qcircuit.cpp:101), so a flushed window can dispatch
+  fewer sweeps than gates queued ("sweeps saved").
+
+* Parametric window programs — a window lowers to ONE jitted program
+  whose payload matrices and control masks are *runtime operands*, not
+  trace constants.  The program is keyed only by the window's
+  **structure** (per-op kind, target axis, controlled-or-not), so two
+  same-shaped windows with different rotation angles dispatch through
+  one compiled executable (compile.fuse hit, not a recompile) — unlike
+  QCircuit.compile_fn, which bakes matrices as literals and recompiles
+  per angle.  Programs live in the bounded telemetry
+  :class:`~qrack_tpu.telemetry.ProgramCache` (``fuse``) and dispatch
+  through the guarded site ``tpu.fuse.flush`` (watchdog / retry /
+  breaker / fault injection — docs/RESILIENCE.md).
+
+Operand layout (per op, in window order):
+
+  kind      payload operand                      extra (iff controlled)
+  cphase    (2,)  [d1.re, d1.im]                 cmask:int32, cval:int32
+  diag      (2,2) [[d0.re,d0.im],[d1.re,d1.im]]  cmask:int32, cval:int32
+  inv       (2,2) [[tr.re,tr.im],[bl.re,bl.im]]  cmask:int32, cval:int32
+  gen       (2,2,2) mtrx_planes                  cmask:int32, cval:int32
+
+"cphase" is the measured hot case (controlled phase with d0 == 1 and
+positive controls — all 231 QFT phases): the factor select collapses to
+one combined-mask test, (idx & (tmask|cmask)) == (tmask|cmask).
+Uncontrolled ops pass NO mask operands, so apply_2x2/apply_invert keep
+their static cmask==0 short-circuit inside the trace.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import matrices as mat
+from .. import telemetry as _tele
+from .. import resilience as _res
+from ..utils.bits import control_offset
+from . import gatekernels as gk
+
+DEFAULT_WINDOW = 16
+
+# structure-keyed parametric window programs, shared by the engine
+# fusers AND QCircuit.RunFused (layers/qcircuit.py) — same structure,
+# one compiled program, regardless of who lowered it
+PROGRAMS = _tele.ProgramCache("fuse", cap_env="QRACK_TPU_FUSE_CACHE_CAP",
+                              default_cap=256)
+
+
+def window_len() -> int:
+    """Pending-window bound. <=1 disables fusion (exact per-gate path)."""
+    try:
+        w = int(os.environ.get("QRACK_TPU_FUSE_WINDOW", str(DEFAULT_WINDOW)))
+    except ValueError:
+        w = DEFAULT_WINDOW
+    return max(1, w)
+
+
+# ---------------------------------------------------------------------------
+# lowering: QCircuitGate window -> flat op descriptors
+# ---------------------------------------------------------------------------
+
+class FusedOp:
+    """One lowered gate: classification + static placement + payload."""
+
+    __slots__ = ("kind", "target", "cmask", "cval", "m")
+
+    def __init__(self, kind: str, target: int, cmask: int, cval: int, m):
+        self.kind = kind
+        self.target = target
+        self.cmask = cmask
+        self.cval = cval
+        self.m = m
+
+
+def classify(m, cmask: int, cval: int) -> str:
+    if mat.is_phase(m):
+        # d0 == 1 with positive controls: factor select collapses to one
+        # combined-mask test (the dominant case — QFT controlled phases)
+        if m[0, 0] == 1.0 and cval == cmask:
+            return "cphase"
+        return "diag"
+    if mat.is_invert(m):
+        return "inv"
+    return "gen"
+
+
+def lower_gates(gates) -> List[FusedOp]:
+    """Flatten merged QCircuitGates into op descriptors (payload perms in
+    sorted order for a deterministic structure)."""
+    ops: List[FusedOp] = []
+    for g in gates:
+        for perm in sorted(g.payloads):
+            m = g.payloads[perm]
+            cmask = 0
+            for c in g.controls:
+                cmask |= 1 << c
+            cval = control_offset(g.controls, perm)
+            ops.append(FusedOp(classify(m, cmask, cval), g.target, cmask, cval, m))
+    return ops
+
+
+def controls_perm(op: FusedOp) -> Tuple[Tuple[int, ...], int]:
+    """Reconstruct a (controls, perm) pair from an op's (cmask, cval) —
+    the inverse of lower_gates' control_offset, in ascending bit order —
+    so a single-op window can re-enter an engine's eager `_k_apply_*`
+    funnel unchanged."""
+    controls = tuple(c for c in range(op.cmask.bit_length())
+                     if (op.cmask >> c) & 1)
+    perm = 0
+    for j, c in enumerate(controls):
+        if (op.cval >> c) & 1:
+            perm |= 1 << j
+    return controls, perm
+
+
+def structure_of(ops: Sequence[FusedOp]) -> Tuple:
+    """The program-cache identity of a window: per-op (kind, target,
+    controlled?).  Payload values and control placement are runtime
+    operands and deliberately NOT part of the key."""
+    return tuple((op.kind, op.target, op.cmask != 0) for op in ops)
+
+
+# ---------------------------------------------------------------------------
+# dense (single-shard) parametric window program
+# ---------------------------------------------------------------------------
+
+def window_fn(n: int, structure: Tuple):
+    """Traced body: fn(planes, *operands) applying the window in order.
+    Pure and jit-safe; operand layout per module docstring."""
+
+    def fn(planes, *operands):
+        i = 0
+        for kind, target, has_ctrl in structure:
+            p = operands[i]
+            i += 1
+            if has_ctrl:
+                cm = operands[i]
+                cv = operands[i + 1]
+                i += 2
+            else:
+                cm = 0
+                cv = 0
+            if kind == "cphase":
+                comb = ((1 << target) | cm) if has_ctrl else (1 << target)
+                hit = (gk.iota_for(planes) & comb) == comb
+                one = jnp.ones((), planes.dtype)
+                zero = jnp.zeros((), planes.dtype)
+                planes = gk.cmul(jnp.where(hit, p[0], one),
+                                 jnp.where(hit, p[1], zero), planes)
+            elif kind == "diag":
+                planes = gk.apply_diag(planes, p[0, 0], p[0, 1], p[1, 0],
+                                       p[1, 1], n, 1 << target, cm, cv)
+            elif kind == "inv":
+                planes = gk.apply_invert(planes, p[0, 0], p[0, 1], p[1, 0],
+                                         p[1, 1], n, target, cm, cv)
+            else:
+                planes = gk.apply_2x2(planes, p, n, target, cm, cv)
+        return planes
+
+    return fn
+
+
+def dense_operands(ops: Sequence[FusedOp], dtype) -> List:
+    out: List = []
+    for op in ops:
+        m = np.asarray(op.m)
+        if op.kind == "cphase":
+            out.append(jnp.asarray([m[1, 1].real, m[1, 1].imag], dtype=dtype))
+        elif op.kind == "diag":
+            out.append(jnp.asarray(
+                [[m[0, 0].real, m[0, 0].imag], [m[1, 1].real, m[1, 1].imag]],
+                dtype=dtype))
+        elif op.kind == "inv":
+            out.append(jnp.asarray(
+                [[m[0, 1].real, m[0, 1].imag], [m[1, 0].real, m[1, 0].imag]],
+                dtype=dtype))
+        else:
+            out.append(gk.mtrx_planes(m, dtype))
+        if op.cmask:
+            out.append(jnp.asarray(op.cmask, dtype=jnp.int32))
+            out.append(jnp.asarray(op.cval, dtype=jnp.int32))
+    return out
+
+
+def dense_window_program(n: int, structure: Tuple, dtype):
+    """One guarded jitted program per (width, dtype, structure) — payload
+    values ride the operand vector, so every same-structure window is a
+    compile.fuse hit."""
+    key = ("dense", n, str(jnp.dtype(dtype)), structure)
+
+    def build():
+        return _res.instrument_dispatch(
+            "tpu.fuse.flush",
+            _tele.instrument_jit(
+                "fuse.window", jax.jit(window_fn(n, structure),
+                                       donate_argnums=(0,))))
+
+    return PROGRAMS.get_or_build(key, build)
+
+
+# ---------------------------------------------------------------------------
+# sharded ('pages'-mesh) parametric window lowering — QPager wraps the
+# body in ONE shard_map program (parallel/pager.py _p_fuse_window), so a
+# flushed window costs one dispatch regardless of how many paged-target
+# exchanges it contains
+# ---------------------------------------------------------------------------
+
+def sharded_structure_of(ops: Sequence[FusedOp]) -> Tuple:
+    """Pager program-cache identity.  'inv' folds into 'gen': the pager
+    gate path has no invert specialization (both route through the
+    local/global 2x2 kernels), so keeping them distinct would compile
+    the same program twice."""
+    return tuple((("gen" if op.kind == "inv" else op.kind),
+                  op.target, op.cmask != 0) for op in ops)
+
+
+def sharded_window_body(L: int, npg: int, structure: Tuple):
+    """Per-shard traced body fn(local, *operands) for one window.  Masks
+    arrive pre-split host-side into (local, page) int32 halves — same
+    exact-past-int32 discipline as the eager pager kernels: cphase takes
+    2 combined-mask scalars, diag/gen take 4 split-mask scalars, and
+    uncontrolled ops take none (their masks stay static in the trace)."""
+    from . import sharded as shb
+
+    lbits = (1 << L) - 1
+
+    def fn(local, *operands):
+        i = 0
+        for kind, target, has_ctrl in structure:
+            p = operands[i]
+            i += 1
+            if kind == "cphase":
+                if has_ctrl:
+                    clo, chi = operands[i], operands[i + 1]
+                    i += 2
+                else:
+                    comb = 1 << target
+                    clo, chi = comb & lbits, comb >> L
+                hit = ((gk.iota_for(local) & clo) == clo) & \
+                      ((shb.page_id() & chi) == chi)
+                one = jnp.ones((), local.dtype)
+                zero = jnp.zeros((), local.dtype)
+                local = gk.cmul(jnp.where(hit, p[0], one),
+                                jnp.where(hit, p[1], zero), local)
+                continue
+            if has_ctrl:
+                lm, lv, gm, gv = operands[i:i + 4]
+                i += 4
+            else:
+                lm = lv = gm = gv = 0
+            if kind == "diag":
+                tmask = 1 << target
+                local = shb.apply_diag(local, p[0, 0], p[0, 1], p[1, 0],
+                                       p[1, 1], tmask & lbits, tmask >> L,
+                                       lm, lv, gm, gv)
+            elif target < L:
+                local = shb.apply_local_2x2(local, p, L, target,
+                                            lm, lv, gm, gv)
+            else:
+                local = shb.apply_global_2x2(local, p, npg, target - L,
+                                             lm, lv, gm, gv)
+        return local
+
+    return fn
+
+
+def sharded_operands(ops: Sequence[FusedOp], L: int, dtype) -> List:
+    from .sharded import split_masks
+
+    out: List = []
+    for op in ops:
+        m = np.asarray(op.m)
+        kind = "gen" if op.kind == "inv" else op.kind
+        if kind == "cphase":
+            out.append(jnp.asarray([m[1, 1].real, m[1, 1].imag], dtype=dtype))
+            if op.cmask:
+                comb = (1 << op.target) | op.cmask
+                out.append(jnp.asarray(comb & ((1 << L) - 1), dtype=jnp.int32))
+                out.append(jnp.asarray(comb >> L, dtype=jnp.int32))
+            continue
+        if kind == "diag":
+            out.append(jnp.asarray(
+                [[m[0, 0].real, m[0, 0].imag], [m[1, 1].real, m[1, 1].imag]],
+                dtype=dtype))
+        else:
+            out.append(gk.mtrx_planes(m, dtype))
+        if op.cmask:
+            out.extend(jnp.asarray(v, dtype=jnp.int32)
+                       for v in split_masks(op.cmask, op.cval, L))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the pending window
+# ---------------------------------------------------------------------------
+
+class GateStreamFuser:
+    """Bounded pending-gate window attached to one engine.
+
+    The engine's gate funnel calls :meth:`queue`; its ``_state`` (or
+    codes/scales) property getter calls :meth:`flush` on every read and
+    :meth:`drop` on every blind overwrite.  The engine supplies two
+    hooks: ``_fuse_admit(m, target, controls) -> bool`` (can this op
+    join a window?) and ``_fuse_flush(gates) -> int`` (lower + dispatch,
+    returning programs dispatched).  On a flush failure the window is
+    KEPT — the resilience retry/failover machinery re-reads state under
+    faults.suspended(), which re-runs the flush."""
+
+    __slots__ = ("engine", "window", "gates", "_raw", "_flushing")
+
+    def __init__(self, engine, window: int):
+        self.engine = engine
+        self.window = window
+        self.gates: List = []   # merged QCircuitGate window
+        self._raw = 0           # gates queued since last flush (pre-merge)
+        self._flushing = False
+
+    @property
+    def pending(self) -> bool:
+        return bool(self.gates)
+
+    def queue(self, controls, m, target: int, perm: int) -> bool:
+        """Admit one gate into the window.  Returns False (after flushing
+        any pending window, to preserve order) when the op cannot join —
+        the caller then dispatches it eagerly."""
+        eng = self.engine
+        if not eng._fuse_admit(m, target, controls):
+            self.flush("ineligible")
+            return False
+        from ..layers.qcircuit import QCircuitGate
+
+        if controls:
+            gate = QCircuitGate.controlled(controls, target, m, perm)
+        else:
+            gate = QCircuitGate.single(target, m)
+        self._append_merge(gate)
+        self._raw += 1
+        if _tele._ENABLED:
+            _tele.inc(f"fuse.{eng._tele_name}.queued")
+            _tele.gauge(f"fuse.{eng._tele_name}.queue_depth",
+                        float(len(self.gates)))
+        # per-LOGICAL-gate engine accounting (drift escalation cadence):
+        # ticked here, not at flush, because merged-away gates (H·H)
+        # never flush yet were still requested.  May itself force a
+        # flush (a drift check reads the state).
+        eng._fuse_tick()
+        if len(self.gates) >= self.window:
+            self.flush("window_full")
+        return True
+
+    def _append_merge(self, gate) -> None:
+        # QCircuit.AppendGate's peephole: walk back past disjoint-qubit
+        # gates; compose onto a same-target/controls partner
+        i = len(self.gates) - 1
+        gset = set(gate.qubits())
+        while i >= 0:
+            g = self.gates[i]
+            if g.can_merge(gate):
+                g.merge(gate)
+                if g.is_identity():
+                    del self.gates[i]
+                return
+            if set(g.qubits()) & gset:
+                break
+            i -= 1
+        self.gates.append(gate.clone())
+
+    def flush(self, reason: str = "read") -> None:
+        """Lower + dispatch the pending window (guarded site
+        ``tpu.fuse.flush``).  No-op when empty or re-entered (the
+        engine's state getter fires during the flush's own dispatch)."""
+        if not self.gates or self._flushing:
+            return
+        eng = self.engine
+        self._flushing = True
+        try:
+            dispatched = eng._fuse_flush(self.gates)
+        finally:
+            self._flushing = False
+        raw = self._raw
+        self.gates = []
+        self._raw = 0
+        if _tele._ENABLED:
+            name = eng._tele_name
+            _tele.inc(f"fuse.{name}.flush.{reason}")
+            _tele.inc(f"fuse.{name}.gates", raw)
+            _tele.inc(f"fuse.{name}.sweeps_saved",
+                      max(0, raw - int(dispatched)))
+            _tele.observe(f"fuse.{name}.window_len", float(raw))
+            _tele.gauge(f"fuse.{name}.queue_depth", 0.0)
+
+    def drop(self, reason: str = "overwritten") -> None:
+        """Discard the pending window — correct only when the caller is
+        about to blind-overwrite the state the gates would have acted on
+        (SetPermutation/SetQuantumState/checkpoint restore)."""
+        if not self.gates:
+            return
+        n = len(self.gates)
+        self.gates = []
+        self._raw = 0
+        if _tele._ENABLED:
+            _tele.inc(f"fuse.{self.engine._tele_name}.dropped.{reason}", n)
+            _tele.gauge(f"fuse.{self.engine._tele_name}.queue_depth", 0.0)
+
+
+def make_fuser(engine):
+    """Install-time factory: None when fusion is off (window <= 1) or the
+    engine opted out (``_fuse_capable``)."""
+    w = window_len()
+    if w <= 1 or not getattr(engine, "_fuse_capable", False):
+        return None
+    return GateStreamFuser(engine, w)

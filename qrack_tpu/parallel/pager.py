@@ -127,6 +127,7 @@ class QPager(QEngine):
 
     _xp = jnp
     _tele_name = "pager"
+    _fuse_capable = True  # gate stream fuses into sharded window programs
 
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
                  n_pages: Optional[int] = None, dtype=None, **kwargs):
@@ -160,10 +161,34 @@ class QPager(QEngine):
         self.dtype = jnp.dtype(dtype)
         self.mesh = Mesh(np.array(dev_list), ("pages",))
         self.sharding = NamedSharding(self.mesh, P(None, "pages"))
-        self._state = None
+        from ..ops import fusion as _fusion
+
+        self._fuser = _fusion.make_fuser(self)
+        self._state_raw = None
         self.SetPermutation(init_state)
 
     # ------------------------------------------------------------------
+
+    @property
+    def _state(self):
+        # every read (kernel RHS, Prob*/M*, Dump, compose, snapshot)
+        # forces the pending gate window out first — laziness is never
+        # observable (ops/fusion.py)
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.flush("read")
+        return self._state_raw
+
+    @_state.setter
+    def _state(self, local) -> None:
+        # blind overwrite (SetPermutation/SetQuantumState/restore):
+        # queued gates acted on state that no longer exists.  Kernel
+        # read-modify-writes are unaffected — their RHS read flushed the
+        # window, so the setter sees it empty.
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.drop("overwritten")
+        self._state_raw = local
 
     @property
     def local_bits(self) -> int:
@@ -394,6 +419,71 @@ class QPager(QEngine):
             self._state, d0.real, d0.imag, d1.real, d1.imag,
             tlo, thi, lmask, lval, gmask, gval,
         )
+
+    # ------------------------------------------------------------------
+    # gate-stream fusion hooks (ops/fusion.py GateStreamFuser)
+    # ------------------------------------------------------------------
+
+    def _fuse_admit(self, m, target, controls) -> bool:
+        # every 2x2 gate lowers into the sharded window body, paged
+        # targets included (the pair exchange runs inside the program)
+        return True
+
+    def _p_fuse_window(self, structure, n_operands: int):
+        from ..ops import fusion as fu
+
+        L, mesh, npg = self.local_bits, self.mesh, self.n_pages
+
+        def build():
+            body = fu.sharded_window_body(L, npg, structure)
+            return _tele.instrument_jit("fuse.window", jax.jit(
+                _compat_shard_map(body, mesh=mesh,
+                                  in_specs=_state_specs(n_operands),
+                                  out_specs=P(None, "pages")),
+                donate_argnums=(0,)))
+
+        return _program(self._key("fusewin", str(self.dtype), structure),
+                        build, site="tpu.fuse.flush")
+
+    def _fuse_flush(self, gates) -> int:
+        from ..ops import fusion as fu
+
+        ops = fu.lower_gates(gates)
+        L = self.local_bits
+        if len(ops) == 1:
+            # merged down to one op: the shared eager programs already
+            # exist and are cheaper than a fresh one-op window structure
+            op = ops[0]
+            m = np.asarray(op.m)
+            lmask, lval, gmask, gval = _split_masks(op.cmask, op.cval, L)
+            if op.kind in ("cphase", "diag"):
+                tmask = 1 << op.target
+                d0, d1 = complex(m[0, 0]), complex(m[1, 1])
+                self._state = self._p_diag()(
+                    self._state, d0.real, d0.imag, d1.real, d1.imag,
+                    tmask & ((1 << L) - 1), tmask >> L,
+                    lmask, lval, gmask, gval)
+            else:
+                mp = gk.mtrx_planes(m, self.dtype)
+                if op.target < L:
+                    self._state = self._p_local_2x2(op.target)(
+                        self._state, mp, lmask, lval, gmask, gval)
+                else:
+                    if _tele._ENABLED:
+                        self._tele_exchange("global_2x2", self._state.nbytes)
+                    self._state = self._p_global_2x2(op.target - L)(
+                        self._state, mp, lmask, lval, gmask, gval)
+            return 1
+        structure = fu.sharded_structure_of(ops)
+        operands = fu.sharded_operands(ops, L, self.dtype)
+        if _tele._ENABLED:
+            nb = self._state.nbytes
+            for kind, target, _ in structure:
+                if kind == "gen" and target >= L:
+                    self._tele_exchange("global_2x2", nb)
+        prog = self._p_fuse_window(structure, len(operands))
+        self._state = prog(self._state, *operands)
+        return 1
 
     def _k_apply_4x4(self, m4, q1, q2) -> None:
         # decompose into primitive ops through the pager paths

@@ -56,6 +56,10 @@ class QPagerTurboQuant(tqe.QEngineTurboQuant):
 
     # the Pallas fused path is single-device; the mesh keeps shard_map
     _pallas_capable = False
+    # gate-window fusion likewise: the window body is single-device
+    # (plain lax.map over local chunks); the sharded gate programs stay
+    # per-gate until a shard_map window variant exists
+    _fuse_capable = False
     _tele_name = "turboquant_pager"
 
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,

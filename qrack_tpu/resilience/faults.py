@@ -57,14 +57,15 @@ KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss",
 # deliberately unvalidated: tests exercise synthetic sites.
 SITES = (
     "discover",
-    "tpu.compile", "tpu.device_get",
+    "tpu.compile", "tpu.device_get", "tpu.fuse.flush",
     "pager.dispatch", "pager.exchange", "pager.device_get",
     "turboquant.dispatch", "turboquant_pager.exchange",
     "serve.dispatch", "serve.device_get",
     "checkpoint.save", "checkpoint.restore",
 )
 # bare last-segment categories that match the site family on any engine
-CATEGORIES = ("discover", "compile", "dispatch", "device_get", "exchange")
+CATEGORIES = ("discover", "compile", "dispatch", "device_get", "exchange",
+              "flush")
 
 
 def validate_site(site: str) -> None:

@@ -7,6 +7,9 @@ sum; spans merge).  Sections:
 
   * top gate counters (gate.<engine>.<kind>.w<width>), grouped and raw
   * compile-cache traffic: hit/miss/eviction per cache, miss ratio
+  * fusion: gate-window queue/flush/drop traffic per engine, sweeps
+    saved vs gates queued (saved_ratio); mean flushed window length
+    rides the spans section (fuse.<engine>.window_len)
   * exchange traffic: pager/ICI event counts and bytes
   * serving: jobs admitted/shed/expired/completed, batch occupancy
     (batched jobs per dispatch), queue-depth / latency gauges
@@ -80,6 +83,7 @@ def report(snap: dict, top: int) -> dict:
         "top_gates": sorted(gates.items(), key=lambda kv: -kv[1])[:top],
         "gates_total": sum(gates.values()),
         "compile": {},
+        "fusion": {},
         "exchange": {},
         "serve": {},
         "checkpoint": {},
@@ -93,6 +97,8 @@ def report(snap: dict, top: int) -> dict:
             # themselves be dotted (compile.tpu.apply_2x2.miss)
             cache, _, kind = k[len("compile."):].rpartition(".")
             out["compile"].setdefault(cache, {})[kind] = v
+        elif k.startswith("fuse."):
+            out["fusion"][k] = v
         elif k.startswith("exchange."):
             out["exchange"][k] = v
         elif k.startswith("serve."):
@@ -107,6 +113,12 @@ def report(snap: dict, top: int) -> dict:
         total = kinds.get("hit", 0) + kinds.get("miss", 0)
         if total:
             kinds["miss_ratio"] = round(kinds.get("miss", 0) / total, 4)
+    for k in [k for k in out["fusion"] if k.endswith(".gates")]:
+        eng = k[len("fuse."):-len(".gates")]
+        gates = out["fusion"][k]
+        if gates:
+            out["fusion"][f"fuse.{eng}.saved_ratio"] = round(
+                out["fusion"].get(f"fuse.{eng}.sweeps_saved", 0) / gates, 4)
     dispatches = out["serve"].get("serve.batch.dispatches", 0)
     if dispatches:
         out["serve"]["batch_occupancy"] = round(
@@ -137,6 +149,10 @@ def main(argv=None) -> int:
     for cache, kinds in sorted(rep["compile"].items()):
         parts = " ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
         print(f"  {cache:<40s} {parts}")
+    if rep["fusion"]:
+        print("== fusion ==")
+        for name, v in sorted(rep["fusion"].items()):
+            print(f"  {name:<40s} {v:>12.3f}")
     print("== exchange ==")
     for name, v in sorted(rep["exchange"].items()):
         shown = _fmt_bytes(v) if name.endswith("bytes") else f"{v:.0f}"

@@ -295,27 +295,34 @@ def test_tensornetwork_fused_materialization_on_tpu_engine():
 
 
 def test_runfused_validates_and_caches():
-    import jax
-
     from qrack_tpu.engines.tpu import QEngineTPU
     from qrack_tpu.layers.qcircuit import QCircuit
+    from qrack_tpu.ops import fusion as fu
 
     c = QCircuit(2)
     c.append_1q(5, mat.H2)  # widens the circuit, exceeds the engine below
     eng = QEngineTPU(4, rng=QrackRandom(1), rand_global_phase=False)
     with pytest.raises(ValueError):
         c.RunFused(eng)
-    # caching: same jitted object reused until the circuit changes
-    c2 = QCircuit(3)
-    c2.append_1q(0, mat.H2)
+
+    # caching: the parametric window program is keyed by STRUCTURE in
+    # the shared fusion.PROGRAMS cache, so a same-shaped circuit with a
+    # DIFFERENT rotation angle reuses the identical compiled program
+    def phase_circ(ang):
+        cc = QCircuit(3)
+        cc.append_1q(0, mat.H2)
+        cc.append_1q(1, np.diag([1.0, np.exp(1j * ang)]).astype(np.complex128))
+        return cc
+
     e2 = QEngineTPU(3, rng=QrackRandom(2), rand_global_phase=False)
+    c2 = phase_circ(0.3)
     c2.RunFused(e2)
-    key = (3, False)  # (width, use_pallas)
-    first = c2._fused_cache[key]
-    c2.RunFused(e2)
-    assert c2._fused_cache[key] is first
-    c2.append_1q(1, mat.H2)
-    assert key not in c2._fused_cache
+    ops = fu.lower_gates(c2.gates)
+    prog = fu.dense_window_program(3, fu.structure_of(ops), e2.dtype)
+    c3 = phase_circ(1.1)
+    c3.RunFused(e2)
+    assert fu.dense_window_program(
+        3, fu.structure_of(fu.lower_gates(c3.gates)), e2.dtype) is prog
 
 
 def test_tensornetwork_rebuffers_after_measurement():

@@ -82,6 +82,11 @@ def test_tq_pallas_matches_xla_path(monkeypatch):
         return abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
                                           * np.vdot(b, b).real)
 
+    # kernel-parity test: pin per-gate dispatch on BOTH builds (the
+    # pallas path never fuses, and windowed recompression rounds int16
+    # codes differently enough to nick the 1e-9 fidelity bar)
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "1")
+
     def build(use_pallas):
         if use_pallas:
             monkeypatch.setenv("QRACK_USE_PALLAS", "1")

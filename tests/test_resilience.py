@@ -311,7 +311,11 @@ def test_tpu_failover_matrix_matches_oracle(site, kind):
 @pytest.mark.parametrize("site,kind", [("pager.exchange", "raise"),
                                        ("pager.dispatch", "device-loss"),
                                        ("pager.device_get", "raise")])
-def test_pager_failover_matrix_matches_oracle(site, kind):
+def test_pager_failover_matrix_matches_oracle(site, kind, monkeypatch):
+    # pin per-gate dispatch: this matrix targets the per-gate sites
+    # (pager.exchange only exists there — fused windows run their
+    # ppermutes inside tpu.fuse.flush, covered by test_fusion.py)
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "1")
     res.enable()
     q = create_quantum_interface("pager", N, n_pages=4, rng=QrackRandom(3),
                                  rand_global_phase=False)
@@ -373,7 +377,8 @@ def test_failover_emits_telemetry():
     res.enable()
     q = create_quantum_interface("tpu", N)
     faults.inject("tpu.compile", "raise", after_n=0, times=None)
-    q.H(0)
+    q.H(0)      # queues in the lazy gate window — no dispatch yet
+    q.Prob(0)   # read boundary flushes; the compile fault fires HERE
     snap = tele.snapshot()
     assert snap["counters"].get("resilience.failovers", 0) >= 1
     assert any(e["name"].startswith("resilience.failover.")
@@ -395,7 +400,8 @@ def test_wide_pager_failover_exhausts_chain_loudly():
         for _ in range(br.threshold):
             br.record_failure("pager.dispatch")  # trip: blocks TPU hop too
         with pytest.raises(MemoryError):
-            q.H(0)
+            q.H(0)     # queues lazily; the dispatch (and the loud
+            q.Prob(0)  # chain-exhausted failure) surfaces at the read
     finally:
         set_config(max_cpu_qubits=old_cap)
 

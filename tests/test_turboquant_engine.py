@@ -181,10 +181,14 @@ def test_rotation_flattens_spiky_blocks():
 #  rides the mesh as b-bit codes — parallel/turboquant_pager.py)
 
 
-def test_sharded_turboquant_conformance():
+def test_sharded_turboquant_conformance(monkeypatch):
     """Pager-over-turboquant battery vs the dense oracle AND vs the
     single-device compressed engine (same blocks, same quantization —
-    the sharding must be numerically invisible)."""
+    the sharding must be numerically invisible).  Per-gate dispatch is
+    pinned: the sharded engine doesn't fuse, and the single-device
+    engine's windowed recompression rounds int16 codes differently —
+    the identical-math comparison needs identical op grouping."""
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "1")
     n, pages = 8, 4
     for seed in (3, 4):
         from qrack_tpu.parallel.turboquant_pager import QPagerTurboQuant
@@ -335,12 +339,15 @@ def test_structure_ops_width_accounting():
     assert fidelity(q.GetQuantumState(), o.GetQuantumState()) > 1 - 1e-6
 
 
-def test_gate_is_constant_dispatches():
+def test_gate_is_constant_dispatches(monkeypatch):
     """A gate on the compressed ket is O(1) jitted-program invocations
     regardless of chunk count (VERDICT r4 weak #2: the old host loop
-    dispatched per chunk and rebuilt the code array per gate)."""
+    dispatched per chunk and rebuilt the code array per gate).  Fusion
+    pinned off: this counts PER-GATE dispatches (with the lazy window
+    on, gates queue and the count at this line is 0)."""
     from qrack_tpu.engines import turboquant as tqe
 
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "1")
     q = QEngineTurboQuant(10, bits=8, chunk_qb=4, block_pow=2,
                           rng=QrackRandom(30), rand_global_phase=False)
     assert q._n_chunks() == 64
