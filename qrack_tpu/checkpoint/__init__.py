@@ -32,16 +32,17 @@ __all__ = [
     "save_container", "load_container",
     "capture", "restore_into", "build",
     "save_state", "load_state", "load_snapshot",
-    "CheckpointStore", "enable_warm_start", "ProgramManifest",
+    "CheckpointStore", "StoreLeaseHeld", "enable_warm_start",
+    "ProgramManifest",
 ]
 
 
 def __getattr__(name):
     # store/warmstart stay un-imported until first touched
-    if name == "CheckpointStore":
-        from .store import CheckpointStore
+    if name in ("CheckpointStore", "StoreLeaseHeld"):
+        from . import store
 
-        return CheckpointStore
+        return getattr(store, name)
     if name in ("enable_warm_start", "ProgramManifest"):
         from . import warmstart
 

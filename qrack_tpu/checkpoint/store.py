@@ -24,17 +24,33 @@ idle eviction or an outright crash:
   live engine still holds the state.  The current footprint is
   exported as the ``checkpoint.store.bytes`` gauge.
 
-All mutation happens on the serve executor thread (the same
-single-owner discipline as every other engine touch), so the store
-needs no locking beyond atomic manifest replacement for crash safety.
+Within one process, all mutation happens on the serve executor thread
+(the same single-owner discipline as every other engine touch).  ACROSS
+processes the store is a shared migration plane (docs/ELASTICITY.md):
+N services may point at one root, so
+
+* the manifest is **merge-on-write** under an ``flock`` on
+  ``<root>/.store.lock`` — each process only overlays the sessions it
+  OWNS (created/adopted here, tracked in ``_owned``) onto what is on
+  disk, and only deletes sids it explicitly unregistered
+  (``_dropped``), so two processes' manifests never clobber each other;
+* ``recover=True`` is gated by an **ownership lease** recorded in the
+  manifest (:meth:`acquire_lease`): exactly one process may replay the
+  WAL.  Liveness is pid-based on the same host (a kill -9'd owner frees
+  the lease instantly) with a TTL fallback across hosts
+  (``QRACK_CKPT_LEASE_TTL_S``, default 300 s).
 """
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
+import socket
 import tempfile
 import threading
+import time
+from contextlib import contextmanager
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -46,6 +62,11 @@ from .registry import load_state, save_state
 
 MANIFEST_VERSION = 1
 CIRCUIT_KIND = "qrack-circuit"
+DEFAULT_LEASE_TTL_S = 300.0
+
+
+class StoreLeaseHeld(CheckpointError):
+    """Another live process holds this store's recovery lease."""
 
 
 # -- circuit <-> container (WAL entries + warm-start program manifest) --
@@ -117,6 +138,13 @@ class CheckpointStore:
         os.makedirs(self._sessions_dir, exist_ok=True)
         os.makedirs(self._wal_dir, exist_ok=True)
         self._manifest_path = os.path.join(self.root, "manifest.json")
+        self._lock_path = os.path.join(self.root, ".store.lock")
+        # cross-process manifest ownership: only sids in _owned are
+        # overlaid from memory onto disk at write time; only sids in
+        # _dropped are deleted.  Everything else on disk belongs to
+        # some other process sharing this root and passes through.
+        self._owned: set = set()
+        self._dropped: set = set()
         self._manifest = self._read_manifest()
         # WAL appends come from submitter threads (everything else is
         # executor-thread-only); the sequence counter needs the lock
@@ -125,6 +153,19 @@ class CheckpointStore:
         self._update_gauge()
 
     # -- manifest ------------------------------------------------------
+
+    @contextmanager
+    def _file_lock(self):
+        """Advisory exclusive lock serializing manifest read-merge-write
+        cycles across every process sharing this root (flock works
+        between threads of one process too — each entry opens its own
+        file description)."""
+        with open(self._lock_path, "a+") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
 
     def _read_manifest(self) -> dict:
         try:
@@ -142,12 +183,14 @@ class CheckpointStore:
         m.setdefault("sessions", {})
         return m
 
-    def _write_manifest(self) -> None:
+    def _write_raw(self, manifest: dict) -> None:
+        """Atomic rewrite (tmp + fsync + os.replace) — call under
+        :meth:`_file_lock` when other processes may share the root."""
         fd, tmp = tempfile.mkstemp(prefix=".manifest-", suffix=".tmp",
                                    dir=self.root)
         try:
             with os.fdopen(fd, "w") as f:
-                json.dump(self._manifest, f, sort_keys=True)
+                json.dump(manifest, f, sort_keys=True)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._manifest_path)
@@ -158,9 +201,27 @@ class CheckpointStore:
                 pass
             raise
 
+    def _write_manifest(self) -> None:
+        """Merge-on-write: overlay only the sessions this process owns
+        onto the manifest currently on disk, preserving other processes'
+        records and the lease verbatim, then rewrite atomically."""
+        with self._file_lock():
+            disk = self._read_manifest()
+            sessions = disk["sessions"]
+            for sid in self._dropped:
+                sessions.pop(sid, None)
+            for sid in self._owned:
+                rec = self._manifest["sessions"].get(sid)
+                if rec is not None:
+                    sessions[sid] = rec
+            self._write_raw(disk)
+            self._manifest = disk
+
     def register(self, sid: str, width: int, layers, seed,
                  engine_kwargs: Optional[dict] = None) -> None:
         """Record a live session's constructor recipe for recovery."""
+        self._owned.add(sid)
+        self._dropped.discard(sid)
         self._manifest["sessions"][sid] = {
             "width": int(width),
             "layers": layers if isinstance(layers, str) else list(layers),
@@ -193,6 +254,8 @@ class CheckpointStore:
         return bool(rec.get("dirty", False)) if rec else False
 
     def unregister(self, sid: str) -> None:
+        self._owned.discard(sid)
+        self._dropped.add(sid)
         if self._manifest["sessions"].pop(sid, None) is not None:
             self._write_manifest()
         self.drop_state(sid)
@@ -201,8 +264,87 @@ class CheckpointStore:
                 self._unlink(path)
         self._update_gauge()
 
+    def disown(self, sid: str) -> None:
+        """Stop overlaying `sid` at manifest writes WITHOUT deleting its
+        record or state file — the drain handoff: the entry stays on
+        disk for whichever process adopts it, and this process's later
+        writes can no longer clobber the adopter's updates."""
+        self._owned.discard(sid)
+
+    def reload(self) -> None:
+        """Re-read shared disk state (manifest + WAL sequence).  An
+        adoption pass calls this first: another process may have drained
+        sessions into the store since this one last looked."""
+        with self._file_lock():
+            self._manifest = self._read_manifest()
+        with self._wal_lock:
+            self._wal_seq = max(self._wal_seq, self._scan_wal_seq())
+
     def sessions(self) -> Dict[str, dict]:
         return dict(self._manifest["sessions"])
+
+    # -- recovery lease (multi-process WAL-replay exclusivity) ---------
+
+    def _lease_live(self, lease: Optional[dict]) -> bool:
+        """Same-host pid liveness is authoritative (kill -9 frees the
+        lease the moment the pid is gone); cross-host falls back to the
+        recorded TTL."""
+        if not lease:
+            return False
+        if lease.get("host") == socket.gethostname() and lease.get("pid"):
+            try:
+                os.kill(int(lease["pid"]), 0)
+                return True
+            except (OSError, ValueError):
+                return False
+        return time.time() < float(lease.get("expires_at", 0))
+
+    def acquire_lease(self, owner: str, ttl_s: Optional[float] = None) -> bool:
+        """Take (or refresh) the store's recovery lease.  False when a
+        DIFFERENT live owner holds it — the caller must not replay the
+        WAL.  A dead owner's lease (pid gone / TTL expired) is claimed
+        over."""
+        if ttl_s is None:
+            ttl_s = float(os.environ.get("QRACK_CKPT_LEASE_TTL_S",
+                                         str(DEFAULT_LEASE_TTL_S)))
+        with self._file_lock():
+            disk = self._read_manifest()
+            cur = disk.get("lease")
+            if cur and cur.get("owner") != owner and self._lease_live(cur):
+                if _tele._ENABLED:
+                    _tele.inc("checkpoint.lease.denied")
+                    _tele.event("checkpoint.lease.denied", owner=owner,
+                                holder=str(cur.get("owner")))
+                return False
+            now = time.time()
+            disk["lease"] = {"owner": owner, "host": socket.gethostname(),
+                             "pid": os.getpid(), "acquired_at": now,
+                             "expires_at": now + ttl_s}
+            self._write_raw(disk)
+            self._manifest = disk
+        if _tele._ENABLED:
+            _tele.inc("checkpoint.lease.acquired")
+            _tele.event("checkpoint.lease.acquired", owner=owner)
+        return True
+
+    def release_lease(self, owner: str) -> bool:
+        """Drop the lease iff `owner` holds it (drain / clean shutdown
+        hand the store to the next process immediately)."""
+        with self._file_lock():
+            disk = self._read_manifest()
+            cur = disk.get("lease")
+            if not cur or cur.get("owner") != owner:
+                return False
+            del disk["lease"]
+            self._write_raw(disk)
+            self._manifest = disk
+        if _tele._ENABLED:
+            _tele.inc("checkpoint.lease.released")
+        return True
+
+    def lease_info(self) -> Optional[dict]:
+        lease = self._manifest.get("lease")
+        return dict(lease) if lease else None
 
     # -- session state (spill / checkpoint / restore) ------------------
 

@@ -113,14 +113,64 @@ class QHybrid:
 
     def _fail_over(self, cause) -> None:
         """In-place degradation: snapshot the ket off the failing engine
-        and continue the circuit on the next engine down (pager→tpu→cpu).
-        The ceiling sticks for this instance — a healed tunnel serves the
-        NEXT circuit, via the breaker's half-open probe."""
-        from ..resilience.failover import _engine_kind, fail_over_engine
+        and continue the circuit on the next engine down (elastic pager
+        shrink → tpu → cpu).  A tpu/cpu landing pins the ceiling; the
+        un-pin probe (:meth:`_maybe_recover`) lifts it at a later call
+        boundary once the device looks healthy again."""
+        from ..resilience.failover import fail_over_engine
 
         fallback = fail_over_engine(self._engine, cause)
-        self._failed_over = _engine_kind(fallback)
-        self._engine = fallback
+        self._commit_fallback(fallback)
+
+    def _commit_fallback(self, engine) -> None:
+        from ..resilience.failover import _engine_kind
+
+        self._engine = engine
+        kind = _engine_kind(engine)
+        if kind in ("tpu", "cpu"):
+            # a shrunk pager is NOT a ceiling — it re-expands on its own
+            # through the elastic probe; only terminal hops pin the mode
+            self._failed_over = kind
+
+    def _maybe_recover(self) -> None:
+        """Breaker-gated un-pin probe — the inverse of :meth:`_fail_over`
+        (docs/ELASTICITY.md).  At a call boundary: re-expand a degraded
+        pager in place, and when a tpu/cpu ceiling is pinned but the
+        health probe passes, rebuild the width-appropriate engine and
+        carry state+rng onto it, re-adopting the recovered device
+        instead of staying down until process restart."""
+        from ..resilience import elastic as _elastic
+
+        eng = self._engine
+        if getattr(eng, "_elastic_target_g", None) is not None:
+            _elastic.maybe_reexpand(eng)
+        if self._failed_over is None:
+            return
+        if not _elastic.health_probe():
+            return
+        prev = self._failed_over
+        self._failed_over = None
+        n = self._engine.qubit_count
+        want = self._mode_for(n)
+        have = (
+            "cpu" if isinstance(self._engine, QEngineCPU)
+            else "tpu" if isinstance(self._engine, QEngineTPU)
+            else "pager"
+        )
+        if want == have:
+            return  # ceiling lifted; the current engine already fits
+        try:
+            state = self._engine.GetQuantumState()
+            rng = self._engine.rng
+            new = self._make_engine(n)  # re-pins the ceiling on failure
+            new.rng = rng
+            new.SetQuantumState(state)
+            self._engine = new
+            if _tele._ENABLED:
+                _tele.event(f"hybrid.unpin.{prev}_to_{want}", width=n)
+                _tele.inc("elastic.hybrid.unpinned")
+        except _res.FAILOVER_ERRORS:
+            self._failed_over = prev
 
     def __getattr__(self, name):
         val = getattr(self._engine, name)
@@ -128,11 +178,20 @@ class QHybrid:
             return val
 
         def call(*args, **kwargs):
+            if (self._failed_over is not None
+                    or getattr(self._engine, "_elastic_target_g", None)
+                    is not None):
+                self._maybe_recover()
             try:
                 return getattr(self._engine, name)(*args, **kwargs)
             except _res.FAILOVER_ERRORS as e:
-                self._fail_over(e)
-                return getattr(self._engine, name)(*args, **kwargs)
+                from ..resilience.failover import replay_with_failover
+
+                _, out = replay_with_failover(
+                    self._engine, e,
+                    lambda fb: getattr(fb, name)(*args, **kwargs),
+                    commit=self._commit_fallback)
+                return out
 
         return call
 

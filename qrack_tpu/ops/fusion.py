@@ -394,13 +394,34 @@ class GateStreamFuser:
     def flush(self, reason: str = "read") -> None:
         """Lower + dispatch the pending window (guarded site
         ``tpu.fuse.flush``).  No-op when empty or re-entered (the
-        engine's state getter fires during the flush's own dispatch)."""
+        engine's state getter fires during the flush's own dispatch).
+
+        Elastic recovery happens HERE, not at the wrapper's failover
+        replay: when the dispatch escalates and the engine can shrink
+        (QPager, docs/ELASTICITY.md), re-page in place and re-dispatch
+        the SAME kept window.  The re-entry guard keeps the shrink's
+        state gather raw (no recursive flush), so the gathered ket
+        excludes the window and the retry applies it exactly once —
+        a wrapper-level replay of the *triggering call* could not
+        distinguish gates already captured by the failover snapshot."""
         if not self.gates or self._flushing:
             return
         eng = self.engine
         self._flushing = True
         try:
-            dispatched = eng._fuse_flush(self.gates)
+            while True:
+                try:
+                    dispatched = eng._fuse_flush(self.gates)
+                    break
+                except Exception as e:  # noqa: BLE001 — filtered below
+                    from ..resilience.errors import FAILOVER_ERRORS
+
+                    if not isinstance(e, FAILOVER_ERRORS):
+                        raise
+                    can_shrink = getattr(eng, "can_shrink", None)
+                    if can_shrink is None or not can_shrink():
+                        raise  # wrapper-level failover takes over
+                    eng.shrink_pages()
         finally:
             self._flushing = False
         raw = self._raw

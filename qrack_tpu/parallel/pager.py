@@ -157,6 +157,10 @@ class QPager(QEngine):
         self.g_bits = log2(n_pages)
         self._max_g = self.g_bits
         self._all_devices = dev_list
+        # elastic degradation marker: construction page exponent to grow
+        # back to, set by shrink_pages, cleared by expand_pages (None =
+        # healthy).  docs/ELASTICITY.md
+        self._elastic_target_g: Optional[int] = None
         self._check_capacity(qubit_count)
         self.dtype = jnp.dtype(dtype)
         self.mesh = Mesh(np.array(dev_list), ("pages",))
@@ -928,6 +932,126 @@ class QPager(QEngine):
                 f"QPager page width {qubit_count - self.g_bits} exceeds a "
                 "single shard; add devices/pages or stack QUnit above")
         return self.sharding
+
+    # ------------------------------------------------------------------
+    # elastic re-paging (docs/ELASTICITY.md): on device loss, halve the
+    # page count and keep serving on the surviving device prefix; on
+    # recovery (health probe at a call boundary), grow back.  Distinct
+    # from _sharding_for's width-driven rebalance: these transitions are
+    # fault-driven and move the page-count CEILING (_max_g), so every
+    # later width change respects the degraded capacity too.
+    # ------------------------------------------------------------------
+
+    #: optional zero-arg probe override — set on an INSTANCE (tests,
+    #: soak harnesses); None = the shared resilience/elastic.py probe
+    elastic_probe = None
+
+    @property
+    def elastic_degraded(self) -> bool:
+        return self._elastic_target_g is not None
+
+    def can_shrink(self) -> bool:
+        """True when a 2^g → 2^(g-1) re-shard is possible: more than
+        one page left and the doubled local width still fits a shard."""
+        return (self.n_pages > 1
+                and (self.qubit_count - (self.g_bits - 1)) <= 30)
+
+    def shrink_pages(self, state=None) -> "QPager":
+        """Re-shard from 2^g to 2^(g-1) pages onto the surviving device
+        prefix, in place.  ``state`` is the already-captured ket (the
+        failover snapshot path hands it in so nothing re-reads the
+        failing mesh); None gathers it here through the guarded-read
+        suspension, same as a failover snapshot would."""
+        if not self.can_shrink():
+            raise MemoryError(
+                f"QPager cannot shrink below {self.n_pages} page(s) at "
+                f"width {self.qubit_count}")
+        new_g = self.g_bits - 1
+        if self._elastic_target_g is None:
+            self._elastic_target_g = self._max_g
+        if state is not None:
+            devs = self._all_devices[: 1 << new_g]
+            mesh = Mesh(np.array(devs), ("pages",))
+            sharding = NamedSharding(mesh, P(None, "pages"))
+            st = np.asarray(state).reshape(-1)
+            planes = jax.device_put(gk.to_planes(st, self.dtype), sharding)
+            self.n_pages = 1 << new_g
+            self.g_bits = new_g
+            self.mesh = mesh
+            self.sharding = sharding
+            self._state = planes
+        else:
+            self._repage(new_g)
+        self._max_g = new_g
+        if _tele._ENABLED:
+            # event() bumps the same-named counter itself
+            _tele.event("elastic.repage.shrink", pages=self.n_pages,
+                        target_pages=1 << self._elastic_target_g)
+            _tele.gauge("elastic.pages", self.n_pages)
+        return self
+
+    def _repage(self, new_g: int) -> None:
+        """Gather the whole ket and re-split it across 2^new_g pages.
+        Exception-safe: the new mesh/sharding/state are built in locals
+        and committed only after the device_put lands, so a failed
+        re-shard leaves the current working topology untouched."""
+        with _res.faults.suspended():
+            # suspension: the gather must not advance fault-spec call
+            # counters (a probe would change when a flap fires) nor be
+            # refused by an open breaker — same discipline as failover
+            # snapshots (docs/RESILIENCE.md caveats)
+            planes = self._fetch(0, 1 << self.qubit_count)
+        devs = self._all_devices[: 1 << new_g]
+        mesh = Mesh(np.array(devs), ("pages",))
+        sharding = NamedSharding(mesh, P(None, "pages"))
+        new_state = jax.device_put(
+            np.asarray(planes, dtype=self.dtype), sharding)
+        self.n_pages = 1 << new_g
+        self.g_bits = new_g
+        self.mesh = mesh
+        self.sharding = sharding
+        self._state = new_state
+
+    def expand_pages(self) -> bool:
+        """Grow back toward the construction page count.  True on
+        success (or when already healthy); on failure the pager STAYS
+        degraded-but-serving at its current size and returns False."""
+        target = self._elastic_target_g
+        if target is None:
+            return True
+        self._max_g = target
+        new_g = self._desired_g(self.qubit_count)
+        try:
+            if new_g != self.g_bits:
+                self._repage(new_g)
+        except Exception:
+            self._max_g = self.g_bits
+            if _tele._ENABLED:
+                _tele.inc("elastic.repage.expand_failed")
+                _tele.gauge("elastic.pages", self.n_pages)
+            return False
+        self._elastic_target_g = None
+        if _tele._ENABLED:
+            _tele.event("elastic.repage.expand", pages=self.n_pages)
+            _tele.gauge("elastic.pages", self.n_pages)
+        return True
+
+    def maybe_reexpand(self) -> bool:
+        """Call-boundary hook (ResilientEngine / QHybrid / the serve
+        executor): expand when degraded AND the health probe passes.
+        One attribute test when healthy — cheap enough for hot paths."""
+        if self._elastic_target_g is None:
+            return False
+        probe = self.__dict__.get("elastic_probe") or type(self).elastic_probe
+        if probe is not None:
+            if not probe():
+                return False
+        else:
+            from ..resilience import elastic as _elastic
+
+            if not _elastic.health_probe():
+                return False
+        return self.expand_pages()
 
     # ------------------------------------------------------------------
     # structure-aware lossy checkpoints (reference: per-page streams +

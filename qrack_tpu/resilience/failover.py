@@ -11,10 +11,18 @@ still COMPLETE, just slower.  The mechanism:
    ``faults.suspended()`` so a device_get fault cannot block its own
    recovery),
 2. build the next engine in the fallback chain
-   (``QPager → QEngineTPU`` (width permitting, breaker willing)
-   ``→ QEngineCPU``), carrying the rng so measurement streams
-   continue unbroken,
-3. rehydrate via ``SetQuantumState`` and replay the ONE failed call.
+   (``QPager @ 2^k pages → QPager @ 2^(k-1) pages`` (elastic shrink,
+   in place on the surviving device prefix) ``→ QEngineTPU`` (width
+   permitting, breaker willing) ``→ QEngineCPU``), carrying the rng so
+   measurement streams continue unbroken,
+3. rehydrate via ``SetQuantumState`` and replay the failed call.
+
+The elastic shrink step keeps a faulting pager ON the mesh, so a
+persistent fault re-fires on the shrunk engine's replay — recovery is
+therefore a LOOP (:func:`replay_with_failover`) that keeps descending
+the strictly-shrinking chain until the replay lands or the chain is
+exhausted.  Degraded pagers grow back at call boundaries through the
+health probe in resilience/elastic.py (docs/ELASTICITY.md).
 
 Because every injected fault fires at site entry and real XLA errors
 surface before results commit (see dispatch.py), the snapshot equals
@@ -92,6 +100,13 @@ def _fallback_candidates(engine):
 
     kind = _engine_kind(engine)
     n = engine.qubit_count
+    if kind == "pager" and getattr(engine, "can_shrink", None) \
+            and engine.can_shrink():
+        # elastic first: halve the page count onto the surviving device
+        # prefix and stay on the mesh (docs/ELASTICITY.md).  Mutates the
+        # SAME engine object; the snapshot the caller took is handed in
+        # so nothing re-reads the failing topology.
+        yield "pager_shrunk", lambda st, rng: engine.shrink_pages(state=st)
     if kind == "pager" and n <= MAX_DENSE_QB \
             and _breaker.get_breaker().state == "closed":
         # single-device TPU is only worth trying when the tunnel is not
@@ -133,13 +148,41 @@ def fail_over_engine(engine, cause: Optional[BaseException] = None):
         f"no failover target for {src} width {engine.qubit_count}")
 
 
+def replay_with_failover(engine, cause, replay, commit=None, max_steps=16):
+    """Descend the failover chain until the failed call replays cleanly;
+    returns ``(engine, result)``.
+
+    One transition is no longer guaranteed to be enough: the elastic
+    shrink candidate keeps a faulting pager on the mesh, so a persistent
+    fault re-fires on the shrunk engine's replay.  Each iteration moves
+    strictly down the chain (2^k pages → … → 1 page → tpu → cpu), so the
+    loop terminates — :func:`fail_over_engine` raises when the chain is
+    exhausted, and `max_steps` is a backstop well past any real depth.
+    ``commit(new_engine)`` runs after EVERY transition so the caller's
+    reference is durable even when the subsequent replay fails too.
+    """
+    err = cause
+    for _ in range(max_steps):
+        engine = fail_over_engine(engine, err)
+        if commit is not None:
+            commit(engine)
+        try:
+            return engine, replay(engine)
+        except FAILOVER_ERRORS as e:
+            err = e
+    raise err
+
+
 class ResilientEngine:
     """Forwarding proxy: any engine method that escalates with a
-    FAILOVER_ERRORS exception is transparently re-run once on the
-    fallback engine (state snapshotted pre-call — see module doc).
-    After failover all subsequent calls go to the fallback; the proxy
-    never fails back (a healed tunnel is the NEXT circuit's business,
-    via the breaker's half-open probe on a fresh engine)."""
+    FAILOVER_ERRORS exception is transparently replayed down the
+    failover chain (state snapshotted pre-call — see module doc) until
+    it lands.  After a terminal failover (tpu/cpu) subsequent calls stay
+    on the fallback — a healed tunnel is the NEXT circuit's business,
+    via the breaker's half-open probe on a fresh engine.  An ELASTIC
+    failover (pager shrink) does grow back: while the wrapped pager is
+    degraded, every call boundary probes for recovery and re-expands in
+    place (resilience/elastic.py)."""
 
     def __init__(self, engine):
         object.__setattr__(self, "_engine", engine)
@@ -175,11 +218,21 @@ class ResilientEngine:
             return val
 
         def call(*args, **kwargs):
+            eng = object.__getattribute__(self, "_engine")
+            if getattr(eng, "_elastic_target_g", None) is not None:
+                # degraded pager: one probe per call boundary, growing
+                # back to full page count as soon as the device returns
+                from . import elastic as _elastic
+
+                _elastic.maybe_reexpand(eng)
             try:
                 return getattr(self._engine, name)(*args, **kwargs)
             except FAILOVER_ERRORS as e:
-                self._fail_over(e)
-                return getattr(self._engine, name)(*args, **kwargs)
+                _, out = replay_with_failover(
+                    self._engine, e,
+                    lambda fb: getattr(fb, name)(*args, **kwargs),
+                    commit=lambda fb: object.__setattr__(self, "_engine", fb))
+                return out
 
         call.__name__ = name
         return call

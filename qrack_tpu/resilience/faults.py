@@ -9,12 +9,21 @@ Grammar (``QRACK_TPU_FAULTS``, comma-separated specs):
   (``discover``, ``compile``, ``dispatch``, ``device_get``,
   ``exchange``), or ``*`` for every site.
 * ``kind`` — ``timeout`` | ``hang`` | ``raise`` | ``nan-poison`` |
-  ``device-loss``.
+  ``device-loss`` | ``flap`` | ``torn-write``.
 * ``after_n`` — how many calls at the site pass through before the
   fault arms.  ``N`` fires once at call N+1 then heals (the transient
   case retry must recover); ``N+M`` fires on M consecutive calls;
   ``N+`` never heals (the persistent case that must trip the breaker
   or fail over).
+
+``flap`` is device-loss with declarative auto-recovery: it raises
+:class:`DeviceLost` at site entry exactly like ``device-loss``, but is
+meant to be written with a bounded window (``site:flap:N+M`` — the
+device is down for M calls starting at call N+1, then healthy again),
+which makes shrink→expand round-trips deterministic in tests.  While
+either kind's window is open, :func:`device_down` reports the device
+as unhealthy so the elastic recovery probe (resilience/elastic.py)
+refuses to re-expand onto it.
 * ``seed`` — optional; when set, each armed call fires with
   probability 1/2 drawn from a PCG64(seed) stream private to the spec
   (deterministic given the seed — scripts/fault_soak.py uses this).
@@ -47,7 +56,7 @@ from .. import telemetry as _tele
 from .errors import (DeviceLost, DispatchFailure, InjectedFault, NaNPoisoned)
 
 KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss",
-         "torn-write")
+         "flap", "torn-write")
 
 # every call_guarded site in the tree (grep '"<name>"' call_guarded /
 # instrument_dispatch / guard_callable call sites when adding one) —
@@ -183,6 +192,29 @@ def is_suspended() -> bool:
         return _SUSPENDED > 0
 
 
+def device_down(site: Optional[str] = None) -> bool:
+    """True while an armed ``device-loss``/``flap`` spec still has fires
+    left — the injected analogue of "the device is unhealthy right now".
+    Read-only: does NOT advance call counters, so probing never changes
+    when a fault fires.  The elastic recovery probe consults this before
+    re-expanding onto a flapped device; a ``flap`` written as ``N+M``
+    reads down for the M-call window and healthy after it heals."""
+    with _LOCK:
+        if _SUSPENDED:
+            return False
+        for spec in _SPECS:
+            if spec.kind not in ("device-loss", "flap"):
+                continue
+            if site is not None and not spec.matches(site):
+                continue
+            if spec.calls < spec.after_n:
+                continue  # window not open yet
+            if spec.times is not None and spec.fired >= spec.times:
+                continue  # healed
+            return True
+    return False
+
+
 class suspended:
     """Re-entrant context manager standing down the WHOLE resilience
     machinery (injection here; breaker/watchdog via dispatch.py checking
@@ -235,6 +267,8 @@ def check(site: str) -> Optional[str]:
         raise DispatchTimeout(site, detail="injected timeout")
     if fired_kind == "device-loss":
         raise DeviceLost(site, "injected device loss")
+    if fired_kind == "flap":
+        raise DeviceLost(site, "injected device flap")
     if fired_kind == "nan-poison":
         raise NaNPoisoned(site, "injected non-finite output")
     raise InjectedFault(site, "injected failure")

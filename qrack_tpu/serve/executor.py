@@ -87,6 +87,18 @@ class Executor:
         for job in batch:
             if job.session is not None and job.session.spilled:
                 self.sessions.ensure_resident(job.session)
+        # job boundaries are the serve-path recovery probe: a session
+        # whose pager shrank under device loss grows back to its
+        # construction page count here once the device looks healthy
+        from .. import resilience as _res
+
+        if _res._ACTIVE:
+            from ..resilience import elastic as _elastic
+
+            for job in batch:
+                sess = job.session
+                if sess is not None and sess.engine is not None:
+                    _elastic.maybe_reexpand(sess.engine)
         if batch[0].batchable:
             self._run_batched(batch)
         else:
@@ -147,19 +159,26 @@ class Executor:
         planes were never donated into the failed batch (the stack is a
         copy) and _run_batched restored them if the batch had already
         written back, so each snapshot equals the pre-batch state and
-        the replay is exact."""
-        from ..resilience.failover import fail_over_engine
+        the replay is exact.  replay_with_failover walks the whole
+        elastic chain (pager shrink → … → tpu → cpu) when the fault
+        persists across replays."""
+        from ..resilience.failover import replay_with_failover
 
         if _tele._ENABLED:
             _tele.inc("serve.batch.failovers")
         for job in jobs:
             sess = job.session
+
+            def commit(eng, sess=sess):
+                sess.engine = eng
+                sess.failovers += 1
+
             try:
                 target = planes_engine(sess.engine) or sess.engine
-                fallback = fail_over_engine(target, cause)
-                sess.engine = fallback
-                sess.failovers += 1
-                job.circuit.Run(fallback)
+                replay_with_failover(
+                    target, cause,
+                    lambda eng, job=job: job.circuit.Run(eng),
+                    commit=commit)
             except BaseException as e:  # noqa: BLE001 — chain exhausted
                 job.handle._fail(e)
                 self._account(job, ok=False)
@@ -187,16 +206,25 @@ class Executor:
             with _tele.span("serve.execute"):
                 result = body()
         except FAILOVER_ERRORS as e:
-            # engine-internal guarded sites escalated: fail the session
-            # over and replay the one job on the fallback
-            from ..resilience.failover import fail_over_engine
+            # engine-internal guarded sites escalated: walk the session
+            # down the elastic chain, replaying the one job after every
+            # transition until it lands
+            from ..resilience.failover import replay_with_failover
+
+            def commit(eng):
+                sess.engine = eng
+                sess.failovers += 1
+
+            def replay(eng):
+                if job.kind == "circuit":
+                    job.circuit.Run(eng)
+                    return None
+                return job.fn(eng)
 
             try:
-                fallback = fail_over_engine(
-                    planes_engine(sess.engine) or sess.engine, e)
-                sess.engine = fallback
-                sess.failovers += 1
-                result = body()
+                _, result = replay_with_failover(
+                    planes_engine(sess.engine) or sess.engine, e,
+                    replay, commit=commit)
             except BaseException as e2:  # noqa: BLE001
                 job.handle._fail(e2)
                 self._account(job, ok=False)

@@ -40,8 +40,11 @@ checkpoint package only loads when a checkpoint dir is configured.
 from __future__ import annotations
 
 import os
+import socket
+import uuid
 from typing import Callable, Optional, Sequence, Union
 
+from .. import telemetry as _tele
 from ..resilience import breaker as _breaker
 from .batcher import stats as _batch_stats
 from .errors import SessionNotFound
@@ -91,6 +94,12 @@ class QrackService:
         self.default_engine_kwargs = engine_kwargs
         self.store = None
         self.program_manifest = None
+        # recovery-lease identity: host+pid let a peer on the same host
+        # detect a dead holder; the suffix disambiguates two services in
+        # one process (docs/ELASTICITY.md)
+        self._owner = (f"{socket.gethostname()}:{os.getpid()}:"
+                       f"{uuid.uuid4().hex[:6]}")
+        self.lease_held = False
         if checkpoint_dir:
             # the only import of qrack_tpu.checkpoint on the serve path —
             # the subsystem costs nothing unless a dir is configured
@@ -118,8 +127,19 @@ class QrackService:
                                  tick_s=tick_s, sync=sync)
         self.executor.start()
         self._closed = False
+        if self.store is not None:
+            # best-effort: a second process sharing the store serves its
+            # own sessions fine without the lease — only recover/adopt
+            # (WAL replay exclusivity) requires holding it
+            self.lease_held = self.store.acquire_lease(self._owner)
         if self.store is not None and recover:
-            self.recover()
+            try:
+                self.recover()
+            except BaseException:
+                # don't leak the daemon executor thread when startup
+                # recovery is refused (e.g. StoreLeaseHeld)
+                self.close()
+                raise
         if self.program_manifest is not None and prewarm:
             self.prewarm()
 
@@ -267,12 +287,31 @@ class QrackService:
         never persisted is rebuilt cold with its WAL entries dropped and
         its sid reported under ``recovered_stale`` so the caller can
         reset or notify the tenant instead of silently serving a state
-        that matches neither pre-crash nor fresh."""
+        that matches neither pre-crash nor fresh.
+
+        Recovery requires the store's ownership lease: two processes
+        sharing a checkpoint dir must never both replay the same WAL.
+        Raises :class:`~qrack_tpu.checkpoint.StoreLeaseHeld` while a
+        live peer holds it — drain or stop that process first."""
         if self.store is None:
             raise RuntimeError("checkpointing is not enabled "
                                "(QRACK_SERVE_CHECKPOINT_DIR)")
+        if not self.lease_held:
+            self.lease_held = self.store.acquire_lease(self._owner)
+        if not self.lease_held:
+            from ..checkpoint.store import StoreLeaseHeld
+
+            lease = self.store.lease_info() or {}
+            raise StoreLeaseHeld(
+                "recovery refused: store lease held by "
+                f"{lease.get('owner', '<unknown>')} — drain or stop that "
+                "process before adopting its sessions")
 
         def do():
+            # re-read the shared manifest under the cross-process lock:
+            # a draining peer may have handed sessions over since our
+            # constructor snapshotted it
+            self.store.reload()
             recovered, stale, replayed, skipped = [], [], 0, 0
             # snapshot the manifest first: re-creating a session below
             # re-registers it, which resets its dirty flag
@@ -313,6 +352,47 @@ class QrackService:
         self.scheduler.submit(job)
         return job.handle.result(timeout)
 
+    def drain(self, timeout: float = 600.0) -> dict:
+        """Hand every idle session over to the checkpoint plane: persist
+        its state, keep its manifest record on disk, and release it from
+        THIS process — a peer sharing the store adopts the set with
+        ``recover=True`` (docs/ELASTICITY.md).  Sessions with jobs still
+        in flight are reported ``busy`` and kept.  When nothing stays
+        behind, the recovery lease is released so the adopter's
+        ``recover()`` is admitted immediately.  Runs as ONE admin job so
+        no tenant job interleaves: the handed-over set is a consistent
+        point-in-time cut."""
+        if self.store is None:
+            raise RuntimeError("checkpointing is not enabled "
+                               "(QRACK_SERVE_CHECKPOINT_DIR)")
+
+        def do():
+            drained, busy = [], []
+            for sid in self.sessions.ids():
+                sess = self.sessions.get(sid)
+                if sess.inflight > 0:
+                    busy.append(sid)
+                    continue
+                if not sess.spilled:  # spilled = already durable
+                    self.store.save(sid, sess.engine)
+                # stop overlaying the record on future manifest writes
+                # (the adopter owns it now), then forget it locally
+                self.store.disown(sid)
+                self.sessions.release(sid)
+                drained.append(sid)
+            if not busy and self.lease_held:
+                self.store.release_lease(self._owner)
+                self.lease_held = False
+            if _tele._ENABLED:
+                _tele.inc("serve.drained", len(drained))
+                _tele.event("serve.drain", drained=len(drained),
+                            busy=len(busy))
+            return {"drained": drained, "busy": busy}
+
+        job = Job(None, "admin", fn=do)
+        self.scheduler.submit(job)
+        return job.handle.result(timeout)
+
     def prewarm(self, timeout: float = 600.0) -> int:
         """Pre-trace every program the manifest recorded (admin job —
         compilation is device traffic).  With the persistent XLA cache
@@ -335,6 +415,9 @@ class QrackService:
         }
         if self.store is not None:
             out["checkpoint_store"] = self.store.stats()
+            out["lease"] = {"owner": self._owner,
+                            "held": self.lease_held,
+                            "store": self.store.lease_info()}
         return out
 
     def close(self) -> None:
@@ -343,6 +426,12 @@ class QrackService:
         self._closed = True
         self.scheduler.stop()
         self.executor.stop()
+        if self.store is not None and self.lease_held:
+            try:
+                self.store.release_lease(self._owner)
+            except Exception:  # noqa: BLE001 — close never raises
+                pass
+            self.lease_held = False
 
     def __enter__(self) -> "QrackService":
         return self

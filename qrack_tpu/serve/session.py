@@ -219,6 +219,20 @@ class SessionManager:
             _tele.inc("serve.session.destroyed")
             _tele.gauge("serve.sessions.active", len(self._sessions))
 
+    def release(self, sid: str) -> None:
+        """Drop `sid` from THIS process without touching the store: the
+        manifest entry and state file survive for whichever process
+        adopts the session next (the drain handoff — QrackService.drain
+        persists state and disowns the sid before calling this)."""
+        with self._lock:
+            sess = self._sessions.pop(sid, None)
+        if sess is None:
+            raise SessionNotFound(sid)
+        if _tele._ENABLED:
+            _tele.inc("serve.session.released")
+            _tele.event("serve.session.release", sid=sid)
+            _tele.gauge("serve.sessions.active", len(self._sessions))
+
     def evict_idle(self) -> List[str]:
         """Spill (with a store) or drop sessions idle past the budget
         with nothing in flight.  Called from the executor's idle ticks

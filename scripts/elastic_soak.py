@@ -1,0 +1,338 @@
+"""Elastic-capacity soak: device loss/flap mid-serve plus kill -9 of
+one of two serving processes, vs per-session CPU oracles.
+
+Two trial kinds interleave (one third are handoffs):
+
+* **elastic** — in-process: pager-backed sessions driven with the
+  tests/test_fuzz_api.py op vocabulary while a ``device-loss`` or
+  ``flap`` spec is armed on a pager site.  The staircase re-pages the
+  session down (4 -> 2 -> 1 pages), jobs keep completing degraded, and
+  the job-boundary recovery probe grows it back once the window heals.
+  The trial asserts oracle equivalence AND that the topology round-
+  tripped (final page count = construction, ``elastic.repage.*``
+  counters moved when the fault actually fired).  The fusion window
+  alternates 1 / 16 so both the eager path and the flush-level
+  exactly-once retry (ops/fusion.py) are exercised.
+
+* **handoff** — two processes: a child serving process (this script,
+  ``--hold`` mode) applies per-session streams against a shared
+  checkpoint store, checkpoints everything, journals one QFT per
+  session to the WAL, then parks holding the recovery lease.  The
+  parent kill -9's it and adopts through the checkpoint plane
+  (``recover()``): pid liveness frees the lease, every WAL entry
+  replays exactly once (the dead child never ran them), and every
+  session's state must match a CPU oracle of stream+QFT.
+
+Usage:
+    python scripts/elastic_soak.py [trials] [seed]
+
+Defaults: 24 trials, seed 0.  Exit 0 = all trials oracle-equivalent.
+One JSON line per trial; `python scripts/elastic_soak.py 1 <seed>`
+after editing the range reproduces a failure.  The slow-marked
+tests/test_serve.py::test_elastic_soak_smoke runs a 3-trial slice.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
+
+pin_host_cpu(8)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import QEngineCPU  # noqa: E402
+from qrack_tpu import resilience as res  # noqa: E402
+from qrack_tpu import telemetry as tele  # noqa: E402
+from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
+from qrack_tpu.resilience.breaker import CircuitBreaker  # noqa: E402
+from qrack_tpu.serve import QrackService  # noqa: E402
+from qrack_tpu.serve.errors import LoadShed, QueueFull  # noqa: E402
+from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
+
+sys.path.insert(0, os.path.join(REPO, "tests"))
+from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
+
+STACKS = [("cpu", {}), ("tpu", {}), ("pager", {"n_pages": 4})]
+
+
+def _streams(trial: int, seed: int, n_sessions: int, n_items: int = 8):
+    """Deterministic per-session op streams — the child serving process
+    and the parent's oracles must regenerate these IDENTICALLY, so the
+    generator depends only on (trial, seed)."""
+    rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
+    streams = []
+    for _ in range(n_sessions):
+        stream = []
+        for _ in range(n_items):
+            if rng.random() < 0.25:
+                stream.append(("circ",))  # qft_qcircuit(N), built at use
+            else:
+                name, args = _ops(rng)
+                if name == "SetBit":  # cross-stack rng streams diverge
+                    continue
+                stream.append(("op", name, args))
+        streams.append(stream)
+    return streams
+
+
+def _apply_to_oracle(oracle, stream) -> None:
+    for item in stream:
+        if item[0] == "circ":
+            qft_qcircuit(N).Run(oracle)
+        else:
+            getattr(oracle, item[1])(*item[2])
+
+
+def _submit_retry(fn, tries: int = 200):
+    for _ in range(tries):
+        try:
+            return fn()
+        except (LoadShed, QueueFull) as e:
+            time.sleep(min(getattr(e, "retry_in_s", 0.0) or 0.02, 0.1))
+    raise RuntimeError(f"admission retries exhausted after {tries} tries")
+
+
+def _fidelity(a, b) -> float:
+    a, b = np.asarray(a), np.asarray(b)
+    return float(abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
+                                            * np.vdot(b, b).real))
+
+
+# -- trial kind 1: in-process device loss / flap on the pager ----------
+
+
+def run_elastic_trial(trial: int, seed: int) -> dict:
+    frng = np.random.Generator(np.random.PCG64((seed << 21) + trial))
+    window = 1 if trial % 4 < 2 else 16
+    flap = bool(frng.integers(0, 2))
+    # persistent loss stays on pager.exchange: that site vanishes once
+    # every qubit is local, so the staircase lands at 1 page instead of
+    # escalating off the pager entirely (we assert on the pager's state)
+    site = ("pager.exchange" if not flap else
+            ["pager.exchange", "pager.dispatch"][int(frng.integers(0, 2))])
+    after_n = int(frng.integers(0, 6))
+    times = int(frng.integers(1, 4)) if flap else None
+    n_sessions = 2
+    info = {"trial": trial, "kind": "elastic", "window": window,
+            "fault": f"{site}:{'flap' if flap else 'device-loss'}",
+            "after_n": after_n, "times": times}
+
+    os.environ["QRACK_TPU_FUSE_WINDOW"] = str(window)
+    res.faults.clear()
+    res.reset_breaker(CircuitBreaker(threshold=4, cooldown_s=0.05))
+    res.configure(max_retries=2, backoff_s=0.0, timeout_s=0.0)
+    res.enable()
+    tele.enable()
+    tele.reset()
+    svc = None
+    try:
+        svc = QrackService(batch_window_ms=5.0, max_depth=64,
+                           queue_budget_ms=60_000.0, tick_s=0.05)
+        streams = _streams(trial, seed, n_sessions)
+        sids, oracles = [], []
+        for k in range(n_sessions):
+            sess_seed = (trial << 4) + k
+            sids.append(svc.create_session(N, layers="pager", n_pages=4,
+                                           seed=sess_seed,
+                                           rand_global_phase=False))
+            oracle = QEngineCPU(N, rng=QrackRandom(sess_seed),
+                                rand_global_phase=False)
+            _apply_to_oracle(oracle, streams[k])
+            oracles.append(oracle)
+        if flap:
+            res.faults.inject(site, "flap", after_n=after_n, times=times)
+        else:
+            res.faults.inject(site, "device-loss", after_n=after_n,
+                              times=None)
+        # interleave across sessions so degraded serving is contended
+        cursors, handles = [0] * n_sessions, []
+        live = [k for k in range(n_sessions) if streams[k]]
+        while live:
+            k = live[int(frng.integers(0, len(live)))]
+            item, sid = streams[k][cursors[k]], sids[k]
+            if item[0] == "circ":
+                handles.append(_submit_retry(
+                    lambda s=sid: svc.submit(s, qft_qcircuit(N))))
+            else:
+                _, name, args = item
+
+                def do(eng, name=name, args=args):
+                    return getattr(eng, name)(*args)
+
+                handles.append(_submit_retry(
+                    lambda s=sid, f=do: svc.call(s, f)))
+            cursors[k] += 1
+            if cursors[k] >= len(streams[k]):
+                live.remove(k)
+        for h in handles:
+            h.result(timeout=120)
+        # degraded-serving evidence: with the loss window still open the
+        # pager must be at reduced pages yet answering jobs
+        fired = sum(sp.fired for sp in res.faults.specs())
+        degraded = [_submit_retry(
+            lambda s=sid: svc.call(s, lambda e: (
+                getattr(e, "n_pages", None),
+                bool(getattr(e, "elastic_degraded", False))))
+        ).result(timeout=120) for sid in sids]
+        info["degraded_after_stream"] = degraded
+        if not flap and fired:
+            assert any(d[1] for d in degraded), degraded
+        # heal -> the next job boundary must re-expand every pager
+        res.faults.clear()
+        final = [_submit_retry(
+            lambda s=sid: svc.call(s, lambda e: (
+                getattr(e, "n_pages", None),
+                bool(getattr(e, "elastic_degraded", False))))
+        ).result(timeout=120) for sid in sids]
+        assert all(d == (4, False) for d in final), final
+        fids = []
+        for sid, oracle in zip(sids, oracles):
+            got = _submit_retry(lambda s=sid: svc.call(
+                s, lambda e: e.GetQuantumState())).result(timeout=120)
+            fids.append(_fidelity(oracle.GetQuantumState(), got))
+        snap = tele.snapshot()["counters"]
+        info["fired"] = fired
+        info["repage_shrink"] = snap.get("elastic.repage.shrink", 0)
+        info["repage_expand"] = snap.get("elastic.repage.expand", 0)
+        if fired:  # a fired loss must have forced at least one repage
+            assert info["repage_shrink"] >= 1, info
+            assert info["repage_expand"] >= 1, info
+        info["fidelity_min"] = min(fids)
+        info["ok"] = bool(min(fids) > 1 - 1e-6)
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if svc is not None:
+            svc.close()
+        os.environ.pop("QRACK_TPU_FUSE_WINDOW", None)
+        res.faults.clear()
+        res.reset_breaker()
+        res.disable()
+        tele.disable()
+        tele.reset()
+    return info
+
+
+# -- trial kind 2: kill -9 one of two serving processes ----------------
+
+
+def hold_child(ckdir: str, trial: int, seed: int) -> None:
+    """The victim serving process: apply the streams, make everything
+    durable, journal one QFT per session, park holding the lease."""
+    n_sessions = len(STACKS)
+    streams = _streams(trial, seed, n_sessions)
+    svc = QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                       batch_window_ms=5.0, queue_budget_ms=60_000.0,
+                       tick_s=0.05)
+    sids = []
+    for k in range(n_sessions):
+        stack, kw = STACKS[k % len(STACKS)]
+        sids.append(svc.create_session(N, layers=stack,
+                                       seed=(trial << 4) + k,
+                                       rand_global_phase=False, **kw))
+    for sid, stream in zip(sids, streams):
+        for item in stream:
+            if item[0] == "circ":
+                svc.apply(sid, qft_qcircuit(N), timeout=120)
+            else:
+                _, name, args = item
+                svc.call(sid, lambda e, n=name, a=args:
+                         getattr(e, n)(*a)).result(120)
+    svc.checkpoint_all()
+    for sid in sids:
+        svc.store.wal_append(sid, qft_qcircuit(N))
+    assert svc.lease_held
+    print("READY " + ",".join(sids), flush=True)
+    sys.stdin.readline()  # parked: the parent kill -9's us here
+    os._exit(0)
+
+
+def run_handoff_trial(trial: int, seed: int) -> dict:
+    n_sessions = len(STACKS)
+    info = {"trial": trial, "kind": "handoff", "sessions": n_sessions}
+    ckdir = tempfile.mkdtemp(prefix="elastic_soak_ck_")
+    child, svc = None, None
+    try:
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--hold", ckdir,
+             str(trial), str(seed)], env=env, text=True,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE)
+        line = child.stdout.readline().strip()
+        if not line.startswith("READY "):
+            raise AssertionError(
+                f"child died before handshake: {child.stderr.read()[-2000:]}")
+        sids = line[len("READY "):].split(",")
+        child.kill()  # the kill -9 — lease freed by pid liveness
+        child.wait(60)
+        svc = QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                           batch_window_ms=5.0, queue_budget_ms=60_000.0,
+                           tick_s=0.05)
+        out = svc.recover()
+        assert sorted(out["sessions"]) == sorted(sids), out
+        # exactly-once: the dead child never ran these, we replay all
+        assert out["wal_replayed"] == n_sessions, out
+        assert out["wal_skipped"] == 0, out
+        assert svc.store.wal_entries() == []
+        streams = _streams(trial, seed, n_sessions)
+        fids = []
+        for k, sid in enumerate(sids):
+            oracle = QEngineCPU(N, rng=QrackRandom((trial << 4) + k),
+                                rand_global_phase=False)
+            _apply_to_oracle(oracle, streams[k])
+            qft_qcircuit(N).Run(oracle)  # the WAL'd job
+            fids.append(_fidelity(oracle.GetQuantumState(),
+                                  svc.get_state(sid, timeout=120)))
+        info["wal_replayed"] = out["wal_replayed"]
+        info["fidelity_min"] = min(fids)
+        info["ok"] = bool(min(fids) > 1 - 1e-6)
+    except Exception as e:  # noqa: BLE001
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()
+            child.wait(60)
+        if svc is not None:
+            svc.close()
+        shutil.rmtree(ckdir, ignore_errors=True)
+    return info
+
+
+def run_trial(trial: int, seed: int) -> dict:
+    if trial % 3 == 2:
+        return run_handoff_trial(trial, seed)
+    return run_elastic_trial(trial, seed)
+
+
+def main(argv) -> int:
+    if len(argv) > 1 and argv[1] == "--hold":
+        hold_child(argv[2], int(argv[3]), int(argv[4]))
+        return 0
+    trials = int(argv[1]) if len(argv) > 1 else 24
+    seed = int(argv[2]) if len(argv) > 2 else 0
+    failures = 0
+    for t in range(trials):
+        info = run_trial(t, seed)
+        print(json.dumps(info), flush=True)
+        if not info["ok"]:
+            failures += 1
+    print(f"SOAK {'FAILED' if failures else 'OK'}: "
+          f"{trials - failures}/{trials} trials oracle-equivalent",
+          flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -14,7 +14,10 @@ sum; spans merge).  Sections:
   * serving: jobs admitted/shed/expired/completed, batch occupancy
     (batched jobs per dispatch), queue-depth / latency gauges
   * checkpoint: save/restore counts + bytes, spill-store footprint,
-    warm-start programs recorded/prewarmed
+    warm-start programs recorded/prewarmed, recovery-lease traffic
+  * elasticity: repage shrink/expand traffic, failed expansions,
+    hybrid un-pins; the current page count rides the gauges section
+    (elastic.pages) — docs/ELASTICITY.md
   * layer events (qunit/stabilizer/qbdt/hybrid/factory escalations)
   * spans: count, total, mean
 
@@ -87,6 +90,7 @@ def report(snap: dict, top: int) -> dict:
         "exchange": {},
         "serve": {},
         "checkpoint": {},
+        "elastic": {},
         "gauges": snap.get("gauges", {}),
         "layer_events": {},
         "spans": snap.get("spans", {}),
@@ -105,6 +109,8 @@ def report(snap: dict, top: int) -> dict:
             out["serve"][k] = v
         elif k.startswith("checkpoint."):
             out["checkpoint"][k] = v
+        elif k.startswith("elastic."):
+            out["elastic"][k] = v
         elif k.split(".")[0] in ("qunit", "qunitmulti", "stabilizer",
                                  "qbdt", "hybrid", "factory", "engine",
                                  "cluster", "resilience"):
@@ -166,6 +172,10 @@ def main(argv=None) -> int:
         for name, v in sorted(rep["checkpoint"].items()):
             shown = _fmt_bytes(v) if name.endswith("bytes") else f"{v:.0f}"
             print(f"  {name:<40s} {shown:>12s}")
+    if rep["elastic"]:
+        print("== elasticity ==")
+        for name, v in sorted(rep["elastic"].items()):
+            print(f"  {name:<40s} {v:>12.0f}")
     if rep["gauges"]:
         print("== gauges ==")
         for name, v in sorted(rep["gauges"].items()):

@@ -13,6 +13,18 @@
 # Usage: nohup scripts/tpu_watch.sh &   (log: bench_out/watch.log)
 cd "$(dirname "$0")/.."
 mkdir -p bench_out
+
+# single-instance guard: two watchers means two concurrent jax clients
+# the moment both probes fire — exactly the pattern that wedges the
+# tunnel.  flock on a lockfile makes the second invocation exit
+# immediately instead of relying on `ps aux | grep` discipline.
+LOCK=/tmp/tpu_watch.lock
+exec 9> "$LOCK"
+if ! flock -n 9; then
+  echo "tpu_watch already running (lock: $LOCK) — exiting" >&2
+  exit 0
+fi
+
 LOG=bench_out/watch.log
 ONE=/tmp/tpu_probe_once.log
 PY="${PYTHON:-/opt/venv/bin/python}"

@@ -14,6 +14,20 @@ Three entry points (argv[1]):
 * ``recover-stale <ckdir> <out.npy>`` — recover, print the result dict
   as JSON on the last stdout line, dump the (cold) session state.
 
+Two-process elasticity phases (docs/ELASTICITY.md):
+
+* ``hold <ckdir>`` — checkpoint c1, journal c2, print ``READY <sid>``
+  and block on stdin while HOLDING the recovery lease: the parent runs
+  an adopter against the live holder, then kill -9's this process.
+* ``adopt-denied <ckdir>`` — assert recover=True raises StoreLeaseHeld
+  (the live peer above still owns the WAL).
+* ``drain-hold <ckdir>`` — run c1, drain() the session into the store,
+  print ``DRAINED <json>`` and block on stdin WITHOUT exiting: proves
+  adoption needs no holder death when the handoff is explicit.
+* ``adopt <ckdir> <out.npy> [--apply-c2]`` — recover explicitly, print
+  the result dict as JSON, optionally apply c2 (the drain path hands
+  over a c1-only state with no WAL), dump the final state.
+
 Kept out of test collection (leading underscore); the oracle the parent
 test compares against lives in test_checkpoint.py.
 """
@@ -132,6 +146,71 @@ def phase_recover(ckdir: str, out: str) -> None:
         svc.destroy_session(sid2)  # keep the manifest single-session
 
 
+def phase_hold(ckdir: str) -> None:
+    from qrack_tpu.serve import QrackService
+
+    c1, c2 = circuits(W)
+    svc = QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                       tick_s=0.02, batch_window_ms=2.0)
+    sid = svc.create_session(W, seed=SEED, rand_global_phase=False)
+    svc.apply(sid, c1)
+    svc.checkpoint_session(sid)
+    svc.store.wal_append(sid, c2)
+    assert svc.lease_held
+    print(f"READY {sid}", flush=True)
+    sys.stdin.readline()  # parent kill -9's us mid-hold; never reached
+    os._exit(0)
+
+
+def phase_adopt_denied(ckdir: str) -> None:
+    from qrack_tpu.checkpoint import StoreLeaseHeld
+    from qrack_tpu.serve import QrackService
+
+    try:
+        QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                     recover=True, tick_s=0.02, batch_window_ms=2.0)
+    except StoreLeaseHeld as e:
+        assert "drain or stop" in str(e), e
+        return
+    print("recover was admitted while a live peer held the lease")
+    sys.exit(1)
+
+
+def phase_drain_hold(ckdir: str) -> None:
+    import json
+
+    from qrack_tpu.serve import QrackService
+
+    c1, _ = circuits(W)
+    svc = QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                       tick_s=0.02, batch_window_ms=2.0)
+    sid = svc.create_session(W, seed=SEED, rand_global_phase=False)
+    svc.apply(sid, c1)
+    out = svc.drain()
+    assert out == {"drained": [sid], "busy": []}, out
+    assert not svc.lease_held
+    assert sid not in svc.sessions.ids()
+    print(f"DRAINED {json.dumps(out)}", flush=True)
+    sys.stdin.readline()  # stay alive while the peer adopts
+    svc.close()
+
+
+def phase_adopt(ckdir: str, out: str, apply_c2: bool) -> None:
+    import json
+
+    from qrack_tpu.serve import QrackService
+
+    _, c2 = circuits(W)
+    with QrackService(engine_layers="cpu", checkpoint_dir=ckdir,
+                      tick_s=0.02, batch_window_ms=2.0) as svc:
+        res = svc.recover()
+        assert svc.lease_held
+        if apply_c2:
+            svc.apply("s000001", c2)
+        np.save(out, np.asarray(svc.get_state("s000001")))
+        print(json.dumps(res))
+
+
 if __name__ == "__main__":
     if sys.argv[1] == "spill":
         phase_spill(sys.argv[2], sys.argv[3])
@@ -143,5 +222,14 @@ if __name__ == "__main__":
         phase_stale(sys.argv[2])
     elif sys.argv[1] == "recover-stale":
         phase_recover_stale(sys.argv[2], sys.argv[3])
+    elif sys.argv[1] == "hold":
+        phase_hold(sys.argv[2])
+    elif sys.argv[1] == "adopt-denied":
+        phase_adopt_denied(sys.argv[2])
+    elif sys.argv[1] == "drain-hold":
+        phase_drain_hold(sys.argv[2])
+    elif sys.argv[1] == "adopt":
+        phase_adopt(sys.argv[2], sys.argv[3],
+                    apply_c2="--apply-c2" in sys.argv[4:])
     else:
         sys.exit(f"unknown phase {sys.argv[1]!r}")

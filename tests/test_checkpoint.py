@@ -399,6 +399,64 @@ def test_store_wal_round_trip_and_damage_skip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# recovery lease: multi-process WAL-replay exclusivity (docs/ELASTICITY.md)
+# ---------------------------------------------------------------------------
+
+def test_store_lease_acquire_refresh_deny_release(tmp_path):
+    from qrack_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "store"))
+    assert store.acquire_lease("a")
+    assert store.lease_info()["owner"] == "a"
+    assert store.acquire_lease("a")  # the holder may refresh
+    # a peer (same host, this pid is alive) is denied, and a non-holder
+    # release must not free someone else's lease
+    peer = CheckpointStore(store.root)
+    assert not peer.acquire_lease("b")
+    assert not peer.release_lease("b")
+    assert store.release_lease("a")
+    assert store.lease_info() is None
+    assert peer.acquire_lease("b")  # free now
+
+
+def test_store_lease_dead_pid_claimed_over(tmp_path):
+    """kill -9 recovery: a same-host lease whose pid is gone is claimed
+    over instantly, even with TTL left on the clock."""
+    from qrack_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "store"))
+    assert store.acquire_lease("dead")
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    path = os.path.join(store.root, "manifest.json")
+    with open(path) as f:
+        m = json.load(f)
+    m["lease"]["pid"] = p.pid  # a pid that no longer exists
+    m["lease"]["expires_at"] = time.time() + 9999
+    with open(path, "w") as f:
+        json.dump(m, f)
+    assert CheckpointStore(store.root).acquire_lease("me")
+
+
+def test_store_lease_cross_host_ttl_fallback(tmp_path):
+    """A foreign-host lease has no pid to probe: the recorded TTL is
+    authoritative — live until it expires, claimable after."""
+    from qrack_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "store"))
+    path = os.path.join(store.root, "manifest.json")
+    lease = {"owner": "far", "host": "elsewhere", "pid": 1,
+             "acquired_at": time.time(), "expires_at": time.time() + 60}
+    with open(path, "w") as f:
+        json.dump({"version": 1, "sessions": {}, "lease": lease}, f)
+    assert not store.acquire_lease("me")
+    lease["expires_at"] = time.time() - 1
+    with open(path, "w") as f:
+        json.dump({"version": 1, "sessions": {}, "lease": lease}, f)
+    assert store.acquire_lease("me")
+
+
+# ---------------------------------------------------------------------------
 # warm start: ProgramManifest round-trips every recorded shape
 # ---------------------------------------------------------------------------
 
@@ -503,6 +561,72 @@ def test_recover_refuses_wal_on_unpersisted_base(tmp_path):
     fresh = np.zeros(1 << 6, dtype=np.complex128)
     fresh[0] = 1.0  # cold = |0..0>, not a half-replayed hybrid
     assert np.array_equal(np.load(out), fresh)
+
+
+def _hold_phase(child_args, tmp_path):
+    """Launch a child that parks holding serve-side state; returns the
+    Popen plus its READY/DRAINED handshake line."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen([sys.executable, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "_ckpt_serve_child.py"),
+        *child_args], env=env, stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    line = p.stdout.readline().strip()
+    if not line:  # child died before the handshake
+        p.wait(30)
+        raise AssertionError(p.stderr.read()[-2000:])
+    return p, line
+
+
+def test_two_process_adopt_gated_by_lease_until_kill(tmp_path):
+    """The acceptance flow for multi-process recovery: while a live
+    process holds the store lease its WAL cannot be adopted (so no
+    entry can ever replay in both processes); kill -9 frees the lease
+    via pid liveness and the adopter replays the journal exactly once."""
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "state.npy")
+    p, line = _hold_phase(["hold", ck], tmp_path)
+    try:
+        assert line == "READY s000001", line
+        # peer adoption against the LIVE holder must be refused
+        _serve_phase(["adopt-denied", ck], tmp_path)
+        assert p.poll() is None  # the holder survived the attempt
+    finally:
+        p.kill()  # the kill -9
+    p.wait(30)
+    stdout = _serve_phase(["adopt", ck, out], tmp_path)
+    res = json.loads(stdout.strip().splitlines()[-1])
+    assert res["sessions"] == ["s000001"], res
+    assert res["wal_replayed"] == 1 and res["wal_skipped"] == 0, res
+    # c2 came from the WAL exactly once: the state is the c1+c2 oracle
+    assert np.array_equal(np.load(out), _serve_oracle(6, 7))
+
+
+def test_two_process_drain_handoff(tmp_path):
+    """Explicit migration needs no holder death: drain() persists the
+    session, disowns it, and releases the lease, so a peer adopts the
+    exact state while the drained process is still running."""
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "state.npy")
+    p, line = _hold_phase(["drain-hold", ck], tmp_path)
+    try:
+        assert line.startswith("DRAINED "), line
+        assert json.loads(line[len("DRAINED "):]) == {
+            "drained": ["s000001"], "busy": []}
+        # adopt WHILE the drained peer is alive; it handed over a
+        # c1-only state with no WAL, so the adopter applies c2 itself
+        stdout = _serve_phase(["adopt", ck, out, "--apply-c2"], tmp_path)
+        res = json.loads(stdout.strip().splitlines()[-1])
+        assert res["sessions"] == ["s000001"], res
+        assert res["wal_replayed"] == 0 and res["wal_skipped"] == 0, res
+        p.stdin.write("\n")
+        p.stdin.flush()
+        assert p.wait(30) == 0, p.stderr.read()[-2000:]
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait(30)
+    assert np.array_equal(np.load(out), _serve_oracle(6, 7))
 
 
 @pytest.mark.slow

@@ -323,8 +323,18 @@ def test_pager_failover_matrix_matches_oracle(site, kind, monkeypatch):
     faults.inject(site, kind, after_n=0, times=None)
     _apply_suffix(q)
     q.GetAmplitude(0)  # device_get rows fail over on this guarded read
-    # pager degrades to single-device first (breaker still closed)
-    assert type(q.engine).__name__ in ("QEngineTPU", "QEngineCPU")
+    name = type(q.engine).__name__
+    if site == "pager.exchange":
+        # elastic landing: shrinking localizes every qubit, the exchange
+        # site vanishes, the pager keeps serving ON the mesh — and since
+        # `raise` is not a device-down signal, the boundary probe has
+        # already grown it back to the construction page count
+        assert name == "QPager"
+        assert q.engine.n_pages == 4 and not q.engine.elastic_degraded
+    else:
+        # dispatch/device_get faults follow the shrunk pager (the site
+        # exists at every page count), so the chain exits the mesh
+        assert name in ("QEngineTPU", "QEngineCPU")
     _assert_oracle_match(q)
 
 
@@ -404,6 +414,183 @@ def test_wide_pager_failover_exhausts_chain_loudly():
             q.Prob(0)  # chain-exhausted failure) surfaces at the read
     finally:
         set_config(max_cpu_qubits=old_cap)
+
+
+# ---------------------------------------------------------------------------
+# elastic re-paging: shrink on loss, serve degraded, grow on recovery
+# (docs/ELASTICITY.md)
+# ---------------------------------------------------------------------------
+
+def test_flap_spec_grammar_and_device_down():
+    s = faults.parse_spec("pager.dispatch:flap:2+3")
+    assert (s.site, s.kind, s.after_n, s.times) == ("pager.dispatch",
+                                                    "flap", 2, 3)
+    with pytest.raises(ValueError):
+        faults.parse_spec("pager.dispatch:flapp:0")
+    faults.inject("pager.dispatch", "flap", after_n=1, times=2)
+    assert not faults.device_down("pager.dispatch")  # window not open yet
+    faults.check("pager.dispatch")                   # call 1 passes through
+    assert faults.device_down("pager.dispatch")      # window open
+    assert not faults.device_down("tpu.compile")     # other sites healthy
+    for _ in range(2):
+        with pytest.raises(res.DeviceLost):
+            faults.check("pager.dispatch")
+    assert not faults.device_down("pager.dispatch")  # flap healed itself
+    faults.inject("tpu.dispatch", "device-loss", after_n=0, times=None)
+    assert faults.device_down()              # any armed loss, any site
+    with faults.suspended():
+        assert not faults.device_down()      # snapshots must stand still
+
+
+def test_pager_shrink_expand_roundtrip():
+    """Structural round trip: shrink while the flap window is open, the
+    probe refuses to grow until it heals, then one boundary restores the
+    construction page count — and the amplitudes survive both repages."""
+    tele.enable()
+    res.enable()
+    q = create_quantum_interface("pager", N, n_pages=4, rng=QrackRandom(3),
+                                 rand_global_phase=False)
+    _apply_prefix(q)
+    pager = q.engine
+    faults.inject("pager.dispatch", "flap", after_n=0, times=2)
+    assert faults.device_down("pager.dispatch")
+    pager.shrink_pages()
+    assert pager.n_pages == 2 and pager.elastic_degraded
+    assert not pager.maybe_reexpand()        # loss window still open
+    assert pager.n_pages == 2
+    for _ in range(2):                       # consume the flap: recovery
+        with pytest.raises(res.DeviceLost):
+            faults.check("pager.dispatch")
+    assert pager.maybe_reexpand()
+    assert pager.n_pages == 4 and not pager.elastic_degraded
+    _apply_suffix(q)
+    _assert_oracle_match(q)
+    c = tele.snapshot()["counters"]
+    assert c.get("elastic.repage.shrink") == 1
+    assert c.get("elastic.repage.expand") == 1
+
+
+def _rcs_ops():
+    """Deterministic RCS-style brickwork: random single-qubit phase/H
+    layers + CZ entanglers (no measurement — rng streams must stay
+    uncoupled from the oracle's)."""
+    gen = np.random.Generator(np.random.PCG64(7))
+    ops = []
+    for _ in range(4):
+        for qb in range(N):
+            ops.append((("T", "H", "S")[int(gen.integers(0, 3))], (qb,)))
+        a = int(gen.integers(0, N))
+        ops.append(("CZ", (a, (a + 1) % N)))
+    return ops
+
+
+def _fuzz_ops():
+    """A slice of the API-fuzzer vocabulary (test_fuzz_api.py) minus
+    measuring ops, so oracle and pager stay stream-independent."""
+    gen = np.random.Generator(np.random.PCG64(11))
+    q = lambda: int(gen.integers(0, N))
+    ops = []
+    for _ in range(16):
+        kind = int(gen.integers(0, 6))
+        if kind == 0:
+            ops.append((("X", "Y", "Z", "H", "S", "T")[q()], (q(),)))
+        elif kind == 1:
+            ops.append((("RX", "RY", "RZ")[kind % 3],
+                        (float(gen.uniform(0, 6.28)), q())))
+        elif kind == 2:
+            a = q()
+            ops.append((("CNOT", "CZ", "Swap", "ISwap")[a % 4],
+                        (a, (a + 1 + q() % (N - 1)) % N)))
+        elif kind == 3:
+            s = int(gen.integers(0, N - 1))
+            ops.append(("INC", (int(gen.integers(0, 8)), s,
+                                int(gen.integers(1, N - s + 1)))))
+        elif kind == 4:
+            ops.append(("XMask", (int(gen.integers(1, 1 << N)),)))
+        else:
+            ops.append(("ZMask", (int(gen.integers(1, 1 << N)),)))
+    return ops
+
+
+_ELASTIC_CIRCUITS = {
+    "qft": lambda: ([("H", (0,)), ("CNOT", (0, 1)), ("RY", (0.7, 2))]
+                    + [("QFT", (0, N))]),
+    "rcs": _rcs_ops,
+    "fuzz": _fuzz_ops,
+}
+
+
+@pytest.mark.parametrize("window", [1, 16])
+@pytest.mark.parametrize("circ", sorted(_ELASTIC_CIRCUITS))
+def test_pager_shrink_midcircuit_matrix(circ, window, monkeypatch):
+    """A flap mid-circuit (fused window mid-flight included) shrinks the
+    pager, the job finishes degraded ON the mesh, the next boundary
+    grows it back — and the final state matches the CPU oracle."""
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", str(window))
+    tele.enable()
+    res.enable()
+    ops = _ELASTIC_CIRCUITS[circ]()
+    cut = len(ops) // 2
+    q = create_quantum_interface("pager", N, n_pages=4, rng=QrackRandom(3),
+                                 rand_global_phase=False)
+    for name, args in ops[:cut]:
+        getattr(q, name)(*args)
+    # one DeviceLost at whatever guarded site fires next, then recovery
+    faults.inject("*", "flap", after_n=0, times=1)
+    for name, args in ops[cut:]:
+        getattr(q, name)(*args)
+    q.GetAmplitude(0)   # read boundary: flush + (for device_get) failover
+    q.Prob(0)           # post-recovery boundary: the probe grows back
+    c = tele.snapshot()["counters"]
+    assert c.get("elastic.repage.shrink", 0) >= 1, (circ, window)
+    assert type(q.engine).__name__ == "QPager"
+    assert q.engine.n_pages == 4 and not q.engine.elastic_degraded
+    with faults.suspended():
+        got = np.asarray(q.GetQuantumState())
+    o = QEngineCPU(N, rng=QrackRandom(3), rand_global_phase=False)
+    for name, args in ops:
+        getattr(o, name)(*args)
+    want = np.asarray(o.GetQuantumState())
+    f = abs(np.vdot(want, got)) ** 2
+    assert f > 1 - 1e-6, (circ, window, f)
+
+
+def test_pager_staircase_descends_through_shrink():
+    """A PERSISTENT device loss re-fires on the shrunk pager, so the
+    chain keeps descending — 4 → 2 → 1 pages — before exiting the mesh,
+    and the final state still matches the oracle."""
+    tele.enable()
+    res.enable()
+    q = create_quantum_interface("pager", N, n_pages=4, rng=QrackRandom(3),
+                                 rand_global_phase=False)
+    _apply_prefix(q)
+    faults.inject("pager.dispatch", "device-loss", after_n=0, times=None)
+    _apply_suffix(q)
+    q.GetAmplitude(0)
+    c = tele.snapshot()["counters"]
+    assert c.get("elastic.repage.shrink", 0) >= 2     # 4→2 then 2→1
+    assert type(q.engine).__name__ in ("QEngineTPU", "QEngineCPU")
+    _assert_oracle_match(q)
+
+
+def test_hybrid_unpins_after_device_recovery():
+    """Regression for the stay-down asymmetry: a pinned CPU ceiling must
+    lift at the next call boundary once the device-loss heals, not
+    persist until process restart."""
+    res.enable()
+    h = QHybrid(N, tpu_threshold_qubits=2, rng=QrackRandom(3),
+                rand_global_phase=False)
+    _apply_prefix(h)
+    faults.inject("tpu.compile", "device-loss", after_n=0, times=None)
+    _apply_suffix(h)
+    assert h._failed_over == "cpu"
+    assert type(h._engine).__name__ == "QEngineCPU"
+    faults.clear()          # the device comes back
+    h.X(4)                  # boundary: probe passes, ceiling lifts
+    assert h._failed_over is None
+    assert type(h._engine).__name__ == "QEngineTPU"
+    h.X(4)                  # undo so the oracle circuit is unchanged
+    _assert_oracle_match(h)
 
 
 # ---------------------------------------------------------------------------

@@ -293,6 +293,102 @@ def test_sync_failure_failover_does_not_double_apply():
 
 
 # ---------------------------------------------------------------------------
+# elastic capacity: degraded serving + drain/adopt (docs/ELASTICITY.md)
+# ---------------------------------------------------------------------------
+
+def test_elastic_pager_serves_degraded_then_reexpands(monkeypatch):
+    """Acceptance flow: a pager session loses its exchange collective
+    mid-serve, re-pages down the elastic staircase, KEEPS serving jobs
+    degraded, and grows back to its construction page count at the
+    first job boundary after the device heals — all telemetry-visible."""
+    # window=1 disables the fuser: gates dispatch eagerly inside the
+    # call job, so the injected exchange loss fires while serving
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "1")
+    tele.enable()
+    tele.reset()
+    Wp = 5
+    with _svc(engine_layers="pager", n_pages=4) as svc:
+        sid = svc.create_session(Wp, seed=3, rand_global_phase=False)
+        svc.call(sid, lambda e: e.H(0)).result(60)    # healthy, 4 pages
+        faults.inject("pager.exchange", "device-loss", times=None)
+        # qubit 4 is global at 4 AND 2 pages, local at 1: the staircase
+        # descends 4 -> 2 -> 1 and the replay lands on the single page
+        svc.call(sid, lambda e: e.H(4)).result(60)
+        # the degraded pager demonstrably serves jobs at reduced pages
+        # (the pre-job recovery probe sees the loss window still open)
+        info = svc.call(sid, lambda e: (e.n_pages,
+                                        bool(e.elastic_degraded))).result(60)
+        assert info == (1, True), info
+        svc.call(sid, lambda e: e.CNOT(0, 1)).result(60)
+        svc.call(sid, lambda e: e.T(1)).result(60)
+        # device heals -> the next job boundary re-expands BEFORE the
+        # job runs, so the same job observes the recovered topology
+        faults.clear()
+        info = svc.call(sid, lambda e: (e.n_pages,
+                                        bool(e.elastic_degraded))).result(60)
+        assert info == (4, False), info
+        state = svc.get_state(sid, timeout=60)
+    snap = tele.snapshot()
+    assert snap["counters"]["elastic.repage.shrink"] == 2
+    assert snap["counters"]["elastic.repage.expand"] == 1
+    assert snap["gauges"]["elastic.pages"] == 4
+    oracle = QEngineCPU(Wp, rng=QrackRandom(3), rand_global_phase=False)
+    oracle.H(0)
+    oracle.H(4)
+    oracle.CNOT(0, 1)
+    oracle.T(1)
+    assert _fidelity(oracle.GetQuantumState(), state) > 1 - 1e-6
+
+
+def test_drain_handoff_adopted_by_second_service(tmp_path):
+    """drain() checkpoints idle sessions, disowns them, and releases
+    the recovery lease; a peer sharing the store adopts the set with
+    recover=True and serves the exact handed-over state."""
+    ck = str(tmp_path / "ck")
+    a = _svc(engine_layers="cpu", checkpoint_dir=ck)
+    try:
+        sid = a.create_session(W, seed=5, rand_global_phase=False)
+        a.apply(sid, qft_qcircuit(W), timeout=60)
+        assert a.stats()["lease"]["held"]
+        out = a.drain()
+        assert out == {"drained": [sid], "busy": []}
+        assert sid not in a.sessions.ids()
+        assert not a.lease_held
+        with pytest.raises(SessionNotFound):
+            a.get_state(sid, timeout=60)
+        # the adopter: drain released the lease, so recover is admitted
+        with _svc(engine_layers="cpu", checkpoint_dir=ck,
+                  recover=True) as b:
+            assert b.lease_held
+            assert [s["sid"] for s in b.stats()["sessions"]] == [sid]
+            state = b.get_state(sid, timeout=60)
+    finally:
+        a.close()
+    oracle = QEngineCPU(W, rng=QrackRandom(5), rand_global_phase=False)
+    qft_qcircuit(W).Run(oracle)
+    assert _fidelity(oracle.GetQuantumState(), state) > 1 - 1e-6
+
+
+def test_recover_refused_while_peer_holds_lease(tmp_path):
+    """Two processes must never both replay the same WAL: while a live
+    peer holds the store lease, recover=True fails with the typed
+    error (and leaks no executor thread); after drain it is admitted."""
+    from qrack_tpu.checkpoint import StoreLeaseHeld
+
+    ck = str(tmp_path / "ck")
+    with _svc(engine_layers="cpu", checkpoint_dir=ck) as a:
+        sid = a.create_session(W, seed=1)
+        with pytest.raises(StoreLeaseHeld) as exc:
+            _svc(engine_layers="cpu", checkpoint_dir=ck, recover=True)
+        assert "drain or stop" in str(exc.value)
+        # the holder keeps serving; handing over unblocks the adopter
+        assert a.drain() == {"drained": [sid], "busy": []}
+        with _svc(engine_layers="cpu", checkpoint_dir=ck,
+                  recover=True) as b:
+            assert sid in b.sessions.ids()
+
+
+# ---------------------------------------------------------------------------
 # fault-spec parse-time validation (satellite)
 # ---------------------------------------------------------------------------
 
@@ -330,5 +426,23 @@ def test_serve_soak_smoke():
     soak = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(soak)
     results = [soak.run_trial(t, seed=123) for t in range(9)]
+    bad = [r for r in results if not r["ok"]]
+    assert not bad, bad
+
+
+@pytest.mark.slow
+def test_elastic_soak_smoke():
+    """3-trial slice of scripts/elastic_soak.py: two in-process
+    device-loss/flap trials (fusion windows 1 and 16) plus one kill -9
+    two-process handoff trial."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "elastic_soak", os.path.join(os.path.dirname(__file__),
+                                     "..", "scripts", "elastic_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    results = [soak.run_trial(t, seed=7) for t in range(3)]
     bad = [r for r in results if not r["ok"]]
     assert not bad, bad
