@@ -204,6 +204,12 @@ def build(snap: dict):
             n,
             tpu_threshold_qubits=int(meta["tpu_threshold"]),
             pager_threshold_qubits=int(meta["pager_threshold"]))
+    elif kind == "routed":
+        from ..route.router import QRouted
+
+        # the wrapper's _ckpt_restore rebuilds the recorded stack from
+        # the snapshot's layer list; a fresh QRouted carries no engine
+        obj = QRouted(n)
     else:
         raise CheckpointError(f"unknown snapshot kind {kind!r}")
     return restore_into(obj, snap)

@@ -24,6 +24,9 @@ QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
   "turboquant"         QEngineTurboQuant block-compressed resident ket
   "turboquant_pager"   QPagerTurboQuant compressed ket sharded over the
                        device mesh (compressed ICI pair exchange)
+  "route"              QRouted lazy per-job stack selection: the first
+                       submitted QCircuit picks the representation
+                       (route/, docs/ROUTING.md; QRACK_ROUTE pins it)
 
 create_quantum_interface(layers, n) composes them top-down; OPTIMAL is
 ["unit", "stabilizer_hybrid", "hybrid"] — the reference's production
@@ -41,7 +44,7 @@ OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
 _TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt",
              "bdt_attached", "unit_clifford", "sparse", "turboquant",
-             "turboquant_pager"}
+             "turboquant_pager", "route"}
 
 
 def _counted(name: str, fn: Callable) -> Callable:
@@ -145,6 +148,14 @@ def _terminal_factory(name: str, **opts) -> Callable:
         from .layers.qunitclifford import QUnitClifford
 
         return lambda n, **kw: QUnitClifford(n, **{**opts, **kw})
+    if name == "route":
+        # pseudo-terminal: construction is free (no engine exists until
+        # routing picks one), and the chosen stack is built through
+        # this same factory, so resilience wrapping and per-layer
+        # creation counters apply to whatever the router instantiates
+        from .route.router import QRouted
+
+        return lambda n, **kw: QRouted(n, **{**opts, **kw})
     raise ValueError(f"unknown terminal layer {name!r}")
 
 

@@ -281,6 +281,13 @@ class QBdt(QInterface):
         walk(self.root)
         return len(seen)
 
+    def within_node_budget(self, budget: int) -> bool:
+        """Cheap-representation probe (route/): True while the
+        hash-consed tree holds at most `budget` distinct nodes.  The
+        router escalates to dense at the first job/read boundary where
+        this goes False (QRACK_ROUTE_BDT_MAX_NODES)."""
+        return self.node_count() <= int(budget)
+
     def footprint_amps(self) -> int:
         """Stored-amplitude estimate: 2 weights per distinct tree node
         plus each distinct dense leaf's length — the memory-compression

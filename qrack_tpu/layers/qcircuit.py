@@ -118,6 +118,11 @@ class QCircuit:
 
     def Run(self, qsim) -> None:
         """Execute on any QInterface (reference: src/qcircuit.cpp:173)."""
+        if getattr(qsim, "_is_routed", False):
+            # library-path routing admission: plan + realize on the
+            # caller thread, then dispatch into the chosen stack (the
+            # serve path splits these across threads — route/router.py)
+            qsim = qsim.route_for(self)
         for g in self.gates:
             for perm, m in g.payloads.items():
                 qsim.MCMtrxPerm(g.controls, m, g.target, perm)
@@ -148,6 +153,8 @@ class QCircuit:
         from ..ops import fusion as fu
         from ..parallel.pager import QPager
 
+        if getattr(qsim, "_is_routed", False):
+            return self.RunFused(qsim.route_for(self))
         if isinstance(qsim, QHybrid):
             # fuse onto whatever engine the width switch currently holds
             inner = qsim._engine

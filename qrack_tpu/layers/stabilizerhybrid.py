@@ -96,6 +96,26 @@ class QStabilizerHybrid(QInterface):
             return all(s is None for s in self.shards)
         return self.shards[q] is None
 
+    def on_tableau(self) -> bool:
+        """Cheap-representation probe (route/): True while the state is
+        still tableau-resident (no internal dense materialization)."""
+        return self.engine is None
+
+    def can_run_cheaply(self, circuit) -> bool:
+        """Feasibility probe for the router: can `circuit` run without
+        forcing SwitchToEngine?  Host-side feature scan only — no gates
+        are applied.  Conservative: a general (non-monomial,
+        non-Clifford) payload or a magic count past the remaining
+        ancilla room means "no"."""
+        if self.engine is not None:
+            return False
+        from ..route.features import extract_features
+
+        f = extract_features(circuit, self.qubit_count)
+        room = max(self.max_ancilla - self._anc, 0)
+        return f.general_count == 0 and (not self.use_t_gadget
+                                         or f.magic_count <= room)
+
     def SwitchToEngine(self) -> None:
         """Materialize the tableau ket + pending shards into a dense
         engine (reference: src/qstabilizerhybrid.cpp:435).  Gadget

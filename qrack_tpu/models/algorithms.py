@@ -265,6 +265,125 @@ def cosmology_inflation(qsim_factory, steps: int, rng) -> List[int]:
     return widths
 
 
+# ----------------------------------------------------------------------
+# QCircuit-emitting builders: workloads as submittable IR.
+#
+# Unlike the eager helpers above (which drive a live engine gate by
+# gate), these return layers.qcircuit.QCircuit objects, so the same
+# workload can be submitted through QrackService, classified by the
+# router (route/), bucketed by shape_key, and batched — the mixed-
+# traffic vocabulary for scripts/serve_bench.py --mixed.
+# ----------------------------------------------------------------------
+
+
+def _rz_mtrx(theta: float) -> np.ndarray:
+    from .. import matrices as mat
+
+    return mat.phase_mtrx(np.exp(-0.5j * theta), np.exp(0.5j * theta))
+
+
+def ghz_qcircuit(n: int) -> "QCircuit":
+    """GHZ chain as IR: H + CNOT ladder — fully Clifford, so the router
+    keeps it tableau-resident at any width (w100+ costs O(n^2))."""
+    from .. import matrices as mat
+    from ..layers.qcircuit import QCircuit
+
+    circ = QCircuit(n)
+    circ.append_1q(0, mat.H2)
+    for i in range(n - 1):
+        circ.append_ctrl((i,), i + 1, mat.X2, 1)
+    return circ
+
+
+def qaoa_qcircuit(n: int, edges: Optional[Sequence[Tuple[int, int]]] = None,
+                  p: int = 1, gammas: Optional[Sequence[float]] = None,
+                  betas: Optional[Sequence[float]] = None,
+                  rng=None) -> "QCircuit":
+    """Depth-p QAOA for MaxCut on `edges` (default: the n-cycle).  Cost
+    layers are RZZ(2*gamma) via the CNOT.RZ.CNOT identity; mixers are
+    RX(2*beta).  Angles default to rng draws (or fixed values without
+    an rng) so the emitted circuit is deterministic under a seed."""
+    from .. import matrices as mat
+    from ..layers.qcircuit import QCircuit
+
+    if edges is None:
+        edges = [(i, (i + 1) % n) for i in range(n)]
+    if gammas is None:
+        gammas = [(rng.rand() * math.pi if rng is not None
+                   else 0.4 + 0.1 * k) for k in range(p)]
+    if betas is None:
+        betas = [(rng.rand() * math.pi / 2 if rng is not None
+                  else 0.7 + 0.05 * k) for k in range(p)]
+    circ = QCircuit(n)
+    for q in range(n):
+        circ.append_1q(q, mat.H2)
+    for gamma, beta in zip(gammas, betas):
+        for a, b in edges:
+            circ.append_ctrl((a,), b, mat.X2, 1)
+            circ.append_1q(b, _rz_mtrx(2.0 * gamma))
+            circ.append_ctrl((a,), b, mat.X2, 1)
+        for q in range(n):
+            circ.append_1q(q, mat.u3_mtrx(2.0 * beta, -math.pi / 2,
+                                          math.pi / 2))
+    return circ
+
+
+def quantum_volume_qcircuit(n: int, depth: Optional[int] = None,
+                            rng=None) -> "QCircuit":
+    """QV-style circuit as IR (the dense tenant's workload): `depth`
+    rounds of random U3 pairs around CNOTs on a shuffled pairing —
+    matches :func:`quantum_volume`'s structure without touching an
+    engine.  Requires an rng (utils.rng.QrackRandom or compatible)."""
+    from .. import matrices as mat
+    from ..layers.qcircuit import QCircuit
+
+    if rng is None:
+        from ..utils.rng import QrackRandom
+
+        rng = QrackRandom()
+    depth = depth if depth is not None else n
+    circ = QCircuit(n)
+    for _ in range(depth):
+        perm = list(range(n))
+        for i in range(n - 1, 0, -1):
+            j = rng.randint(0, i + 1)
+            perm[i], perm[j] = perm[j], perm[i]
+        for k in range(0, n - 1, 2):
+            a, b = perm[k], perm[k + 1]
+            for q in (a, b):
+                circ.append_1q(q, mat.u3_mtrx(
+                    rng.rand() * math.pi, rng.rand() * 2 * math.pi,
+                    rng.rand() * 2 * math.pi))
+            circ.append_ctrl((a,), b, mat.X2, 1)
+            for q in (a, b):
+                circ.append_1q(q, mat.u3_mtrx(
+                    rng.rand() * math.pi, rng.rand() * 2 * math.pi,
+                    rng.rand() * 2 * math.pi))
+    return circ
+
+
+def trotter_qcircuit(n: int, steps: int = 1, dt: float = 0.1,
+                     j: float = 1.0, h: float = 1.0) -> "QCircuit":
+    """First-order Trotterized transverse-field Ising evolution as IR:
+    exp(-i dt H) per step with H = -j * sum Z_i Z_{i+1} - h * sum X_i —
+    RZZ(2*j*dt) on each bond (CNOT.RZ.CNOT) then RX(2*h*dt) mixers.
+    Deterministic: a fixed (n, steps, dt, j, h) tuple always emits the
+    same circuit, so repeated submissions share one compiled program."""
+    from .. import matrices as mat
+    from ..layers.qcircuit import QCircuit
+
+    circ = QCircuit(n)
+    for _ in range(steps):
+        for i in range(n - 1):
+            circ.append_ctrl((i,), i + 1, mat.X2, 1)
+            circ.append_1q(i + 1, _rz_mtrx(2.0 * j * dt))
+            circ.append_ctrl((i,), i + 1, mat.X2, 1)
+        for q in range(n):
+            circ.append_1q(q, mat.u3_mtrx(2.0 * h * dt, -math.pi / 2,
+                                          math.pi / 2))
+    return circ
+
+
 def separability_demo(qsim) -> dict:
     """Entangle, then watch Schmidt separation recover the product
     structure (reference: examples/qunit_separability.cpp /

@@ -1,0 +1,33 @@
+"""qrack_tpu.route — per-job representation routing (docs/ROUTING.md).
+
+Classify a submitted QCircuit into cheap static features, score the
+candidate stacks (stabilizer hybrid / QBdt / QUnit-factored / dense
+TPU) against a tunable cost model, and instantiate the winner per
+session — so one QrackService serves a w100 Clifford tenant next to a
+dense w22 tenant.  Imported lazily (factory "route" pseudo-layer,
+QrackService.submit); ``import qrack_tpu`` alone never pays for it.
+"""
+
+from .cost import (INFEASIBLE, STACKS, RouteKnobs, choose_stack,
+                   default_stack, layers_for, route_mode, score_stacks)
+from .features import CircuitFeatures, extract_features
+from .router import (MisrouteError, QRouted, RouteDecision, decide,
+                     update_residency)
+
+
+def admit(engine, circuit) -> RouteDecision:
+    """The submit-side admission step: record the routing decision the
+    circuit implies on a routed engine (pure host work — safe on the
+    caller thread; the executor realizes it via ``apply_plan``).
+    Raises :class:`MisrouteError` when the circuit needs dense and the
+    width cannot escalate."""
+    return engine.plan(circuit)
+
+
+__all__ = [
+    "CircuitFeatures", "extract_features",
+    "RouteKnobs", "route_mode", "score_stacks", "choose_stack",
+    "layers_for", "default_stack", "STACKS", "INFEASIBLE",
+    "QRouted", "RouteDecision", "MisrouteError", "decide",
+    "update_residency", "admit",
+]

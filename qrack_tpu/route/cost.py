@@ -1,0 +1,189 @@
+"""Tunable cost model scoring candidate stacks for a feature vector.
+
+Four candidate representations (docs/ROUTING.md):
+
+* ``stabilizer`` — QStabilizerHybrid over the CHP tableau with a dense
+  escape hatch below it.  Feasible when no payload is "general" and the
+  magic (gadgetable T-like) count fits the ancilla budget; cost scales
+  as gates * width^2 (tableau column ops), plus a per-gadget surcharge.
+* ``bdt``        — QBdt hash-consed decision tree.  Always *runnable*,
+  but only cheap while the tree stays small; the estimate bounds stored
+  amplitudes by the worst cut's entangling-gate crossings (a bond-
+  dimension heuristic, deliberately conservative and env-tunable).
+* ``qunit``      — the OPTIMAL Schmidt-factoring stack; cost scales
+  with the largest *entangled block* the circuit ever fuses, not the
+  full width.
+* ``dense``      — QEngineTPU split planes (the only batchable stack);
+  cost gates * 2^width, infeasible past the dense width cap.
+
+Scores are abstract work units — only their ratios matter.  Every knob
+is an env var so deployments can re-weight without code changes:
+
+  QRACK_ROUTE                auto | dense | stabilizer | bdt | qunit
+  QRACK_ROUTE_DENSE_MAX_QB   dense-representable width cap (default 26)
+  QRACK_ROUTE_MAX_MAGIC      stabilizer gadget budget (default 8)
+  QRACK_ROUTE_BDT_MAX_NODES  QBdt escalation node budget (default 2^20)
+  QRACK_ROUTE_STAB_WEIGHT    per-op weight multipliers ...
+  QRACK_ROUTE_BDT_WEIGHT
+  QRACK_ROUTE_QUNIT_WEIGHT
+  QRACK_ROUTE_DENSE_WEIGHT
+
+One guard rail sits above the scores: a fully-Clifford circuit always
+routes to the stabilizer stack when feasible — its polynomial bound is
+exact, while the QBdt/QUnit numbers are heuristics, and a heuristic
+should never outbid a guarantee.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from .features import CircuitFeatures
+
+INFEASIBLE = float("inf")
+
+STACKS = ("stabilizer", "bdt", "qunit", "dense")
+
+_MODES = ("auto",) + STACKS
+
+
+def route_mode() -> str:
+    """Current QRACK_ROUTE value (re-read per call: tests and operators
+    flip it at runtime).  Unknown values fall back to "auto" loudly at
+    decision time rather than silently pinning."""
+    mode = os.environ.get("QRACK_ROUTE", "auto").strip().lower() or "auto"
+    return mode if mode in _MODES else "auto"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class RouteKnobs:
+    dense_max_qb: int = 26
+    max_magic: int = 8
+    bdt_max_nodes: int = 1 << 20
+    stab_weight: float = 1.0
+    # the tree's per-node constant is host-side python, ~2^10 of a
+    # vectorized dense lane (measured: qaoa12 tree 590ms vs dense 11ms
+    # warm; trotter16 13s vs 32ms) — so the tree only wins when its
+    # bond bound beats the full width by >10 qubits, i.e. wide weakly-
+    # entangled circuits, and it stays the only runnable stack past the
+    # dense cap when stabilizer/qunit are infeasible
+    bdt_weight: float = 1024.0
+    qunit_weight: float = 2.0
+    dense_weight: float = 1.0
+
+    @classmethod
+    def from_env(cls) -> "RouteKnobs":
+        return cls(
+            dense_max_qb=_env_int("QRACK_ROUTE_DENSE_MAX_QB", 26),
+            max_magic=_env_int("QRACK_ROUTE_MAX_MAGIC", 8),
+            bdt_max_nodes=_env_int("QRACK_ROUTE_BDT_MAX_NODES", 1 << 20),
+            stab_weight=_env_float("QRACK_ROUTE_STAB_WEIGHT", 1.0),
+            bdt_weight=_env_float("QRACK_ROUTE_BDT_WEIGHT", 1024.0),
+            qunit_weight=_env_float("QRACK_ROUTE_QUNIT_WEIGHT", 2.0),
+            dense_weight=_env_float("QRACK_ROUTE_DENSE_WEIGHT", 1.0),
+        )
+
+
+def score_stacks(f: CircuitFeatures,
+                 knobs: Optional[RouteKnobs] = None) -> Dict[str, float]:
+    """Abstract work-unit score per candidate stack; INFEASIBLE marks a
+    representation that cannot (or must not) take this circuit."""
+    k = knobs or RouteKnobs.from_env()
+    w = max(f.width, 1)
+    g = max(f.gate_count, 1)
+    scores: Dict[str, float] = {}
+
+    # dense split planes: every gate sweeps the whole 2^w ket
+    scores["dense"] = (g * float(2 ** w) * k.dense_weight
+                       if w <= k.dense_max_qb else INFEASIBLE)
+
+    # stabilizer tableau: O(w^2) per Clifford op; each gadgetable magic
+    # payload costs an ancilla column + a forced-measurement cascade
+    if f.general_count == 0 and f.magic_count <= k.max_magic:
+        scores["stabilizer"] = (g * float(w * w)
+                                + f.magic_count * float(w * w) * 16.0
+                                ) * k.stab_weight
+    else:
+        scores["stabilizer"] = INFEASIBLE
+
+    # QBdt: stored amplitudes bounded by the worst cut's bond growth —
+    # each entangling gate crossing a cut can at most double the bond
+    bdt_pow = min(w, 2 * f.max_cut_crossings + 1)
+    scores["bdt"] = g * float(2 ** bdt_pow) * k.bdt_weight
+
+    # QUnit: dense work confined to the largest entangled block
+    blk = min(f.max_component, w)
+    scores["qunit"] = (g * float(2 ** blk) * k.qunit_weight
+                       if blk <= k.dense_max_qb else INFEASIBLE)
+    return scores
+
+
+def choose_stack(f: CircuitFeatures,
+                 knobs: Optional[RouteKnobs] = None,
+                 mode: Optional[str] = None) -> Tuple[str, Dict[str, float]]:
+    """(stack, scores) for `f` under `mode` (default: QRACK_ROUTE)."""
+    k = knobs or RouteKnobs.from_env()
+    mode = mode or route_mode()
+    scores = score_stacks(f, k)
+    if mode != "auto":
+        return mode, scores
+    # guard rail: exact polynomial representation beats any heuristic
+    if f.is_clifford and scores["stabilizer"] != INFEASIBLE:
+        return "stabilizer", scores
+    # the QBdt estimate is never infeasible (the tree always represents
+    # the state; the node-budget probe escalates it if it blows up), so
+    # min() always lands on a runnable stack
+    best = min(scores, key=lambda s: (scores[s], STACKS.index(s)))
+    return best, scores
+
+
+def layers_for(stack: str, width: int,
+               knobs: Optional[RouteKnobs] = None) -> Tuple[str, ...]:
+    """Factory layer spec realizing `stack` at `width`.  The stabilizer
+    route keeps a dense escape below it sized to the width: within the
+    dense cap the escape is the batch-capable TPU engine, past it the
+    width-switching hybrid (which would only be exercised by a
+    mis-route the admission probes failed to catch)."""
+    k = knobs or RouteKnobs.from_env()
+    if stack == "dense":
+        return ("tpu",) if width <= k.dense_max_qb else ("hybrid",)
+    if stack == "stabilizer":
+        return (("stabilizer_hybrid", "tpu") if width <= k.dense_max_qb
+                else ("stabilizer_hybrid", "hybrid"))
+    if stack == "bdt":
+        return ("bdt",)
+    if stack == "qunit":
+        return ("unit", "stabilizer_hybrid", "hybrid")
+    raise ValueError(f"unknown route stack {stack!r}")
+
+
+def default_stack(width: int, knobs: Optional[RouteKnobs] = None,
+                  mode: Optional[str] = None) -> str:
+    """Stack for an eager-gate caller (no circuit to inspect): start on
+    the stabilizer hybrid — Clifford prefixes stay polynomial and the
+    first general gate escapes to dense on its own — unless pinned."""
+    k = knobs or RouteKnobs.from_env()
+    mode = mode or route_mode()
+    if mode != "auto":
+        return mode
+    return "stabilizer"
+
+
+__all__ = ["INFEASIBLE", "STACKS", "RouteKnobs", "route_mode",
+           "score_stacks", "choose_stack", "layers_for", "default_stack"]

@@ -1,0 +1,404 @@
+"""QRouted: lazily-instantiated, feature-routed simulator stack.
+
+The ``"route"`` factory pseudo-layer builds this wrapper instead of a
+concrete stack.  Construction is free — no tableau, no planes, no
+device traffic — so a w100 session costs nothing until its first
+circuit arrives.  The first submitted ``QCircuit`` is classified
+(features.py), scored (cost.py), and the winning stack is built by the
+ordinary factory, which keeps resilience wrapping and telemetry
+counting identical to a hand-picked stack.  Eager gate callers (no
+circuit to inspect) get the width-appropriate default: the stabilizer
+hybrid, whose own dense escape hatch handles non-Clifford streams.
+
+Thread discipline mirrors serve/: :meth:`plan` is pure host work and
+safe on the submit (caller) thread; :meth:`apply_plan` constructs or
+escalates engines and runs ONLY on the dispatch-owner thread
+(serve/executor.py calls it before each job).  Library callers do both
+implicitly on their own thread.
+
+Mis-routes escalate to dense **exactly once** per wrapper, through the
+same snapshot-carry the failover chain uses (GetQuantumState onto the
+new stack, rng object carried so measurement streams continue):
+
+* a planned escalation — a later circuit's features are infeasible for
+  the resident cheap stack — lands BEFORE the circuit runs;
+* a stabilizer forced off-tableau mid-stream materializes its internal
+  dense engine on its own (layers/stabilizerhybrid.py SwitchToEngine);
+  the post-job probe just observes and re-labels it;
+* a QBdt whose node count blows past QRACK_ROUTE_BDT_MAX_NODES is
+  re-materialized onto dense at the next job/read boundary.
+
+A mis-route that CANNOT escalate (width past the dense cap) raises the
+typed :class:`MisrouteError` at plan time, before any state is lost.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from .. import telemetry as _tele
+from ..utils.rng import QrackRandom
+from . import cost as _cost
+from .features import extract_features
+
+
+class MisrouteError(RuntimeError):
+    """A routed session needs the dense representation but its width
+    cannot be densely represented — the circuit is refused at admission
+    rather than destroying the session's cheap-representation state."""
+
+
+@dataclass
+class RouteDecision:
+    stack: str
+    layers: Tuple[str, ...]
+    reason: str                      # "cost" | "pinned" | "default" | ...
+    scores: Dict[str, float] = field(default_factory=dict)
+    features: Optional[Dict[str, float]] = None
+
+
+def decide(circuit, width: int, mode: Optional[str] = None) -> RouteDecision:
+    """Score `circuit` at `width` and return the winning decision —
+    pure host work, no engine construction (the testable core of the
+    admission step)."""
+    knobs = _cost.RouteKnobs.from_env()
+    mode = mode or _cost.route_mode()
+    f = extract_features(circuit, width)
+    stack, scores = _cost.choose_stack(f, knobs, mode=mode)
+    return RouteDecision(stack=stack,
+                         layers=_cost.layers_for(stack, width, knobs),
+                         reason="pinned" if mode != "auto" else "cost",
+                         scores=scores, features=f.as_dict())
+
+
+# live wrappers, for the residency gauges (weak: a dropped session must
+# not be pinned alive by its own telemetry)
+_LIVE: "weakref.WeakSet[QRouted]" = weakref.WeakSet()
+
+
+def update_residency() -> None:
+    if not _tele._ENABLED:
+        return
+    counts = {s: 0 for s in _cost.STACKS}
+    unrouted = 0
+    for eng in list(_LIVE):
+        stack = eng.current_stack()
+        if stack is None:
+            unrouted += 1
+        elif stack in counts:
+            counts[stack] += 1
+    for stack, n in counts.items():
+        _tele.gauge(f"route.residency.{stack}", n)
+    _tele.gauge("route.residency.unrouted", unrouted)
+
+
+# reads whose observable result may depend on a cheap representation
+# that has silently stopped being cheap — probe (and possibly re-label/
+# escalate) before serving them on the library path
+_PROBE_BEFORE = frozenset({
+    "Prob", "ProbAll", "M", "ForceM", "MAll", "MReg",
+    "MultiShotMeasureMask", "GetQuantumState", "GetAmplitude",
+    "GetProbs", "ApproxCompare",
+})
+
+
+class QRouted:
+    """Forwarding wrapper (the engines/hybrid.py pattern) whose inner
+    stack does not exist until routing picks one."""
+
+    _is_routed = True
+    _ckpt_kind = "routed"
+
+    def __init__(self, qubit_count: int, init_state: int = 0,
+                 rng: Optional[QrackRandom] = None, **kwargs):
+        self.qubit_count = int(qubit_count)
+        self.rng = rng if rng is not None else QrackRandom()
+        self._init_state = int(init_state)
+        self._kwargs = dict(kwargs)       # forwarded to the chosen stack
+        self._decision: Optional[RouteDecision] = None
+        self._pending: Optional[RouteDecision] = None
+        self._engine = None
+        self._escalated = False
+        self._misroute_counted = False
+        self._lock = threading.Lock()
+        _LIVE.add(self)
+        update_residency()
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def engine(self):
+        return self._engine if self._engine is not None else self
+
+    def current_stack(self) -> Optional[str]:
+        d = self._decision
+        return d.stack if d is not None else None
+
+    def plans_dense(self) -> bool:
+        with self._lock:
+            d = self._pending or self._decision
+        return d is not None and d.stack == "dense"
+
+    # -- admission: plan (caller thread) / apply (dispatch thread) -----
+
+    def plan(self, circuit) -> RouteDecision:
+        """Record the routing decision `circuit` implies.  Pure host
+        work.  Decisions are monotone toward dense: once a wrapper
+        plans (or holds) the dense stack it never goes back, and a
+        cheap-stack session whose new circuit is infeasible for its
+        representation gets a planned escalation here — or a typed
+        MisrouteError when the width makes escalation impossible."""
+        knobs = _cost.RouteKnobs.from_env()
+        with self._lock:
+            if self._engine is None:
+                if self._pending is not None and self._pending.stack == "dense":
+                    return self._pending
+                d = decide(circuit, self.qubit_count)
+                if self._pending is None or d.stack == "dense":
+                    # first circuit decides; later pre-build circuits
+                    # may only upgrade the plan to dense
+                    self._pending = d
+                    self._note_decision(d)
+                return self._pending
+            d = self._decision
+            if self._escalated or d is None or d.stack == "dense":
+                return self._pending or d
+            if d.stack == "stabilizer":
+                f = extract_features(circuit, self.qubit_count)
+                if f.general_count > 0 or f.magic_count > knobs.max_magic:
+                    if self.qubit_count > knobs.dense_max_qb:
+                        raise MisrouteError(
+                            f"circuit needs a dense representation but "
+                            f"width {self.qubit_count} exceeds the dense "
+                            f"cap ({knobs.dense_max_qb}); refusing rather "
+                            "than destroying the stabilizer state")
+                    self._pending = RouteDecision(
+                        stack="dense",
+                        layers=_cost.layers_for("dense", self.qubit_count,
+                                                knobs),
+                        reason="misroute:planned", features=f.as_dict())
+                    self._note_misroute("planned")
+            return self._pending or d
+
+    def apply_plan(self) -> None:
+        """Realize the recorded plan: build the first engine, or
+        escalate a mis-routed cheap stack to dense.  DISPATCH-OWNER
+        THREAD ONLY on the serve path (engine construction and state
+        re-materialization are device traffic)."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        if self._engine is None:
+            self._build(pending)
+        elif pending.stack == "dense" and self.current_stack() != "dense":
+            self._escalate(pending.reason)
+
+    # -- engine lifecycle ----------------------------------------------
+
+    def _build(self, decision: RouteDecision) -> None:
+        from ..factory import create_quantum_interface
+
+        self._engine = create_quantum_interface(
+            decision.layers, self.qubit_count,
+            init_state=self._init_state, rng=self.rng, **self._kwargs)
+        self._decision = decision
+        if _tele._ENABLED:
+            _tele.inc(f"route.built.{decision.stack}")
+            _tele.event("route.build", stack=decision.stack,
+                        width=self.qubit_count, reason=decision.reason)
+        update_residency()
+
+    def _build_default(self) -> None:
+        """Eager-gate path: no circuit to inspect, route by width."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if self._engine is not None:
+            return
+        if pending is None:
+            knobs = _cost.RouteKnobs.from_env()
+            stack = _cost.default_stack(self.qubit_count, knobs)
+            pending = RouteDecision(
+                stack=stack,
+                layers=_cost.layers_for(stack, self.qubit_count, knobs),
+                reason="default")
+            self._note_decision(pending)
+        self._build(pending)
+
+    def _escalate(self, reason: str) -> None:
+        """Snapshot-carry the state onto the dense stack (the failover
+        chain's rehydration idiom: full-state read, SetQuantumState on
+        the replacement, rng OBJECT carried so the measurement stream
+        position survives)."""
+        from ..factory import create_quantum_interface
+
+        knobs = _cost.RouteKnobs.from_env()
+        if self.qubit_count > knobs.dense_max_qb:
+            raise MisrouteError(
+                f"cannot escalate width {self.qubit_count} to dense "
+                f"(cap {knobs.dense_max_qb})")
+        old_stack = self.current_stack()
+        state = self._engine.GetQuantumState()
+        dense = create_quantum_interface(
+            _cost.layers_for("dense", self.qubit_count, knobs),
+            self.qubit_count, rng=self.rng, **self._kwargs)
+        dense.SetQuantumState(state)
+        self._engine = dense
+        self._decision = RouteDecision(
+            stack="dense",
+            layers=_cost.layers_for("dense", self.qubit_count, knobs),
+            reason=f"escalated:{reason}")
+        self._escalated = True
+        if _tele._ENABLED:
+            _tele.inc("route.misroute.escalated")
+            _tele.event("route.escalate", reason=reason,
+                        from_stack=old_stack, to_stack="dense",
+                        width=self.qubit_count)
+        update_residency()
+
+    def route_for(self, circuit):
+        """Library-path admission (layers/qcircuit.py Run/RunFused):
+        plan on the calling thread, realize immediately, and return the
+        engine the circuit should dispatch into.  May raise
+        :class:`MisrouteError` exactly as the serve admission does."""
+        if getattr(circuit, "gates", None):
+            self.plan(circuit)
+            self.apply_plan()
+        if self._engine is None:
+            self._build_default()
+        return self._engine
+
+    # -- mis-route probes ----------------------------------------------
+
+    def misroute_check(self) -> None:
+        """Job/read-boundary probe: has the cheap representation
+        silently stopped being cheap?  Re-labels a stabilizer that
+        materialized its internal dense engine (that switch WAS the
+        escalation — state already lives on the dense escape hatch) and
+        escalates a QBdt past its node budget.  Never raises: a tree
+        too wide to escalate keeps running exactly, just slowly."""
+        if self._engine is None or self._escalated:
+            return
+        d = self._decision
+        if d is None:
+            return
+        knobs = _cost.RouteKnobs.from_env()
+        if d.stack == "stabilizer":
+            from ..layers.stabilizerhybrid import QStabilizerHybrid
+
+            inner = self._engine
+            if (isinstance(inner, QStabilizerHybrid)
+                    and inner.engine is not None):
+                self._note_misroute("off_tableau")
+                self._decision = RouteDecision(
+                    stack="dense", layers=d.layers,
+                    reason="escalated:off_tableau")
+                self._escalated = True
+                if _tele._ENABLED:
+                    _tele.inc("route.misroute.escalated")
+                    _tele.event("route.escalate", reason="off_tableau",
+                                from_stack="stabilizer", to_stack="dense",
+                                width=self.qubit_count)
+                update_residency()
+        elif d.stack == "bdt":
+            from ..layers.qbdt import QBdt
+
+            inner = self._engine
+            if (isinstance(inner, QBdt)
+                    and not inner.within_node_budget(knobs.bdt_max_nodes)):
+                self._note_misroute("bdt_nodes")
+                if self.qubit_count <= knobs.dense_max_qb:
+                    self._escalate("bdt_nodes")
+                elif _tele._ENABLED:
+                    _tele.inc("route.misroute.unescalatable")
+
+    def note_job(self) -> None:
+        if _tele._ENABLED:
+            _tele.inc(f"route.jobs.{self.current_stack() or 'pending'}")
+
+    def _note_decision(self, d: RouteDecision) -> None:
+        if _tele._ENABLED:
+            _tele.inc("route.decisions")
+            _tele.inc(f"route.decision.{d.stack}")
+            _tele.event("route.decision", stack=d.stack, reason=d.reason,
+                        **(d.features or {"width": self.qubit_count}))
+
+    def _note_misroute(self, reason: str) -> None:
+        if self._misroute_counted:
+            return
+        self._misroute_counted = True
+        if _tele._ENABLED:
+            # telemetry.event() also bumps a counter under the event's
+            # own name, so the aggregate counter takes the plural
+            _tele.inc("route.misroutes")
+            _tele.event("route.misroute", reason=reason,
+                        stack=self.current_stack() or "pending",
+                        width=self.qubit_count)
+
+    # -- forwarding ----------------------------------------------------
+
+    def __getattr__(self, name):
+        # private/dunder probes must never force an engine into
+        # existence (hasattr checks, pickling, elastic probes)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self.__dict__.get("_engine") is None:
+            self._build_default()
+        if name in _PROBE_BEFORE:
+            self.misroute_check()
+        return getattr(self._engine, name)
+
+    def __repr__(self) -> str:
+        stack = self.current_stack() or "unrouted"
+        return (f"QRouted(n={self.qubit_count}, stack={stack}, "
+                f"engine={type(self._engine).__name__})")
+
+    # -- checkpoint protocol (checkpoint/registry.py) ------------------
+
+    def _ckpt_capture(self, capture_child):
+        if self._engine is None:
+            # materialize the default stack so the snapshot holds real
+            # state; spill-before-first-use is rare and |0..0> is cheap
+            # on every default stack
+            self._build_default()
+        d = self._decision
+        return {"kind": "routed",
+                "meta": {"n": self.qubit_count,
+                         "stack": d.stack if d else None,
+                         "layers": list(d.layers) if d else None,
+                         "reason": d.reason if d else None,
+                         "escalated": bool(self._escalated),
+                         "misroute_counted": bool(self._misroute_counted)},
+                "children": {"engine": capture_child(self._engine)}}
+
+    def _ckpt_restore(self, arrays, meta, children, restore_child):
+        if int(meta["n"]) != self.qubit_count:
+            raise ValueError("checkpoint width mismatch")
+        layers = tuple(meta.get("layers") or ())
+        stack = meta.get("stack")
+        self._escalated = bool(meta.get("escalated", False))
+        self._misroute_counted = bool(meta.get("misroute_counted", False))
+        self._pending = None
+        if stack is not None and (self._engine is None
+                                  or self.current_stack() != stack):
+            from ..factory import create_quantum_interface
+
+            self._engine = create_quantum_interface(
+                layers, self.qubit_count, rng=self.rng, **self._kwargs)
+        self._decision = (RouteDecision(stack=stack, layers=layers,
+                                        reason=meta.get("reason")
+                                        or "restored")
+                          if stack is not None else None)
+        if self._engine is not None:
+            self._engine = restore_child(children["engine"], self._engine)
+            rng = getattr(self._engine, "rng", None)
+            if rng is not None:
+                self.rng = rng
+        update_residency()
+
+
+__all__ = ["QRouted", "RouteDecision", "MisrouteError", "decide",
+           "update_residency"]

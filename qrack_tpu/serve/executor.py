@@ -87,6 +87,16 @@ class Executor:
         for job in batch:
             if job.session is not None and job.session.spilled:
                 self.sessions.ensure_resident(job.session)
+        # realize routing plans before anything inspects the engine:
+        # building the routed stack (or escalating a mis-route) is
+        # device traffic and belongs to this thread (route/router.py)
+        for job in batch:
+            sess = job.session
+            if sess is not None and getattr(sess.engine, "_is_routed",
+                                            False):
+                sess.engine.apply_plan()
+                if job.kind == "circuit":
+                    sess.engine.note_job()
         # job boundaries are the serve-path recovery probe: a session
         # whose pager shrank under device loss grows back to its
         # construction page count here once the device looks healthy
@@ -103,6 +113,14 @@ class Executor:
             self._run_batched(batch)
         else:
             self._run_single(batch[0])
+        # job-boundary mis-route probe: a stabilizer forced off-tableau
+        # or a QBdt past its node budget escalates (once) right here,
+        # before the next job lands on the wrong representation
+        for job in batch:
+            sess = job.session
+            if (job.kind == "circuit" and sess is not None
+                    and getattr(sess.engine, "_is_routed", False)):
+                sess.engine.misroute_check()
 
     # -- batched circuit path ------------------------------------------
 

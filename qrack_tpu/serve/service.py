@@ -169,11 +169,28 @@ class QrackService:
     def submit(self, sid: str, circuit, priority: int = 0) -> JobHandle:
         """Queue `circuit` against session `sid`; returns immediately
         with a JobHandle.  Raises typed admission errors (QueueFull /
-        LoadShed / ServiceStopped) synchronously."""
+        LoadShed / ServiceStopped / MisrouteError) synchronously.
+
+        Routing admission: a session built on the ``"route"`` pseudo-
+        layer gets its circuit classified and a stack decision recorded
+        HERE (pure host work — docs/ROUTING.md); the executor realizes
+        the plan on the dispatch-owner thread before the job runs.
+        ``QRACK_ROUTE=dense`` opts a deployment out (every decision
+        pins dense); explicit stacks pin likewise."""
         sess = self.sessions.get(sid)
+        routed = getattr(sess.engine, "_is_routed", False)
+        if routed and circuit.gates:
+            from ..route import admit as _route_admit
+
+            _route_admit(sess.engine, circuit)  # may raise MisrouteError
         shape_key = None
-        if planes_engine(sess.engine) is not None and circuit.gates:
-            shape_key = circuit.shape_key(sess.width)
+        if circuit.gates:
+            if planes_engine(sess.engine) is not None:
+                shape_key = circuit.shape_key(sess.width)
+            elif routed and sess.engine.plans_dense():
+                # dense-routed but not built yet: key the job anyway so
+                # routed jobs still bucket+batch by stack+shape
+                shape_key = circuit.shape_key(sess.width)
         job = Job(sess, "circuit", circuit=circuit, shape_key=shape_key,
                   priority=priority)
         if self.store is not None:

@@ -34,8 +34,16 @@ def planes_engine(engine):
     from ..engines.tpu import QEngineTPU
 
     seen = 0
-    while seen < 4:  # proxy -> hybrid -> engine chains are short
+    while seen < 6:  # proxy -> router -> hybrid -> engine chains are short
         seen += 1
+        if getattr(engine, "_is_routed", False):
+            # QRouted: inner stack may not exist yet (not batchable
+            # until the router builds a dense engine) — never forward
+            # through __getattr__ here, it would force construction
+            engine = engine._engine
+            if engine is None:
+                return None
+            continue
         from ..resilience.failover import ResilientEngine
 
         if isinstance(engine, ResilientEngine):
@@ -58,8 +66,14 @@ def engine_touches_tunnel(engine) -> bool:
 
     inner = engine
     seen = 0
-    while seen < 4:
+    while seen < 6:
         seen += 1
+        if getattr(inner, "_is_routed", False):
+            inner = inner._engine
+            if inner is None:
+                # unrouted session: no engine, nothing dispatches
+                return False
+            continue
         from ..resilience.failover import ResilientEngine
 
         if isinstance(inner, ResilientEngine):

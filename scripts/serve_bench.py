@@ -21,12 +21,28 @@ does NOT beat that number — same FLOPs, bigger cache footprint — the
 serving win is compile + dispatch-round-trip amortization across
 tenants, not per-gate arithmetic.  docs/SERVING.md records both.
 
+MIXED-TRAFFIC mode (--mixed, docs/ROUTING.md): one routed service
+(engine_layers="route") hosts three tenant classes at once — Clifford-
+heavy GHZ tenants, dense quantum-volume tenants, and shallow-QAOA
+tenants — and the same traffic replays with QRACK_ROUTE=dense forced.
+Per-class walls are timed class-phased within the shared service (every
+session stays resident across the whole round), completion stays
+devget-honest (the executor's sync step), and the headline is the
+routed-vs-forced speedup on the Clifford class, measured at a
+dense-feasible width so the forced baseline can exist at all.  A w100
+Clifford tenant additionally rides the routed phase only: past the
+dense cap there IS no forced baseline — that impossibility is the
+routing subsystem's reason to exist.
+
 Usage:
     python scripts/serve_bench.py [--width 16] [--jobs 8] [--rounds 4]
                                   [--layers tpu] [--window-ms 50] [--json]
+    python scripts/serve_bench.py --mixed [--clifford-width 20]
+                                  [--qaoa-width 12] [--wide-width 100]
 
-Exit 0 when the acceptance bar holds (cold AND steady-state serve
-rounds < 0.6x the sequential library wall), 1 otherwise.
+Exit 0 when the acceptance bar holds (default: cold AND steady-state
+serve rounds < 0.6x the sequential library wall; --mixed: routed
+Clifford class >= 10x faster than dense-forced), 1 otherwise.
 """
 
 import argparse
@@ -119,6 +135,105 @@ def measure_serve(width, jobs, rounds, layers, window_ms, **engine_kwargs):
     return walls, handles_steady
 
 
+def _measure_mixed_phase(args, mode):
+    """One full mixed-traffic run with QRACK_ROUTE pinned to `mode`
+    ("auto" = routing on, "dense" = forced).  Returns per-class wall
+    lists (one entry per round; round 0 is cold) plus, in auto mode,
+    walls for the w100 Clifford tenant no forced baseline can serve."""
+    from qrack_tpu.models.algorithms import (ghz_qcircuit, qaoa_qcircuit,
+                                             quantum_volume_qcircuit)
+    from qrack_tpu.utils.rng import QrackRandom
+
+    prev = os.environ.get("QRACK_ROUTE")
+    os.environ["QRACK_ROUTE"] = mode
+    walls = {"clifford": [], "dense": [], "qaoa": [], "wide": []}
+    try:
+        svc = QrackService(engine_layers="route",
+                           max_depth=8 * args.jobs + 16,
+                           batch_window_ms=args.window_ms,
+                           max_batch=args.jobs,
+                           queue_budget_ms=600_000.0)
+        try:
+            tenants = {
+                "clifford": ([svc.create_session(args.clifford_width, seed=i)
+                              for i in range(args.jobs)],
+                             lambda: ghz_qcircuit(args.clifford_width)),
+                # fresh circuit OBJECT per submission, same content
+                # every round and phase (fixed seed): steady rounds are
+                # warm in BOTH phases, so routed-vs-forced is fair
+                "dense": ([svc.create_session(args.width, seed=100 + i)
+                           for i in range(args.jobs)],
+                          lambda: quantum_volume_qcircuit(
+                              args.width, rng=QrackRandom(17))),
+                "qaoa": ([svc.create_session(args.qaoa_width, seed=200 + i)
+                          for i in range(args.jobs)],
+                         lambda: qaoa_qcircuit(args.qaoa_width, p=1)),
+            }
+            if mode == "auto" and args.wide_width:
+                tenants["wide"] = (
+                    [svc.create_session(args.wide_width, seed=300)],
+                    lambda: ghz_qcircuit(args.wide_width))
+            for _ in range(args.rounds):
+                for cls, (sids, make) in tenants.items():
+                    circs = [make() for _ in sids]
+                    t0 = time.perf_counter()
+                    handles = [svc.submit(sid, c)
+                               for sid, c in zip(sids, circs)]
+                    for h in handles:
+                        h.result(timeout=600)
+                    walls[cls].append(time.perf_counter() - t0)
+        finally:
+            svc.close()
+    finally:
+        if prev is None:
+            os.environ.pop("QRACK_ROUTE", None)
+        else:
+            os.environ["QRACK_ROUTE"] = prev
+    return walls
+
+
+def run_mixed(args) -> dict:
+    tele.enable()
+    tele.reset()
+    routed = _measure_mixed_phase(args, "auto")
+    snap = tele.snapshot()
+    route_jobs = {k[len("route.jobs."):]: v
+                  for k, v in snap["counters"].items()
+                  if k.startswith("route.jobs.")}
+    tele.reset()
+    forced = _measure_mixed_phase(args, "dense")
+
+    def steady(ws):
+        tail = ws[1:] or ws
+        return float(np.median(tail)) if tail else None
+
+    res = {
+        "mode": "mixed",
+        "jobs_per_class": args.jobs, "rounds": args.rounds,
+        "clifford_width": args.clifford_width, "dense_width": args.width,
+        "qaoa_width": args.qaoa_width, "wide_width": args.wide_width,
+        "routed_jobs_by_stack": route_jobs,
+        "misroutes": snap["counters"].get("route.misroutes", 0),
+    }
+    for cls in ("clifford", "dense", "qaoa"):
+        r, f = steady(routed[cls]), steady(forced[cls])
+        res[f"routed_{cls}_steady_wall_s"] = round(r, 6)
+        res[f"forced_{cls}_steady_wall_s"] = round(f, 6)
+        res[f"{cls}_jobs_per_s_routed"] = round(args.jobs / r, 2)
+        res[f"{cls}_jobs_per_s_forced"] = round(args.jobs / f, 2)
+        res[f"{cls}_speedup_vs_forced"] = round(f / r, 2)
+    if routed["wide"]:
+        w = steady(routed["wide"])
+        res["wide_clifford_steady_wall_s"] = round(w, 6)
+        res["wide_clifford_jobs_per_s"] = round(1.0 / w, 2)
+        res["wide_clifford_forced"] = "unservable (width past dense cap)"
+    for k in ("clifford_speedup_vs_forced", "dense_speedup_vs_forced",
+              "qaoa_speedup_vs_forced"):
+        tele.gauge(f"route.bench.{k}", res[k])
+    res["pass_10x_clifford"] = bool(res["clifford_speedup_vs_forced"] >= 10.0)
+    return res
+
+
 def run(args) -> dict:
     tele.enable()
     tele.reset()
@@ -183,7 +298,45 @@ def main(argv=None) -> int:
                          "engine on whatever backend jax selects)")
     ap.add_argument("--window-ms", type=float, default=50.0)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--mixed", action="store_true",
+                    help="mixed-traffic routing bench: Clifford + dense "
+                         "QV + shallow-QAOA tenants in ONE routed "
+                         "service, vs the same traffic QRACK_ROUTE="
+                         "dense-forced (docs/ROUTING.md)")
+    ap.add_argument("--clifford-width", type=int, default=20,
+                    help="Clifford tenant width — dense-FEASIBLE so the "
+                         "forced baseline exists (default 20)")
+    ap.add_argument("--qaoa-width", type=int, default=12)
+    ap.add_argument("--wide-width", type=int, default=100,
+                    help="extra routed-only Clifford tenant width (no "
+                         "forced baseline possible; 0 disables)")
     args = ap.parse_args(argv)
+
+    if args.mixed:
+        res = run_mixed(args)
+        if args.json:
+            print(json.dumps(res, indent=1, sort_keys=True))
+        else:
+            print(f"mixed traffic x{args.jobs}/class, {args.rounds} rounds "
+                  f"(devget-honest; steady = median of post-cold rounds)")
+            for cls, w in (("clifford", args.clifford_width),
+                           ("dense", args.width),
+                           ("qaoa", args.qaoa_width)):
+                print(f"  {cls:<9s} w{w:<3d} routed "
+                      f"{res[f'routed_{cls}_steady_wall_s'] * 1e3:9.1f} ms "
+                      f"({res[f'{cls}_jobs_per_s_routed']:>8.2f} jobs/s) | "
+                      f"forced dense "
+                      f"{res[f'forced_{cls}_steady_wall_s'] * 1e3:9.1f} ms "
+                      f"-> {res[f'{cls}_speedup_vs_forced']:.2f}x")
+            if "wide_clifford_steady_wall_s" in res:
+                print(f"  clifford  w{args.wide_width:<3d} routed "
+                      f"{res['wide_clifford_steady_wall_s'] * 1e3:9.1f} ms "
+                      f"| forced dense: {res['wide_clifford_forced']}")
+            print(f"  routed jobs by stack: {res['routed_jobs_by_stack']} "
+                  f"(misroutes={res['misroutes']:.0f})")
+            print(f"  acceptance (clifford >=10x vs forced): "
+                  f"{'PASS' if res['pass_10x_clifford'] else 'FAIL'}")
+        return 0 if res["pass_10x_clifford"] else 1
 
     res = run(args)
     if args.json:

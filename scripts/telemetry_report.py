@@ -13,6 +13,9 @@ sum; spans merge).  Sections:
   * exchange traffic: pager/ICI event counts and bytes
   * serving: jobs admitted/shed/expired/completed, batch occupancy
     (batched jobs per dispatch), queue-depth / latency gauges
+  * routing: decisions and executed jobs per stack with per-stack hit
+    rates, mis-routes and escalations, live residency gauges
+    (route.residency.<stack>) — docs/ROUTING.md
   * checkpoint: save/restore counts + bytes, spill-store footprint,
     warm-start programs recorded/prewarmed, recovery-lease traffic
   * elasticity: repage shrink/expand traffic, failed expansions,
@@ -89,6 +92,7 @@ def report(snap: dict, top: int) -> dict:
         "fusion": {},
         "exchange": {},
         "serve": {},
+        "route": {},
         "checkpoint": {},
         "elastic": {},
         "gauges": snap.get("gauges", {}),
@@ -107,6 +111,8 @@ def report(snap: dict, top: int) -> dict:
             out["exchange"][k] = v
         elif k.startswith("serve."):
             out["serve"][k] = v
+        elif k.startswith("route."):
+            out["route"][k] = v
         elif k.startswith("checkpoint."):
             out["checkpoint"][k] = v
         elif k.startswith("elastic."):
@@ -129,6 +135,14 @@ def report(snap: dict, top: int) -> dict:
     if dispatches:
         out["serve"]["batch_occupancy"] = round(
             out["serve"].get("serve.batch.jobs", 0) / dispatches, 3)
+    # per-stack hit rates: fraction of routed jobs each stack executed
+    routed_jobs = sum(v for k, v in out["route"].items()
+                      if k.startswith("route.jobs."))
+    if routed_jobs:
+        for k in [k for k in out["route"] if k.startswith("route.jobs.")]:
+            stack = k[len("route.jobs."):]
+            out["route"][f"hit_rate.{stack}"] = round(
+                out["route"][k] / routed_jobs, 4)
     return out
 
 
@@ -166,6 +180,10 @@ def main(argv=None) -> int:
     if rep["serve"]:
         print("== serve ==")
         for name, v in sorted(rep["serve"].items()):
+            print(f"  {name:<40s} {v:>12.3f}")
+    if rep["route"]:
+        print("== routing ==")
+        for name, v in sorted(rep["route"].items()):
             print(f"  {name:<40s} {v:>12.3f}")
     if rep["checkpoint"]:
         print("== checkpoint ==")
