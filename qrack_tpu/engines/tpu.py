@@ -57,7 +57,14 @@ def _device_get(fn, *args):
     proves completion over the relay — and therefore the one that hangs
     when the tunnel wedges mid-flight."""
     if _res._ACTIVE:
-        return _res.call_guarded("tpu.device_get", fn, args)
+        out = _res.call_guarded("tpu.device_get", fn, args)
+        from ..resilience import integrity as _integ
+
+        if _integ.enabled():
+            # boundary invariant piggybacked on the value the caller
+            # already forced to host — no extra HBM sweep
+            _integ.check_host("tpu.device_get", out)
+        return out
     return fn(*args)
 
 

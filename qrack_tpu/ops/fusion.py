@@ -359,6 +359,16 @@ class GateStreamFuser:
             gate = QCircuitGate.controlled(controls, target, m, perm)
         else:
             gate = QCircuitGate.single(target, m)
+        # flush a full window BEFORE admitting the new gate: when the
+        # flush escalates past in-place repair (DispatchGiveUp ->
+        # wrapper-level failover), the failover snapshot re-runs the
+        # kept window and the wrapper replays the TRIGGERING CALL on
+        # the fallback — a gate living in both would apply twice.
+        # Keeping the trigger out of the flushed window makes the two
+        # disjoint, which is the exactly-once property the integrity
+        # replay path (resilience/integrity.py) also leans on.
+        if len(self.gates) >= self.window:
+            self.flush("window_full")
         self._append_merge(gate)
         self._raw += 1
         if _tele._ENABLED:
@@ -370,8 +380,6 @@ class GateStreamFuser:
         # never flush yet were still requested.  May itself force a
         # flush (a drift check reads the state).
         eng._fuse_tick()
-        if len(self.gates) >= self.window:
-            self.flush("window_full")
         return True
 
     def _append_merge(self, gate) -> None:
@@ -407,11 +415,27 @@ class GateStreamFuser:
         if not self.gates or self._flushing:
             return
         eng = self.engine
+        guard = None
+        if _res._ACTIVE:
+            from ..resilience import integrity as _integ
+
+            if _integ.enabled():
+                guard = _integ
         self._flushing = True
         try:
             while True:
                 try:
-                    dispatched = eng._fuse_flush(self.gates)
+                    if guard is not None:
+                        # snapshot → dispatch → verify → replay: silent
+                        # corruption inside the window restores the
+                        # pre-flush planes and re-dispatches the SAME
+                        # kept gates; repeated corruption escalates as
+                        # DispatchGiveUp into the shrink path below with
+                        # good planes already restored (integrity.py)
+                        dispatched = guard.guarded_flush(
+                            eng, lambda: eng._fuse_flush(self.gates))
+                    else:
+                        dispatched = eng._fuse_flush(self.gates)
                     break
                 except Exception as e:  # noqa: BLE001 — filtered below
                     from ..resilience.errors import FAILOVER_ERRORS
@@ -452,8 +476,18 @@ class GateStreamFuser:
 
 def make_fuser(engine):
     """Install-time factory: None when fusion is off (window <= 1) or the
-    engine opted out (``_fuse_capable``)."""
+    engine opted out (``_fuse_capable``).  With the integrity guard
+    plane armed a window-1 fuser is forced even when fusion is off —
+    the flush envelope is where snapshot/verify/replay lives, so
+    per-gate dispatch still gets corruption repair (docs/INTEGRITY.md)."""
+    if not getattr(engine, "_fuse_capable", False):
+        return None
     w = window_len()
-    if w <= 1 or not getattr(engine, "_fuse_capable", False):
+    if w <= 1:
+        if _res._ACTIVE:
+            from ..resilience import integrity as _integ
+
+            if _integ.enabled():
+                return GateStreamFuser(engine, 1)
         return None
     return GateStreamFuser(engine, w)

@@ -157,6 +157,12 @@ class QPager(QEngine):
         self.g_bits = log2(n_pages)
         self._max_g = self.g_bits
         self._all_devices = dev_list
+        # devices beyond the page prefix: integrity quarantine swaps one
+        # in when a chip is excluded, keeping full page count when it can
+        self._spare_devices = list(devices)[n_pages:]
+        # last integrity-quarantine epoch acted on (healthy-path cost of
+        # the job-boundary probe: one module read + int compare)
+        self._quarantine_epoch = 0
         # elastic degradation marker: construction page exponent to grow
         # back to, set by shrink_pages, cleared by expand_pages (None =
         # healthy).  docs/ELASTICITY.md
@@ -182,6 +188,17 @@ class QPager(QEngine):
         if f is not None and f.gates and not f._flushing:
             f.flush("read")
         return self._state_raw
+
+    def _settle(self) -> None:
+        # a flush can shrink the pager in place (fusion escalation,
+        # ELASTICITY.md), so kernels that build mesh-keyed operands —
+        # iota, cached programs, sharding, local_bits masks — before
+        # their first `_state` read must force the pending window out
+        # FIRST, or the dispatch pairs a post-shrink state with
+        # pre-shrink operands (mixed-device ValueError)
+        f = self._fuser
+        if f is not None and f.gates and not f._flushing:
+            f.flush("read")
 
     @_state.setter
     def _state(self, local) -> None:
@@ -538,6 +555,7 @@ class QPager(QEngine):
         return _program(self._key("phaseapply"), build)
 
     def _k_phase_fn(self, fn, split=None) -> None:
+        self._settle()
         if split is not None and self._wide_alu:
             self._phase_fn_wide(split)
             return
@@ -592,6 +610,7 @@ class QPager(QEngine):
         return self.force_wide_alu or self.qubit_count > 31
 
     def _k_gather(self, src_fn, split=None) -> None:
+        self._settle()
         if not self._wide_alu:
             src = src_fn(self._global_iota())
             self._state = self._p_gather()(self._state, src)
@@ -654,6 +673,7 @@ class QPager(QEngine):
             # forms (MUL/DIV/*ModNOut included); reaching this kernel
             # wide means a new op needs its own split form
             raise NotImplementedError("see the `split=` gather forms")
+        self._settle()
         src_idx = jnp.asarray(src_idx, dtype=gk.IDX_DTYPE)
         dst_idx = jnp.asarray(dst_idx, dtype=gk.IDX_DTYPE)
         if passthrough_cmask is not None:
@@ -669,17 +689,20 @@ class QPager(QEngine):
         return np.asarray(jax.jit(gk.probs)(self._state), dtype=np.float64)
 
     def _k_prob_mask(self, mask, perm) -> float:
+        self._settle()
         lmask, lval, gmask, gval = _split_masks(mask, perm, self.local_bits)
         p = float(_host_read(self._p_prob_mask()(self._state, lmask, lval, gmask, gval)))
         return min(max(p, 0.0), 1.0)
 
     def _k_collapse(self, mask, val, nrm_sq) -> None:
+        self._settle()
         lmask, lval, gmask, gval = _split_masks(mask, val, self.local_bits)
         self._state = self._p_collapse()(self._state, lmask, lval, gmask, gval, nrm_sq)
 
     def MAll(self) -> int:
         """Two-stage sample: page marginals (psum over mesh), then an
         in-page draw — only one page ever reaches the host."""
+        self._settle()
         pp = self._p_page_probs()(self._state)
         if not pp.is_fully_addressable:
             from jax.experimental import multihost_utils
@@ -914,6 +937,24 @@ class QPager(QEngine):
         self._sharding_for(n + length)
         self._state = new_state
 
+    def _device_pool(self):
+        """Device preference order for (re-)paging: the construction
+        prefix with integrity-quarantined chips excluded, then spares —
+        so a quarantined chip is replaced by a spare at the next
+        re-page instead of capping capacity (docs/INTEGRITY.md).  Falls
+        back to the construction list rather than return an empty pool:
+        a fully-quarantined mesh still has to serve."""
+        if not _res._ACTIVE:
+            return self._all_devices
+        from ..resilience import integrity as _integ
+
+        q = _integ.quarantined()
+        if not q:
+            return self._all_devices
+        pool = [d for d in self._all_devices + self._spare_devices
+                if d.id not in q]
+        return pool if pool else self._all_devices
+
     def _sharding_for(self, qubit_count):
         """Sharding for a new width: drops pages when the ket gets
         smaller than the page count and re-grows back to the
@@ -922,7 +963,7 @@ class QPager(QEngine):
         src/qpager.cpp:316-367)."""
         new_g = self._desired_g(qubit_count)
         if new_g != self.g_bits:
-            devs = self._all_devices[: 1 << new_g]
+            devs = self._device_pool()[: 1 << new_g]
             self.n_pages = 1 << new_g
             self.g_bits = new_g
             self.mesh = Mesh(np.array(devs), ("pages",))
@@ -970,7 +1011,7 @@ class QPager(QEngine):
         if self._elastic_target_g is None:
             self._elastic_target_g = self._max_g
         if state is not None:
-            devs = self._all_devices[: 1 << new_g]
+            devs = self._device_pool()[: 1 << new_g]
             mesh = Mesh(np.array(devs), ("pages",))
             sharding = NamedSharding(mesh, P(None, "pages"))
             st = np.asarray(state).reshape(-1)
@@ -1001,7 +1042,7 @@ class QPager(QEngine):
             # refused by an open breaker — same discipline as failover
             # snapshots (docs/RESILIENCE.md caveats)
             planes = self._fetch(0, 1 << self.qubit_count)
-        devs = self._all_devices[: 1 << new_g]
+        devs = self._device_pool()[: 1 << new_g]
         mesh = Mesh(np.array(devs), ("pages",))
         sharding = NamedSharding(mesh, P(None, "pages"))
         new_state = jax.device_put(
@@ -1019,6 +1060,11 @@ class QPager(QEngine):
         target = self._elastic_target_g
         if target is None:
             return True
+        if _res._ACTIVE:
+            # quarantine caps recovery: never expand onto more pages
+            # than the healthy pool (spares included) can host
+            pool_g = log2(max(1, len(self._device_pool())))
+            target = min(target, pool_g)
         self._max_g = target
         new_g = self._desired_g(self.qubit_count)
         try:
@@ -1036,10 +1082,49 @@ class QPager(QEngine):
             _tele.gauge("elastic.pages", self.n_pages)
         return True
 
+    def _quarantine_repage(self) -> bool:
+        """Move the ket OFF freshly-quarantined chips at a job boundary:
+        re-page at the SAME page count when the healthy pool (spares
+        included) still covers it, else shrink a level and keep serving
+        (docs/INTEGRITY.md quarantine semantics)."""
+        pool = self._device_pool()
+        if len(pool) >= self.n_pages:
+            try:
+                self._repage(self.g_bits)
+            except Exception:  # noqa: BLE001 — stay on current topology
+                if _tele._ENABLED:
+                    _tele.inc("integrity.quarantine.repage_failed")
+                return False
+            if _tele._ENABLED:
+                _tele.event("integrity.quarantine.repage",
+                            pages=self.n_pages)
+            return True
+        if self.can_shrink():
+            self.shrink_pages()
+            if _tele._ENABLED:
+                _tele.event("integrity.quarantine.shrink",
+                            pages=self.n_pages)
+            return True
+        if _tele._ENABLED:
+            _tele.inc("integrity.quarantine.repage_failed")
+        return False
+
     def maybe_reexpand(self) -> bool:
         """Call-boundary hook (ResilientEngine / QHybrid / the serve
         executor): expand when degraded AND the health probe passes.
-        One attribute test when healthy — cheap enough for hot paths."""
+        One attribute test when healthy — cheap enough for hot paths.
+        Also the integrity quarantine consumer: when the quarantine
+        epoch moved and this pager still holds planes on a quarantined
+        chip, re-page off it first."""
+        if _res._ACTIVE:
+            from ..resilience import integrity as _integ
+
+            ep = _integ._EPOCH
+            if ep != self._quarantine_epoch:
+                self._quarantine_epoch = ep
+                q = _integ.quarantined()
+                if q and any(d.id in q for d in self.mesh.devices.flat):
+                    self._quarantine_repage()
         if self._elastic_target_g is None:
             return False
         probe = self.__dict__.get("elastic_probe") or type(self).elastic_probe
@@ -1147,8 +1232,15 @@ class QPager(QEngine):
                     dtype=np.float64)
 
             if _res._ACTIVE:  # site "pager.device_get": the relay sync
-                return _res.call_guarded("pager.device_get", read,
-                                         (self._state,))
+                planes = _res.call_guarded("pager.device_get", read,
+                                           (self._state,))
+                from ..resilience import integrity as _integ
+
+                if _integ.enabled():
+                    # boundary invariant piggybacked on the fetched
+                    # window — no extra HBM sweep (docs/INTEGRITY.md)
+                    _integ.check_host("pager.device_get", planes)
+                return planes
             return read(self._state)
         from .cluster import replicate_program
 
@@ -1173,6 +1265,7 @@ class QPager(QEngine):
 
     def SetAmplitude(self, perm: int, amp: complex) -> None:
         amp = complex(amp)
+        self._settle()
 
         sh = self.sharding
 
@@ -1198,6 +1291,7 @@ class QPager(QEngine):
         self.running_norm = 1.0
 
     def Clone(self) -> "QPager":
+        self._settle()
         c = QPager(
             self.qubit_count, n_pages=self.n_pages,
             devices=list(self.mesh.devices.flat), dtype=self.dtype,
@@ -1230,6 +1324,8 @@ class QPager(QEngine):
         )
 
     def IsZeroAmplitude(self) -> bool:
+        self._settle()
+
         def build():
             return jax.jit(lambda s: jnp.any(s != 0),
                            out_shardings=NamedSharding(self.mesh, P()))
@@ -1241,6 +1337,7 @@ class QPager(QEngine):
         return planes[0] + 1j * planes[1]
 
     def SetAmplitudePage(self, page, offset: int) -> None:
+        self._settle()
         sh = self.sharding
 
         def build():

@@ -20,6 +20,9 @@ Layout (import order matters — no cycles, no jax at import time):
 * probe.py     — stdlib-only SIGTERM-first subprocess probe
 * failover.py  — ResilientEngine + fail_over_engine (imports engines;
   loaded lazily by consumers, NOT here)
+* integrity.py — silent-corruption detection, window replay, device
+  quarantine (imports errors + telemetry only; loaded lazily by the
+  flush path — see docs/INTEGRITY.md)
 
 See docs/RESILIENCE.md.
 """
@@ -28,9 +31,10 @@ from __future__ import annotations
 
 import os as _os
 
-from .errors import (BreakerOpen, DeviceLost, DispatchFailure,
-                     DispatchGiveUp, DispatchTimeout, FAILOVER_ERRORS,
-                     InjectedFault, NaNPoisoned, ResilienceError)
+from .errors import (BreakerOpen, CorruptionDetected, DeviceLost,
+                     DispatchFailure, DispatchGiveUp, DispatchTimeout,
+                     FAILOVER_ERRORS, InjectedFault, NaNPoisoned,
+                     ResilienceError)
 from . import faults
 from .breaker import CircuitBreaker, get_breaker, reset_breaker
 from .dispatch import (DispatchParams, call_guarded, configure,
@@ -39,7 +43,8 @@ from .probe import ProbeResult, ensure_backend, run_probe
 
 __all__ = [
     "ResilienceError", "DispatchFailure", "DispatchTimeout", "DeviceLost",
-    "NaNPoisoned", "InjectedFault", "DispatchGiveUp", "BreakerOpen",
+    "NaNPoisoned", "InjectedFault", "CorruptionDetected",
+    "DispatchGiveUp", "BreakerOpen",
     "FAILOVER_ERRORS",
     "faults",
     "CircuitBreaker", "get_breaker", "reset_breaker",

@@ -162,6 +162,10 @@ def call_guarded(site: str, fn, args=(), kwargs=None):
                 out = _run_with_watchdog(site, fn, args, kwargs, p.timeout_s)
             else:
                 out = fn(*args, **kwargs)
+            if _faults._HAS_CORRUPT:
+                # amp-corrupt fires at site EXIT: the dispatch SUCCEEDS
+                # and hands back a silently-wrong result (faults.py)
+                out = _faults.corrupt_output(site, out)
             if p.validate:
                 _faults.validate_finite(site, out)
             br.record_success()

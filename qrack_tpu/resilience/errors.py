@@ -73,6 +73,21 @@ class InjectedFault(DispatchFailure):
     kind = "raise"
 
 
+class CorruptionDetected(DispatchFailure):
+    """An integrity invariant (resilience/integrity.py) caught silent
+    data corruption AFTER a dispatch committed its result.  Never
+    retried in place — donated operands are gone — so the guard plane
+    restores a pre-flush snapshot and replays the kept window instead;
+    ``fp`` carries the offending fingerprint for attribution."""
+
+    retryable = False
+    kind = "amp-corrupt"
+
+    def __init__(self, site: str, detail: str = "", fp=None):
+        self.fp = fp
+        super().__init__(site, detail)
+
+
 class DispatchGiveUp(ResilienceError):
     """Every retry at a guarded site failed; carries the last attempt's
     failure as `cause`.  Triggers engine failover."""

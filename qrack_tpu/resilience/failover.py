@@ -53,25 +53,59 @@ from .errors import FAILOVER_ERRORS
 _persist_seq = 0
 
 
+def _snapshot_is_finite(snap) -> bool:
+    """Walk a captured snapshot tree and reject any non-finite float
+    array.  Guards the persist path: a nan-poisoned ket written over the
+    previous good snapshot would turn recovery evidence into the thing
+    that re-poisons the recovery."""
+    import numpy as np
+
+    for arr in snap.get("arrays", {}).values():
+        a = np.asarray(arr)
+        if (np.issubdtype(a.dtype, np.floating)
+                or np.issubdtype(a.dtype, np.complexfloating)):
+            if not np.all(np.isfinite(a)):
+                return False
+    return all(_snapshot_is_finite(c)
+               for c in snap.get("children", {}).values())
+
+
 def _persist_snapshot(engine, cause) -> Optional[str]:
     """Durable post-mortem evidence: with QRACK_TPU_FAILOVER_PERSIST set
     to a directory, write the failing engine's full checkpoint container
     (ket + rng stream) there before rehydrating, so the pre-call state
     survives even if the fallback itself dies.  Best-effort: a persist
-    failure must never block the failover it documents."""
+    failure must never block the failover it documents.
+
+    The capture is VERIFIED before it is written: a snapshot holding a
+    non-finite plane is rejected (`resilience.failover.persist_rejected`)
+    so the newest file in the persist directory stays the newest GOOD
+    state.  Write-side integrity beyond finiteness rides the checkpoint
+    container's own per-array sha256 manifest (checkpoint/container.py),
+    which load_container re-verifies."""
     global _persist_seq
     root = os.environ.get("QRACK_TPU_FAILOVER_PERSIST")
     if not root:
         return None
     try:
-        from ..checkpoint.registry import save_state
+        from ..checkpoint.registry import (STATE_KIND_PREFIX, _flatten,
+                                           capture, save_container)
 
+        snap = capture(engine)
+        if not _snapshot_is_finite(snap):
+            if _tele._ENABLED:
+                _tele.event("resilience.failover.persist_rejected",
+                            cause=type(cause).__name__ if cause else "")
+            return None
         os.makedirs(root, exist_ok=True)
         _persist_seq += 1
         name = (f"failover-{int(time.time())}-{os.getpid()}"
                 f"-{_persist_seq:03d}.qckpt")
         path = os.path.join(root, name)
-        save_state(engine, path)
+        flat = {}
+        tree = _flatten(snap, "", flat)
+        save_container(path, flat, meta={"tree": tree},
+                       kind=STATE_KIND_PREFIX + snap["kind"])
     except Exception:  # noqa: BLE001
         if _tele._ENABLED:
             _tele.inc("resilience.failover.persist_failed")

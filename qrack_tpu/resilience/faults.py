@@ -9,7 +9,7 @@ Grammar (``QRACK_TPU_FAULTS``, comma-separated specs):
   (``discover``, ``compile``, ``dispatch``, ``device_get``,
   ``exchange``), or ``*`` for every site.
 * ``kind`` — ``timeout`` | ``hang`` | ``raise`` | ``nan-poison`` |
-  ``device-loss`` | ``flap`` | ``torn-write``.
+  ``device-loss`` | ``flap`` | ``torn-write`` | ``amp-corrupt``.
 * ``after_n`` — how many calls at the site pass through before the
   fault arms.  ``N`` fires once at call N+1 then heals (the transient
   case retry must recover); ``N+M`` fires on M consecutive calls;
@@ -33,12 +33,17 @@ and :data:`KINDS`: an unknown site or kind raises ValueError listing
 the valid values, because a typo'd env spec that silently never fires
 is worse than no injection at all.
 
-Every kind fires at SITE ENTRY, before the guarded callable runs, so
-the resident ket is never donated into a failed dispatch and both
-retry and snapshot-based failover see intact state.  ``nan-poison``
-models the output-validation path (QRACK_TPU_VALIDATE=1) detecting a
-non-finite result; ``hang`` makes the dispatch wrapper run a sleeping
-stub so the watchdog timeout is exercised for real.
+Every kind except ``amp-corrupt`` fires at SITE ENTRY, before the
+guarded callable runs, so the resident ket is never donated into a
+failed dispatch and both retry and snapshot-based failover see intact
+state.  ``nan-poison`` models the output-validation path
+(QRACK_TPU_VALIDATE=1) detecting a non-finite result; ``hang`` makes
+the dispatch wrapper run a sleeping stub so the watchdog timeout is
+exercised for real.  ``amp-corrupt`` fires at SITE EXIT instead: it
+perturbs one amplitude in the dispatch OUTPUT (finite, order-unity,
+seeded — the silent-data-corruption model), so nothing raises at the
+site and only the integrity guard plane (resilience/integrity.py) can
+catch it downstream.
 
 Injection is recorded as `resilience.fault.<site>.<kind>` telemetry
 counters/events.  Tests drive the programmatic API (:func:`inject`,
@@ -56,7 +61,7 @@ from .. import telemetry as _tele
 from .errors import (DeviceLost, DispatchFailure, InjectedFault, NaNPoisoned)
 
 KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss",
-         "flap", "torn-write")
+         "flap", "torn-write", "amp-corrupt")
 
 # every call_guarded site in the tree (grep '"<name>"' call_guarded /
 # instrument_dispatch / guard_callable call sites when adding one) —
@@ -89,6 +94,14 @@ def validate_site(site: str) -> None:
 _LOCK = threading.RLock()
 _SPECS: List["FaultSpec"] = []
 _SUSPENDED = 0  # re-entrant suspension depth (failover snapshots)
+# fast-path flag for the site-EXIT hook: call_guarded only pays the
+# corrupt_output call when an amp-corrupt spec is actually armed
+_HAS_CORRUPT = False
+
+
+def _recount_locked() -> None:
+    global _HAS_CORRUPT
+    _HAS_CORRUPT = any(s.kind == "amp-corrupt" for s in _SPECS)
 
 
 @dataclass
@@ -100,6 +113,11 @@ class FaultSpec:
     seed: Optional[int] = None
     calls: int = 0                 # matching calls observed
     fired: int = 0                 # faults actually delivered
+    # amp-corrupt only (programmatic API; no env grammar): pin every
+    # strike to ONE page's shard so attribution lands on one device —
+    # the deterministic trigger the quarantine tests need
+    page: Optional[int] = None
+    n_pages: Optional[int] = None
     _rng: object = field(default=None, repr=False)
 
     def __post_init__(self):
@@ -160,17 +178,22 @@ def load_env(value: Optional[str] = None) -> int:
         for tok in value.split(","):
             if tok.strip():
                 _SPECS.append(parse_spec(tok))
+        _recount_locked()
         return len(_SPECS)
 
 
 def inject(site: str, kind: str, after_n: int = 0,
-           times: Optional[int] = 1, seed: Optional[int] = None) -> FaultSpec:
+           times: Optional[int] = 1, seed: Optional[int] = None,
+           page: Optional[int] = None,
+           n_pages: Optional[int] = None) -> FaultSpec:
     """Programmatic injection (tests).  Activates the resilience layer
-    so guarded sites start checking."""
+    so guarded sites start checking.  ``page``/``n_pages`` pin an
+    ``amp-corrupt`` strike to one page's shard (quarantine tests)."""
     spec = FaultSpec(site=site, kind=kind, after_n=after_n,
-                     times=times, seed=seed)
+                     times=times, seed=seed, page=page, n_pages=n_pages)
     with _LOCK:
         _SPECS.append(spec)
+        _recount_locked()
     from . import enable
 
     enable()
@@ -180,6 +203,7 @@ def inject(site: str, kind: str, after_n: int = 0,
 def clear() -> None:
     with _LOCK:
         _SPECS.clear()
+        _recount_locked()
 
 
 def specs() -> List[FaultSpec]:
@@ -252,6 +276,8 @@ def check(site: str) -> Optional[str]:
             return None
         fired_kind = None
         for spec in _SPECS:
+            if spec.kind == "amp-corrupt":
+                continue  # fires at site EXIT via corrupt_output()
             if spec.matches(site) and spec.should_fire():
                 fired_kind = spec.kind
                 break
@@ -289,6 +315,79 @@ def validate_finite(site: str, out) -> None:
 
         if not bool(jnp.all(jnp.isfinite(v))):
             raise NaNPoisoned(site, "non-finite value in dispatch output")
+
+
+def _corrupt_value(v, rng, page=None, n_pages=None):
+    """Perturb ONE element of float array `v` by an order-unity finite
+    delta, preserving dtype/shape and (for jax arrays) sharding — a
+    corrupted ppermute must stay dispatchable so the corruption is
+    SILENT until an integrity invariant reads it.  With ``page``
+    pinned the strike lands inside that page's contiguous axis-1
+    shard (the pager's P(None, "pages") layout)."""
+    import numpy as np
+
+    arr = np.asarray(v)
+    flat = arr.reshape(-1).copy()
+    if flat.size == 0:
+        return v
+    if page is not None and n_pages and arr.ndim >= 2 \
+            and arr.shape[-1] % n_pages == 0:
+        chunk = arr.shape[-1] // n_pages
+        # element (0, col) of the planes flattens to index `col`
+        idx = page * chunk + int(rng.integers(0, chunk))
+    else:
+        idx = int(rng.integers(0, flat.size))
+    # push AWAY from zero: a signed delta near -2a would be norm-
+    # neutral and genuinely invisible to a norm invariant, which makes
+    # "0 silent mis-computes" unprovable — this way the element's
+    # probability grows by at least delta**2 ≈ 0.06, far over budget
+    delta = 0.25 + 0.5 * float(rng.random())
+    flat[idx] += delta if flat[idx] >= 0 else -delta
+    new = flat.reshape(arr.shape).astype(arr.dtype)
+    if type(v).__module__.startswith("jax"):
+        import jax
+
+        sharding = getattr(v, "sharding", None)
+        return jax.device_put(new, sharding) if sharding is not None \
+            else jax.numpy.asarray(new)
+    return new
+
+
+def corrupt_output(site: str, out):
+    """SITE-EXIT hook (dispatch.py): deliver any armed ``amp-corrupt``
+    spec by perturbing the first float array in the dispatch output.
+    Returns the (possibly corrupted) output.  Unlike entry kinds this
+    never raises — the corruption is the whole point."""
+    with _LOCK:
+        if not _SPECS or _SUSPENDED:
+            return out
+        spec_fired = None
+        for spec in _SPECS:
+            if (spec.kind == "amp-corrupt" and spec.matches(site)
+                    and spec.should_fire()):
+                spec_fired = spec
+                break
+    if spec_fired is None:
+        return out
+    if _tele._ENABLED:
+        _tele.event(f"resilience.fault.{site}.amp-corrupt")
+    import numpy as np
+
+    rng = spec_fired._rng
+    if rng is None:  # unseeded specs still corrupt deterministically
+        rng = np.random.Generator(np.random.PCG64(
+            0xA3C0 ^ (spec_fired.after_n << 8) ^ spec_fired.fired))
+    is_seq = isinstance(out, (tuple, list))
+    vals = list(out) if is_seq else [out]
+    for i, v in enumerate(vals):
+        dt = getattr(v, "dtype", None)
+        if dt is not None and np.issubdtype(np.dtype(dt), np.floating):
+            vals[i] = _corrupt_value(v, rng, page=spec_fired.page,
+                                     n_pages=spec_fired.n_pages)
+            break
+    if not is_seq:
+        return vals[0]
+    return tuple(vals) if isinstance(out, tuple) else vals
 
 
 # env-armed at import so `QRACK_TPU_FAULTS=... python app.py` needs no

@@ -25,22 +25,28 @@ block_until_ready over the relay acks dispatch, not completion.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 from .. import telemetry as _tele
 from ..resilience.errors import FAILOVER_ERRORS
 from . import batcher as _batcher
+from .errors import QueueBudgetExceeded
 from .scheduler import Job, Scheduler
 from .session import SessionManager, planes_engine
 
 
 class Executor:
     def __init__(self, scheduler: Scheduler, sessions: SessionManager,
-                 tick_s: float = 0.25, sync: bool = True):
+                 tick_s: float = 0.25, sync: bool = True, canary=None):
         self.scheduler = scheduler
         self.sessions = sessions
         self.tick_s = tick_s
         self.sync = sync  # devget-honest completion (QRACK_SERVE_SYNC)
+        # sampled oracle-replay verification (serve/canary.py); None
+        # unless QRACK_SERVE_CANARY_RATE > 0 — the default costs one
+        # attribute test per batch
+        self.canary = canary
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -80,6 +86,27 @@ class Executor:
                         self._account(job, ok=False)
 
     def _run(self, batch: List[Job]) -> None:
+        # pre-dispatch shed: the admission-side expiry only sees jobs
+        # still in the heap — a job whose budget ran out while its batch
+        # was being assembled (the batch window holds the door open)
+        # would otherwise execute stale.  Same accounting as expiry,
+        # plus its own counter so the report can tell the two apart.
+        budget = self.scheduler.queue_budget_s
+        if budget > 0:
+            now = time.perf_counter()
+            live: List[Job] = []
+            for job in batch:
+                waited = now - job.handle.t_submit
+                if job.kind != "admin" and waited > budget:
+                    job.handle._fail(QueueBudgetExceeded(waited, budget))
+                    self._account(job, ok=False)
+                    if _tele._ENABLED:
+                        _tele.inc("serve.shed.pre_dispatch")
+                else:
+                    live.append(job)
+            if not live:
+                return
+            batch = live
         for job in batch:
             job.handle._start()
         # fault spilled sessions back in before their jobs touch engines
@@ -109,6 +136,14 @@ class Executor:
                 sess = job.session
                 if sess is not None and sess.engine is not None:
                     _elastic.maybe_reexpand(sess.engine)
+        # canary sampling decides BEFORE execution: the oracle replay
+        # needs the pre-job ket, and the state reads belong to this
+        # thread (the replay itself runs on the canary thread)
+        if self.canary is not None:
+            for job in batch:
+                if (job.kind == "circuit" and job.session is not None
+                        and self.canary.should_sample()):
+                    self.canary.capture_pre(job)
         if batch[0].batchable:
             self._run_batched(batch)
         else:
@@ -257,10 +292,16 @@ class Executor:
     # -- bookkeeping ---------------------------------------------------
 
     def _complete(self, job: Job, result) -> None:
+        if self.canary is not None and job.kind == "circuit":
+            # post-state read happens here (dispatch-owner thread);
+            # no-op for unsampled jobs
+            self.canary.submit_post(job)
         job.handle._complete(result)
         self._account(job, ok=True)
 
     def _account(self, job: Job, ok: bool) -> None:
+        if not ok and self.canary is not None:
+            self.canary.discard(job)
         if job.session is not None:
             job.session.end_job(ok)
             if ok and self.sessions.spill_store is not None:

@@ -30,6 +30,10 @@ blocks on a JobHandle.  Env knobs (constructor args override):
                                    process at startup
 * ``QRACK_SERVE_PREWARM``          "1": pre-trace recorded programs at
                                    startup (warm time-to-first-result)
+* ``QRACK_SERVE_CANARY_RATE``      fraction of circuit jobs re-verified
+                                   against the CPU oracle off the
+                                   dispatch-owner thread (default 0 =
+                                   off; docs/INTEGRITY.md)
 
 See docs/SERVING.md for the architecture and the load-shedding
 semantics; serving is NOT imported by ``import qrack_tpu`` so the
@@ -123,8 +127,18 @@ class QrackService:
                                    batch_window_s=batch_window_ms / 1e3,
                                    max_batch=max_batch)
         sync = os.environ.get("QRACK_SERVE_SYNC", "devget") != "none"
+        self.canary = None
+        canary_rate = _env_float("QRACK_SERVE_CANARY_RATE", 0.0)
+        if canary_rate > 0:
+            # sampled oracle-replay verification (serve/canary.py,
+            # docs/INTEGRITY.md); off by default — the verifier thread
+            # only exists when a rate is configured
+            from .canary import CanaryVerifier
+
+            self.canary = CanaryVerifier(canary_rate)
         self.executor = Executor(self.scheduler, self.sessions,
-                                 tick_s=tick_s, sync=sync)
+                                 tick_s=tick_s, sync=sync,
+                                 canary=self.canary)
         self.executor.start()
         self._closed = False
         if self.store is not None:
@@ -443,6 +457,8 @@ class QrackService:
         self._closed = True
         self.scheduler.stop()
         self.executor.stop()
+        if self.canary is not None:
+            self.canary.stop()
         if self.store is not None and self.lease_held:
             try:
                 self.store.release_lease(self._owner)

@@ -1,0 +1,107 @@
+"""Shared per-session CPU-oracle soak harness.
+
+Every soak in this directory (fault_soak.py, serve_soak.py,
+elastic_soak.py, integrity_soak.py) follows the same contract: seeded
+randomized trials driven by the tests/test_fuzz_api.py op vocabulary,
+a QEngineCPU oracle per session, state fidelity as the verdict, one
+JSON line per trial, and a ``SOAK OK/FAILED`` footer whose exit code
+the driver checks.  This module is that harness, written once.
+
+Importing it performs the soak preamble as a side effect — repo root
+and tests/ on sys.path, ``pin_host_cpu(8)`` BEFORE any jax backend
+init — so a soak script's own preamble shrinks to two lines::
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _soak_common import ...
+
+(The explicit scripts-dir insert keeps the import working when a
+slow-marked smoke test loads the soak via spec_from_file_location,
+where scripts/ is not otherwise on sys.path.)
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
+
+pin_host_cpu(8)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import resilience as res  # noqa: E402
+
+_TESTS = os.path.join(REPO, "tests")
+if _TESTS not in sys.path:
+    sys.path.insert(0, _TESTS)
+from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
+
+__all__ = ["REPO", "N", "_ops", "STACKS", "fidelity", "submit_retry",
+           "resilience_up", "resilience_down", "soak_main"]
+
+# stacks that exercise each guarded dispatch family
+STACKS = [
+    ("tpu", {}),
+    ("pager", {"n_pages": 4}),
+    ("hybrid", {"tpu_threshold_qubits": 3}),
+]
+
+
+def fidelity(a, b) -> float:
+    a, b = np.asarray(a), np.asarray(b)
+    return float(abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
+                                            * np.vdot(b, b).real))
+
+
+def submit_retry(fn, tries: int = 200):
+    """Admission rejections are the CONTRACT under an open breaker —
+    honor the retry hint instead of treating them as failures."""
+    from qrack_tpu.serve.errors import LoadShed, QueueFull
+
+    for _ in range(tries):
+        try:
+            return fn()
+        except (LoadShed, QueueFull) as e:
+            time.sleep(min(getattr(e, "retry_in_s", 0.0) or 0.02, 0.1))
+    raise RuntimeError(f"admission retries exhausted after {tries} tries")
+
+
+def resilience_up(breaker=None, max_retries: int = 2) -> None:
+    """Per-trial arming: clean fault table, fresh breaker (pass one with
+    a short cooldown when the trial must ride through an open window),
+    zero backoff — soaks measure correctness, never latency."""
+    res.faults.clear()
+    if breaker is not None:
+        res.reset_breaker(breaker)
+    else:
+        res.reset_breaker()
+    res.configure(max_retries=max_retries, backoff_s=0.0, timeout_s=0.0)
+    res.enable()
+
+
+def resilience_down() -> None:
+    res.faults.clear()
+    res.reset_breaker()
+    res.disable()
+
+
+def soak_main(argv, run_trial, default_trials: int) -> int:
+    """The shared driver: ``python scripts/<soak>.py [trials] [seed]``,
+    one JSON line per trial, exit 0 iff every trial reported ok."""
+    trials = int(argv[1]) if len(argv) > 1 else default_trials
+    seed = int(argv[2]) if len(argv) > 2 else 0
+    failures = 0
+    for t in range(trials):
+        info = run_trial(t, seed)
+        print(json.dumps(info), flush=True)
+        if not info["ok"]:
+            failures += 1
+    print(f"SOAK {'FAILED' if failures else 'OK'}: "
+          f"{trials - failures}/{trials} trials oracle-equivalent",
+          flush=True)
+    return 1 if failures else 0

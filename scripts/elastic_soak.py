@@ -32,20 +32,17 @@ after editing the range reproduces a failure.  The slow-marked
 tests/test_serve.py::test_elastic_soak_smoke runs a 3-trial slice.
 """
 
-import json
 import os
 import shutil
 import subprocess
 import sys
 import tempfile
-import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
-
-pin_host_cpu(8)
+from _soak_common import (N, REPO, _ops, fidelity,  # noqa: E402
+                          resilience_down, resilience_up, soak_main,
+                          submit_retry)
 
 import numpy as np  # noqa: E402
 
@@ -55,12 +52,11 @@ from qrack_tpu import telemetry as tele  # noqa: E402
 from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
 from qrack_tpu.resilience.breaker import CircuitBreaker  # noqa: E402
 from qrack_tpu.serve import QrackService  # noqa: E402
-from qrack_tpu.serve.errors import LoadShed, QueueFull  # noqa: E402
 from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
-sys.path.insert(0, os.path.join(REPO, "tests"))
-from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
-
+# cpu in rotation: the handoff trial checkpoints/recovers every stack
+# kind, not just the device-backed ones (differs from the shared
+# _soak_common.STACKS on purpose)
 STACKS = [("cpu", {}), ("tpu", {}), ("pager", {"n_pages": 4})]
 
 
@@ -92,21 +88,6 @@ def _apply_to_oracle(oracle, stream) -> None:
             getattr(oracle, item[1])(*item[2])
 
 
-def _submit_retry(fn, tries: int = 200):
-    for _ in range(tries):
-        try:
-            return fn()
-        except (LoadShed, QueueFull) as e:
-            time.sleep(min(getattr(e, "retry_in_s", 0.0) or 0.02, 0.1))
-    raise RuntimeError(f"admission retries exhausted after {tries} tries")
-
-
-def _fidelity(a, b) -> float:
-    a, b = np.asarray(a), np.asarray(b)
-    return float(abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
-                                            * np.vdot(b, b).real))
-
-
 # -- trial kind 1: in-process device loss / flap on the pager ----------
 
 
@@ -127,10 +108,7 @@ def run_elastic_trial(trial: int, seed: int) -> dict:
             "after_n": after_n, "times": times}
 
     os.environ["QRACK_TPU_FUSE_WINDOW"] = str(window)
-    res.faults.clear()
-    res.reset_breaker(CircuitBreaker(threshold=4, cooldown_s=0.05))
-    res.configure(max_retries=2, backoff_s=0.0, timeout_s=0.0)
-    res.enable()
+    resilience_up(breaker=CircuitBreaker(threshold=4, cooldown_s=0.05))
     tele.enable()
     tele.reset()
     svc = None
@@ -160,7 +138,7 @@ def run_elastic_trial(trial: int, seed: int) -> dict:
             k = live[int(frng.integers(0, len(live)))]
             item, sid = streams[k][cursors[k]], sids[k]
             if item[0] == "circ":
-                handles.append(_submit_retry(
+                handles.append(submit_retry(
                     lambda s=sid: svc.submit(s, qft_qcircuit(N))))
             else:
                 _, name, args = item
@@ -168,7 +146,7 @@ def run_elastic_trial(trial: int, seed: int) -> dict:
                 def do(eng, name=name, args=args):
                     return getattr(eng, name)(*args)
 
-                handles.append(_submit_retry(
+                handles.append(submit_retry(
                     lambda s=sid, f=do: svc.call(s, f)))
             cursors[k] += 1
             if cursors[k] >= len(streams[k]):
@@ -178,7 +156,7 @@ def run_elastic_trial(trial: int, seed: int) -> dict:
         # degraded-serving evidence: with the loss window still open the
         # pager must be at reduced pages yet answering jobs
         fired = sum(sp.fired for sp in res.faults.specs())
-        degraded = [_submit_retry(
+        degraded = [submit_retry(
             lambda s=sid: svc.call(s, lambda e: (
                 getattr(e, "n_pages", None),
                 bool(getattr(e, "elastic_degraded", False))))
@@ -188,7 +166,7 @@ def run_elastic_trial(trial: int, seed: int) -> dict:
             assert any(d[1] for d in degraded), degraded
         # heal -> the next job boundary must re-expand every pager
         res.faults.clear()
-        final = [_submit_retry(
+        final = [submit_retry(
             lambda s=sid: svc.call(s, lambda e: (
                 getattr(e, "n_pages", None),
                 bool(getattr(e, "elastic_degraded", False))))
@@ -196,9 +174,9 @@ def run_elastic_trial(trial: int, seed: int) -> dict:
         assert all(d == (4, False) for d in final), final
         fids = []
         for sid, oracle in zip(sids, oracles):
-            got = _submit_retry(lambda s=sid: svc.call(
+            got = submit_retry(lambda s=sid: svc.call(
                 s, lambda e: e.GetQuantumState())).result(timeout=120)
-            fids.append(_fidelity(oracle.GetQuantumState(), got))
+            fids.append(fidelity(oracle.GetQuantumState(), got))
         snap = tele.snapshot()["counters"]
         info["fired"] = fired
         info["repage_shrink"] = snap.get("elastic.repage.shrink", 0)
@@ -215,9 +193,7 @@ def run_elastic_trial(trial: int, seed: int) -> dict:
         if svc is not None:
             svc.close()
         os.environ.pop("QRACK_TPU_FUSE_WINDOW", None)
-        res.faults.clear()
-        res.reset_breaker()
-        res.disable()
+        resilience_down()
         tele.disable()
         tele.reset()
     return info
@@ -292,8 +268,8 @@ def run_handoff_trial(trial: int, seed: int) -> dict:
                                 rand_global_phase=False)
             _apply_to_oracle(oracle, streams[k])
             qft_qcircuit(N).Run(oracle)  # the WAL'd job
-            fids.append(_fidelity(oracle.GetQuantumState(),
-                                  svc.get_state(sid, timeout=120)))
+            fids.append(fidelity(oracle.GetQuantumState(),
+                                 svc.get_state(sid, timeout=120)))
         info["wal_replayed"] = out["wal_replayed"]
         info["fidelity_min"] = min(fids)
         info["ok"] = bool(min(fids) > 1 - 1e-6)
@@ -320,18 +296,7 @@ def main(argv) -> int:
     if len(argv) > 1 and argv[1] == "--hold":
         hold_child(argv[2], int(argv[3]), int(argv[4]))
         return 0
-    trials = int(argv[1]) if len(argv) > 1 else 24
-    seed = int(argv[2]) if len(argv) > 2 else 0
-    failures = 0
-    for t in range(trials):
-        info = run_trial(t, seed)
-        print(json.dumps(info), flush=True)
-        if not info["ok"]:
-            failures += 1
-    print(f"SOAK {'FAILED' if failures else 'OK'}: "
-          f"{trials - failures}/{trials} trials oracle-equivalent",
-          flush=True)
-    return 1 if failures else 0
+    return soak_main(argv, run_trial, default_trials=24)
 
 
 if __name__ == "__main__":

@@ -20,15 +20,13 @@ CI.  One line of JSON per trial on stdout; a failing trial prints its
 full spec so `python scripts/fault_soak.py 1 <seed>` reproduces it.
 """
 
-import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
-
-pin_host_cpu(8)
+from _soak_common import (N, STACKS, _ops, fidelity,  # noqa: E402
+                          resilience_down, resilience_up, soak_main)
 
 import numpy as np  # noqa: E402
 
@@ -36,16 +34,6 @@ from qrack_tpu import QEngineCPU, create_quantum_interface  # noqa: E402
 from qrack_tpu import resilience as res  # noqa: E402
 from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "tests"))
-from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
-
-# stacks that exercise each guarded dispatch family
-STACKS = [
-    ("tpu", {}),
-    ("pager", {"n_pages": 4}),
-    ("hybrid", {"tpu_threshold_qubits": 3}),
-]
 SITES = ["*", "tpu.compile", "tpu.device_get", "pager.dispatch",
          "pager.exchange", "pager.device_get", "compile", "device_get"]
 # hang exercised by the dedicated watchdog tests, not the soak (a
@@ -64,10 +52,7 @@ def run_trial(trial: int, seed: int) -> dict:
     info = {"trial": trial, "stack": stack_name, "site": site, "kind": kind,
             "after_n": after_n, "persistent": persistent}
 
-    res.faults.clear()
-    res.reset_breaker()
-    res.configure(max_retries=2, backoff_s=0.0, timeout_s=0.0)
-    res.enable()
+    resilience_up()
     try:
         o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
         s = create_quantum_interface(stack_name, N, rng=QrackRandom(trial),
@@ -85,36 +70,22 @@ def run_trial(trial: int, seed: int) -> dict:
         with res.faults.suspended():
             a = np.asarray(o.GetQuantumState())
             b = np.asarray(s.GetQuantumState())
-        f = abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
-                                       * np.vdot(b, b).real)
+        f = fidelity(a, b)
         info["n_ops"] = n_ops
         info["fired"] = sum(sp.fired for sp in res.faults.specs())
         info["breaker"] = res.get_breaker().snapshot()["state"]
-        info["fidelity"] = float(f)
+        info["fidelity"] = f
         info["ok"] = bool(f > 1 - 1e-6)
     except Exception as e:  # noqa: BLE001 — a soak records, never dies
         info["ok"] = False
         info["error"] = f"{type(e).__name__}: {e}"
     finally:
-        res.faults.clear()
-        res.reset_breaker()
-        res.disable()
+        resilience_down()
     return info
 
 
 def main(argv) -> int:
-    trials = int(argv[1]) if len(argv) > 1 else 100
-    seed = int(argv[2]) if len(argv) > 2 else 0
-    failures = 0
-    for t in range(trials):
-        info = run_trial(t, seed)
-        print(json.dumps(info), flush=True)
-        if not info["ok"]:
-            failures += 1
-    print(f"SOAK {'FAILED' if failures else 'OK'}: "
-          f"{trials - failures}/{trials} trials oracle-equivalent",
-          flush=True)
-    return 1 if failures else 0
+    return soak_main(argv, run_trial, default_trials=100)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,164 @@
+"""Randomized silent-corruption soak: ``amp-corrupt`` specs on the
+fused-flush dispatch sites, vs the CPU oracle.
+
+Each trial builds a tpu- or pager-backed stack and drives it with a
+FUSABLE-ONLY gate vocabulary (single-qubit gates, rotations,
+controlled gates) — structural ops (Swap / ALU / masks) commit outside
+the fused-flush envelope and are a different, unguarded surface
+(docs/INTEGRITY.md).  One seeded ``amp-corrupt`` spec is armed on the
+site that actually carries state commits for the trial's
+(stack, fusion window) pair:
+
+    tpu   @ window 1  -> tpu.compile     (eager single-op dispatch)
+    tpu   @ window 16 -> tpu.fuse.flush  (fused window program)
+    pager @ window 1  -> pager.exchange  (single global-qubit op)
+    pager @ window 16 -> tpu.fuse.flush  (fused window on the pager)
+
+The integrity guard plane (resilience/integrity.py) must then detect
+every fired corruption at the next flush verify, repair it by scoped
+window replay — or, when the spec is persistent and replays keep
+corrupting, give up through the elastic shrink staircase / failover —
+and the final state must stay oracle-equivalent.  The trial verdict is
+"zero silent mis-computes": fidelity ~1.0 AND (nothing fired OR at
+least one violation was detected).  A fired corruption that no
+invariant saw would fail the trial even if fidelity survived.
+
+Pager trials randomly pin the corruption to one page
+(``inject(..., page=p, n_pages=4)``) so strike attribution lands on a
+known page/device pair; the per-trial JSON records the strike table.
+
+Usage:
+    python scripts/integrity_soak.py [trials] [seed]
+
+Defaults: 48 trials, seed 0.  Exit 0 = all trials clean.  One JSON
+line per trial; `python scripts/integrity_soak.py 1 <seed>` after
+editing the range reproduces a failure.  The slow-marked
+tests/test_integrity.py::test_integrity_soak_smoke runs a short slice.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import (N, fidelity, resilience_down,  # noqa: E402
+                          resilience_up, soak_main)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import QEngineCPU, create_quantum_interface  # noqa: E402
+from qrack_tpu import resilience as res  # noqa: E402
+from qrack_tpu import telemetry as tele  # noqa: E402
+from qrack_tpu.resilience import integrity as integ  # noqa: E402
+from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
+
+STACKS = [("tpu", {}), ("pager", {"n_pages": 4})]
+
+GATES1 = ("H", "X", "Y", "Z", "S", "T")
+ROTS = ("RX", "RY", "RZ")
+
+
+def _fusable_op(rng):
+    """One random op from the fusable vocabulary as (name, args)."""
+    q = lambda: int(rng.integers(0, N))
+    r = float(rng.random())
+    if r < 0.5:
+        g = GATES1[int(rng.integers(0, len(GATES1)))]
+        return g, (q(),)
+    if r < 0.75:
+        g = ROTS[int(rng.integers(0, len(ROTS)))]
+        return g, (float(rng.uniform(0, 2 * np.pi)), q())
+    a = q()
+    b = (a + 1 + int(rng.integers(0, N - 1))) % N
+    if r < 0.95:
+        return ("CNOT" if rng.integers(0, 2) else "CZ"), (a, b)
+    return "CCNOT", (0, 1, 2 + int(rng.integers(0, N - 2)))
+
+
+def _site_for(stack_name: str, window: int) -> str:
+    if stack_name == "tpu":
+        return "tpu.compile" if window == 1 else "tpu.fuse.flush"
+    return "pager.exchange" if window == 1 else "tpu.fuse.flush"
+
+
+def run_trial(trial: int, seed: int) -> dict:
+    rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
+    stack_name, kw = STACKS[trial % len(STACKS)]
+    window = 1 if (trial // 2) % 2 else 16
+    site = _site_for(stack_name, window)
+    # window-16 merging can collapse a 24-gate trial to a SINGLE
+    # matching dispatch, so any after_n > 0 risks a trial where nothing
+    # ever fires; window-1 streams dispatch per gate and can wait
+    after_n = 0 if window == 16 else int(rng.integers(0, 8))
+    persistent = bool(rng.integers(0, 2))
+    times = None if persistent else int(rng.integers(1, 3))
+    page = None
+    if stack_name == "pager" and rng.integers(0, 2):
+        page = int(rng.integers(0, 4))
+    info = {"trial": trial, "stack": stack_name, "window": window,
+            "site": site, "after_n": after_n, "persistent": persistent,
+            "times": times, "page": page}
+
+    os.environ["QRACK_TPU_FUSE_WINDOW"] = str(window)
+    resilience_up()
+    tele.enable()
+    tele.reset()
+    integ.reset()
+    try:
+        # engines AFTER enable(): the forced window-1 fuser (the repair
+        # envelope for eager dispatch) only builds when the layer is up
+        o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
+        s = create_quantum_interface(stack_name, N, rng=QrackRandom(trial),
+                                     rand_global_phase=False, **kw)
+        # NO seed: seeded specs coin-flip on every eligible call
+        # (faults.should_fire), and a window-16 trial can merge into a
+        # single matching dispatch — a tails coin would mean nothing
+        # fires and the trial tests nothing.  Unseeded amp-corrupt is
+        # still deterministic: corrupt_output derives a per-fire rng
+        # from (after_n, fired).
+        res.faults.inject(site, "amp-corrupt", after_n=after_n,
+                          times=times,
+                          page=page, n_pages=4 if page is not None else None)
+        for _ in range(24):
+            name, args = _fusable_op(rng)
+            getattr(o, name)(*args)
+            getattr(s, name)(*args)
+        # drain the fuser OUTSIDE suspension so a pending spec still
+        # fires inside the guarded flush (a suspended read would flush
+        # with injection stood down and the trial would test nothing)
+        _ = s.Prob(0)
+        with res.faults.suspended():
+            a = np.asarray(o.GetQuantumState())
+            b = np.asarray(s.GetQuantumState())
+        f = fidelity(a, b)
+        snap = tele.snapshot()["counters"]
+        fired = sum(sp.fired for sp in res.faults.specs())
+        info["fired"] = fired
+        info["violations"] = snap.get("integrity.violation", 0)
+        info["repaired"] = snap.get("integrity.replay.repaired", 0)
+        info["giveups"] = snap.get("integrity.replay.giveup", 0)
+        info["strikes"] = {str(k): v for k, v in integ.strikes().items()}
+        info["quarantined"] = sorted(integ.quarantined())
+        info["fidelity"] = f
+        # zero silent mis-computes: equivalence alone is not enough —
+        # every fired corruption must have been SEEN by an invariant
+        info["ok"] = bool(f > 1 - 1e-6
+                          and (fired == 0 or info["violations"] >= 1))
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        os.environ.pop("QRACK_TPU_FUSE_WINDOW", None)
+        resilience_down()
+        integ.reset()
+        tele.disable()
+        tele.reset()
+    return info
+
+
+def main(argv) -> int:
+    return soak_main(argv, run_trial, default_trials=48)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -28,16 +28,14 @@ One JSON line per trial; a failing trial's line holds the spec, so
 tests/test_serve.py::test_serve_soak_smoke runs a 9-trial slice in CI.
 """
 
-import json
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from qrack_tpu.utils.platform import pin_host_cpu  # noqa: E402
-
-pin_host_cpu(8)
+from _soak_common import (N, STACKS, _ops, fidelity,  # noqa: E402
+                          resilience_down, resilience_up, soak_main,
+                          submit_retry)
 
 import numpy as np  # noqa: E402
 
@@ -46,33 +44,12 @@ from qrack_tpu import resilience as res  # noqa: E402
 from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
 from qrack_tpu.resilience.breaker import CircuitBreaker  # noqa: E402
 from qrack_tpu.serve import QrackService  # noqa: E402
-from qrack_tpu.serve.errors import LoadShed, QueueFull  # noqa: E402
 from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "tests"))
-from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
-
-STACKS = [
-    ("tpu", {}),
-    ("pager", {"n_pages": 4}),
-    ("hybrid", {"tpu_threshold_qubits": 3}),
-]
 SITES = ["*", "serve.dispatch", "serve.device_get", "dispatch",
          "device_get", "tpu.compile", "pager.exchange"]
 # hang exercised by the watchdog tests, not the soak (see fault_soak.py)
 KINDS = ["timeout", "raise", "nan-poison", "device-loss"]
-
-
-def _submit_retry(fn, tries: int = 200):
-    """Admission rejections are the CONTRACT under an open breaker —
-    honor the retry hint instead of treating them as failures."""
-    for _ in range(tries):
-        try:
-            return fn()
-        except (LoadShed, QueueFull) as e:
-            time.sleep(min(getattr(e, "retry_in_s", 0.0) or 0.02, 0.1))
-    raise RuntimeError(f"admission retries exhausted after {tries} tries")
 
 
 def run_trial(trial: int, seed: int) -> dict:
@@ -88,12 +65,9 @@ def run_trial(trial: int, seed: int) -> dict:
         info.update(site=site, kind=kind, after_n=after_n,
                     persistent=persistent)
 
-    res.faults.clear()
     # short cooldown so a tripped breaker half-opens within the soak's
     # retry budget instead of shedding for the default 30s
-    res.reset_breaker(CircuitBreaker(threshold=2, cooldown_s=0.05))
-    res.configure(max_retries=2, backoff_s=0.0, timeout_s=0.0)
-    res.enable()
+    resilience_up(breaker=CircuitBreaker(threshold=2, cooldown_s=0.05))
     svc = None
     try:
         svc = QrackService(batch_window_ms=5.0, max_batch=n_sessions,
@@ -138,7 +112,7 @@ def run_trial(trial: int, seed: int) -> dict:
             item = streams[k][cursors[k]]
             sid = sids[k]
             if item[0] == "circ":
-                handles.append(_submit_retry(
+                handles.append(submit_retry(
                     lambda s=sid, c=item[1]: svc.submit(s, c)))
             else:
                 _, name, args = item
@@ -146,7 +120,7 @@ def run_trial(trial: int, seed: int) -> dict:
                 def do(eng, name=name, args=args):
                     return getattr(eng, name)(*args)
 
-                handles.append(_submit_retry(
+                handles.append(submit_retry(
                     lambda s=sid, f=do: svc.call(s, f)))
             cursors[k] += 1
             if cursors[k] >= len(streams[k]):
@@ -155,14 +129,12 @@ def run_trial(trial: int, seed: int) -> dict:
             h.result(timeout=120)
         fidelities = []
         for sid, oracle in zip(sids, oracles):
-            b = np.asarray(_submit_retry(
+            b = np.asarray(submit_retry(
                 lambda s=sid: svc.call(s, lambda eng: eng.GetQuantumState())
             ).result(timeout=120))
             with res.faults.suspended():
                 a = np.asarray(oracle.GetQuantumState())
-            f = abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
-                                           * np.vdot(b, b).real)
-            fidelities.append(float(f))
+            fidelities.append(fidelity(a, b))
         info["n_jobs"] = len(handles)
         info["fired"] = sum(sp.fired for sp in res.faults.specs())
         info["breaker"] = res.get_breaker().snapshot()["state"]
@@ -175,25 +147,12 @@ def run_trial(trial: int, seed: int) -> dict:
     finally:
         if svc is not None:
             svc.close()
-        res.faults.clear()
-        res.reset_breaker()
-        res.disable()
+        resilience_down()
     return info
 
 
 def main(argv) -> int:
-    trials = int(argv[1]) if len(argv) > 1 else 60
-    seed = int(argv[2]) if len(argv) > 2 else 0
-    failures = 0
-    for t in range(trials):
-        info = run_trial(t, seed)
-        print(json.dumps(info), flush=True)
-        if not info["ok"]:
-            failures += 1
-    print(f"SOAK {'FAILED' if failures else 'OK'}: "
-          f"{trials - failures}/{trials} trials oracle-equivalent",
-          flush=True)
-    return 1 if failures else 0
+    return soak_main(argv, run_trial, default_trials=60)
 
 
 if __name__ == "__main__":

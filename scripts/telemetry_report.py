@@ -21,6 +21,10 @@ sum; spans merge).  Sections:
   * elasticity: repage shrink/expand traffic, failed expansions,
     hybrid un-pins; the current page count rides the gauges section
     (elastic.pages) — docs/ELASTICITY.md
+  * integrity: invariant violations, replay repairs vs giveups,
+    quarantine strikes/devices/repages, canary verification traffic;
+    the live quarantine size rides the gauges section
+    (integrity.quarantined) — docs/INTEGRITY.md
   * layer events (qunit/stabilizer/qbdt/hybrid/factory escalations)
   * spans: count, total, mean
 
@@ -95,6 +99,7 @@ def report(snap: dict, top: int) -> dict:
         "route": {},
         "checkpoint": {},
         "elastic": {},
+        "integrity": {},
         "gauges": snap.get("gauges", {}),
         "layer_events": {},
         "spans": snap.get("spans", {}),
@@ -117,6 +122,8 @@ def report(snap: dict, top: int) -> dict:
             out["checkpoint"][k] = v
         elif k.startswith("elastic."):
             out["elastic"][k] = v
+        elif k.startswith("integrity."):
+            out["integrity"][k] = v
         elif k.split(".")[0] in ("qunit", "qunitmulti", "stabilizer",
                                  "qbdt", "hybrid", "factory", "engine",
                                  "cluster", "resilience"):
@@ -193,6 +200,10 @@ def main(argv=None) -> int:
     if rep["elastic"]:
         print("== elasticity ==")
         for name, v in sorted(rep["elastic"].items()):
+            print(f"  {name:<40s} {v:>12.0f}")
+    if rep["integrity"]:
+        print("== integrity ==")
+        for name, v in sorted(rep["integrity"].items()):
             print(f"  {name:<40s} {v:>12.0f}")
     if rep["gauges"]:
         print("== gauges ==")
