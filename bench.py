@@ -97,18 +97,39 @@ def _make_fused_qft_fn(width: int, dtype):
     qft_qcircuit -> neighbor-merged ops -> ONE structure-keyed compiled
     program taking every rotation as a runtime operand (constant-free;
     qrack_tpu/ops/fusion.py).  This is literally what the engine fuser
-    dispatches, so its wall-clock is the fused-path headline."""
+    dispatches, so its wall-clock is the fused-path headline.
+
+    The lowering mirrors the engine flush: the cost model picks the
+    single-sweep Pallas kernel or the XLA op chain per
+    QRACK_TPU_FUSE_KERNEL (auto/on/off), and the choice plus the HBM
+    sweeps the program actually pays ride the stats line
+    (hbm_sweeps_per_window — 1 sweep per planned segment on the kernel
+    path vs one per op on the chain)."""
     from qrack_tpu.models import qft as qftm
     from qrack_tpu.ops import fusion as fu
 
     ops = fu.lower_gates(qftm.qft_qcircuit(width).gates)
-    prog = fu.dense_window_program(width, fu.structure_of(ops), dtype)
+    structure = fu.structure_of(ops)
+    plan, _why = fu.kernel_lowering(width, structure)
+    if plan is not None:
+        prog = fu.kernel_window_program(width, structure, dtype,
+                                        interpret=plan["interpret"],
+                                        block_pow=plan["block_pow"])
+        sweeps = plan["sweeps"]
+        lowering = "pallas_interp" if plan["interpret"] else "pallas"
+    else:
+        prog = fu.dense_window_program(width, structure, dtype)
+        sweeps = len(ops)
+        lowering = "xla_chain"
     operands = fu.dense_operands(ops, dtype)
 
     def fn(planes):
         return prog(planes, *operands)
 
     fn.already_compiled = True  # _measure must not re-wrap in jax.jit
+    fn.window_ops = len(ops)
+    fn.hbm_sweeps = sweeps
+    fn.fuse_lowering = lowering
     return fn
 
 
@@ -271,6 +292,13 @@ def _measure(width: int, samples: int):
         # window program, fusion ON; "unrolled"/"fast" = per-stage
         # traced circuits, the pre-fusion forms)
         st["qft_form"] = _qft_form(width)
+        if getattr(body, "fuse_lowering", None):
+            # the fused-window program's lowering + honest HBM pass
+            # count: one sweep per planned kernel segment, one per op
+            # on the XLA chain (feeds hbm_sweeps_per_window in _emit)
+            st["fuse_lowering"] = body.fuse_lowering
+            st["window_ops"] = body.window_ops
+            st["hbm_sweeps_per_window"] = body.hbm_sweeps
     if WORKLOAD == "xeb":
         st["xeb_fidelity"] = round(_xeb_from_planes(planes, width), 6)
     return st
@@ -354,7 +382,17 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     if base_src:
         line["baseline_source"] = base_src
     if WORKLOAD != "qft_unit":
-        ghbm = _implied_hbm(width, stats["avg"])
+        sweeps = stats.get("hbm_sweeps_per_window")
+        if sweeps is not None:
+            # fused-window line: the program's real pass count is known
+            # (kernel plan or op chain), so both the ratio and the
+            # implied bandwidth use it instead of the 2w stage estimate
+            line["hbm_sweeps_per_window"] = sweeps
+            esize = 2 if DTYPE == "bfloat16" else 4
+            ghbm = (sweeps * 2 * (1 << width) * esize * 2
+                    / max(stats["avg"], 1e-12) / 1e9)
+        else:
+            ghbm = _implied_hbm(width, stats["avg"])
         line["implied_hbm_gbps"] = round(ghbm, 1)
         # dense simulation is bandwidth-bound (2-4 flops/byte), so the
         # roofline fraction IS the MFU analogue: fraction of the v5e's
@@ -532,6 +570,20 @@ def main() -> None:
                 _emit(fb_width, st_off,
                       label_suffix="_cpu_xla_fallback_fuse_off")
                 emitted = True
+            # kernel A/B sibling: same fused window forced through the
+            # Pallas kernel's CPU lowering (the interpreter — parity
+            # harness, ~3x the XLA chain on the real QFT despite paying
+            # ~40x fewer HBM sweeps; docs/PERFORMANCE.md documents the
+            # gap).  Fail-soft: a lost child leaves a *_timed_out line.
+            st_k = _run_child(fb_width, min(SAMPLES, 3),
+                              min(150.0, _remaining() - 20),
+                              platform="cpu",
+                              extra_env={"QRACK_BENCH_QFT_FORM": "fused",
+                                         "QRACK_TPU_FUSE_KERNEL": "on"})
+            if st_k:
+                _emit(fb_width, st_k,
+                      label_suffix="_cpu_xla_fallback_kernel_interp")
+                emitted = True
 
         # 1a) Second CPU anchor on the OTHER reference headline workload
         #     (nearest-neighbour RCS, test_random_circuit_sampling_nn):
@@ -561,6 +613,25 @@ def main() -> None:
     #    (VERDICT r4: 240s was shorter than a cold compile).
     tpu_alive = False
     tpu_attempted = False
+    kernel_ab_done = False
+
+    def _kernel_ab(w) -> bool:
+        """On-chip kernel A/B at width w: the fused window program with
+        the Pallas kernel (auto resolves to on for TPU backends) vs
+        QRACK_TPU_FUSE_KERNEL=off (the PR 5 XLA window chain,
+        byte-for-byte) — one pair per run, fail-soft timed_out lines."""
+        got = False
+        for tag, env in (
+                ("_fused_kernel_on", {"QRACK_BENCH_QFT_FORM": "fused"}),
+                ("_fused_kernel_off", {"QRACK_BENCH_QFT_FORM": "fused",
+                                       "QRACK_TPU_FUSE_KERNEL": "off"})):
+            st = _run_child(w, min(SAMPLES, 3),
+                            min(300.0, _remaining() - 20), extra_env=env)
+            if st:
+                _emit(w, st, label_suffix=tag)
+                got = True
+        return got
+
     if FIRST_WIDTH < WIDTH:
         tpu_attempted = True
         st = _run_child(FIRST_WIDTH, SAMPLES, min(420.0, _remaining() - 20))
@@ -568,6 +639,10 @@ def main() -> None:
             _emit(FIRST_WIDTH, st)
             emitted = True
             tpu_alive = True
+            if (WORKLOAD == "qft"
+                    and not os.environ.get("QRACK_BENCH_QFT_FORM")
+                    and _remaining() > 360):
+                kernel_ab_done = _kernel_ab(FIRST_WIDTH)
 
     # 3) Full-width TPU measurement (and optional sweep).
     widths = [WIDTH]
@@ -590,6 +665,10 @@ def main() -> None:
             _emit(w, st)
             emitted = True
             tpu_alive = True
+            if (not kernel_ab_done and WORKLOAD == "qft"
+                    and not os.environ.get("QRACK_BENCH_QFT_FORM")
+                    and _remaining() > 360):
+                kernel_ab_done = _kernel_ab(w)
         elif not tpu_alive:
             break
 

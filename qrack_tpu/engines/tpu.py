@@ -348,9 +348,20 @@ class QEngineTPU(QEngine):
                 self._state = _j_apply_2x2(
                     self._state, mp, n, op.target, op.cmask, op.cval)
             return 1
-        prog = fu.dense_window_program(n, fu.structure_of(ops), self.dtype)
+        structure = fu.structure_of(ops)
         operands = fu.dense_operands(ops, self.dtype)
+        plan, why = fu.kernel_lowering(n, structure)
+        if plan is not None:
+            prog = fu.kernel_window_program(
+                n, structure, self.dtype, interpret=plan["interpret"],
+                block_pow=plan["block_pow"])
+            self._state = prog(self._state, *operands)
+            fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"])
+            return 1
+        fu.record_kernel_fallback(why)
+        prog = fu.dense_window_program(n, structure, self.dtype)
         self._state = prog(self._state, *operands)
+        fu.record_xla_flush(self._tele_name, len(ops))
         return 1
 
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:

@@ -266,7 +266,14 @@ def _mk_fuse_window(ca, block, cdt, qmax, structure):
     Non-diagonal targets at/above the chunk axis never reach here
     (_fuse_admit routes them to the eager pair-mixing program).  A chunk
     no window op acted on keeps its codes bit-for-bit — same exactness
-    contract as the per-gate kernels."""
+    contract as the per-gate kernels.
+
+    The per-op tile math lives in ops/pallas_kernels.py's shared tile
+    primitives (one implementation for the dense Pallas kernel, the
+    pager's per-page kernel body and this decompress->window->recompress
+    sweep); only the dirty/ident exact-keep accounting is local."""
+    from ..ops import pallas_kernels as pk
+
     lbits = (1 << ca) - 1
 
     def run(codes3, scales2, rot, rot_t, cid0, *operands):
@@ -288,10 +295,8 @@ def _mk_fuse_window(ca, block, cdt, qmax, structure):
                         clo, chi = comb & lbits, comb >> ca
                     # chi carries the target's high bit too, so hi_ok
                     # is already exact per chunk (factor-1 chunks stay)
-                    hi_ok = (cid & chi) == chi
-                    hit = ((lidx & clo) == clo) & hi_ok
-                    pl = gk.cmul(jnp.where(hit, p[0], 1.0),
-                                 jnp.where(hit, p[1], 0.0), pl)
+                    pl, hi_ok = pk.tile_cphase(pl, lidx, cid, clo, chi,
+                                               p[0], p[1])
                     dirty = dirty | hi_ok
                     continue
                 if has_ctrl:
@@ -299,20 +304,15 @@ def _mk_fuse_window(ca, block, cdt, qmax, structure):
                     i += 4
                 else:
                     lo_cm = lo_cv = hi_cm = hi_cv = 0
-                hi_ok = (cid & hi_cm) == hi_cv
                 if kind == "diag":
-                    tmask_lo = (1 << target) if target < ca else 0
-                    tb_hi = 0 if target < ca else (1 << (target - ca))
-                    hi_bit = (cid & tb_hi) != 0
-                    bit = ((lidx & tmask_lo) != 0) | hi_bit
-                    fre = jnp.where(bit, p[1, 0], p[0, 0])
-                    fim = jnp.where(bit, p[1, 1], p[0, 1])
-                    active = ((lidx & lo_cm) == lo_cv) & hi_ok
-                    pl = gk.cmul(jnp.where(active, fre, 1.0),
-                                 jnp.where(active, fim, 0.0), pl)
-                    if tmask_lo == 0:
+                    pl, hi_ok = pk.tile_diag(
+                        pl, lidx, cid, target, ca,
+                        p[0, 0], p[0, 1], p[1, 0], p[1, 1],
+                        lo_cm, lo_cv, hi_cm, hi_cv)
+                    if target >= ca:
                         # whole-chunk constant factor: exact-keep chunks
                         # whose factor is identically 1 (_mk_diag ident)
+                        hi_bit = (cid & (1 << (target - ca))) != 0
                         cf_re = jnp.where(hi_bit, p[1, 0], p[0, 0])
                         cf_im = jnp.where(hi_bit, p[1, 1], p[0, 1])
                         ident = ((lo_cm == 0) & (cf_re == 1.0)
@@ -321,8 +321,9 @@ def _mk_fuse_window(ca, block, cdt, qmax, structure):
                     else:
                         dirty = dirty | hi_ok
                 else:  # gen: target < ca guaranteed by _fuse_admit
-                    out = gk.apply_2x2(pl, p, ca, target, lo_cm, lo_cv)
-                    pl = jnp.where(hi_ok, out, pl)
+                    pl, hi_ok = pk.tile_local_2x2(pl, lidx, cid, target, p,
+                                                  lo_cm, lo_cv,
+                                                  hi_cm, hi_cv)
                     dirty = dirty | hi_ok
             nc, ns = _comp_rows_f(_planes_to_rows(pl, block), rot,
                                   qmax, cdt)

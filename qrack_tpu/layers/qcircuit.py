@@ -174,21 +174,19 @@ class QCircuit:
             if os.environ.get("QRACK_USE_PALLAS") == "1":
                 import jax
 
-                # the pallas segment sweep bakes matrices as kernel
-                # constants, so its cache key needs payload VALUES
-                # (digest), not just structure
-                key = ("pallas", n, self.structure_digest())
-
-                def build():
-                    # pallas lowers natively on TPU; elsewhere (tests,
-                    # CPU installs) run the same kernel interpreted
-                    body = self.compile_fn_pallas(
-                        n,
-                        interpret=jax.default_backend() not in ("tpu", "axon"))
-                    return jax.jit(body, donate_argnums=(0,))
-
-                fn = fu.PROGRAMS.get_or_build(key, build)
-                qsim._state = fn(qsim._state)
+                ops = fu.lower_gates(self.gates)
+                if not ops:
+                    return
+                # the parametric window kernel takes payloads as runtime
+                # operands, so this keys on STRUCTURE in the shared fuse
+                # cache — same-skeleton circuits with different angles
+                # hit one executable, exactly like the XLA window path
+                # (the old baked segment sweep needed a payload digest)
+                prog = fu.kernel_window_program(
+                    n, fu.structure_of(ops), qsim.dtype,
+                    interpret=jax.default_backend() not in ("tpu", "axon"))
+                qsim._state = prog(qsim._state,
+                                   *fu.dense_operands(ops, qsim.dtype))
                 return
             ops = fu.lower_gates(self.gates)
             if not ops:
@@ -326,64 +324,25 @@ class QCircuit:
 
     def compile_fn_pallas(self, n: int, block_pow: int = 16,
                           interpret: bool = False):
-        """fn(planes) applying the circuit as fused Pallas gate-segment
-        sweeps (one HBM read+write per segment) with XLA-kernel bridges
-        for ops a tile cannot hold (non-diagonal high targets).  Opt-in:
-        see ops/pallas_kernels.py."""
-        from ..ops import gatekernels as gk
+        """fn(planes) applying the circuit through the parametric Pallas
+        window kernel: one HBM sweep per planned segment, matrices and
+        masks as runtime operands (trace shape depends only on circuit
+        structure).  Non-diagonal targets at/above the tile no longer
+        bridge out to XLA or raise — they lead pair-mapped cross-tile
+        segments (ops/pallas_kernels.py plan_window).  ``fn.sweeps``
+        reports the planned sweep count."""
+        from ..ops import fusion as fu
         from ..ops import pallas_kernels as pk
-        from ..utils.bits import control_offset
 
-        bp = min(block_pow, n)
-        plan: List[Tuple] = []  # ("seg", ops) | ("xla", target, cmask, cval, m)
-        seg: List[Tuple] = []
-        for g in self.gates:
-            for perm, m in g.payloads.items():
-                cmask = 0
-                for c in g.controls:
-                    cmask |= 1 << c
-                cval = control_offset(g.controls, perm)
-                kind = "diag" if mat.is_phase(m) else "gen"
-                if pk.segment_compatible(kind, g.target, bp):
-                    seg.append((kind, g.target, cmask, cval, m))
-                else:
-                    if seg:
-                        plan.append(("seg", seg))
-                        seg = []
-                    plan.append(("xla", g.target, cmask, cval, m))
-        if seg:
-            plan.append(("seg", seg))
-
-        stages = []
-        for item in plan:
-            if item[0] == "seg":
-                stages.append(pk.make_segment_fn(item[1], n, block_pow=bp,
-                                                 interpret=interpret))
-            else:
-                _, target, cmask, cval, m = item
-                if mat.is_invert(m):
-                    tr, bl = complex(m[0, 1]), complex(m[1, 0])
-
-                    def xla_stage(planes, target=target, cmask=cmask,
-                                  cval=cval, tr=tr, bl=bl):
-                        return gk.apply_invert(planes, tr.real, tr.imag,
-                                               bl.real, bl.imag,
-                                               n, target, cmask, cval)
-                else:
-                    mp = gk.mtrx_planes(m)
-
-                    def xla_stage(planes, target=target, cmask=cmask,
-                                  cval=cval, mp=mp):
-                        return gk.apply_2x2(planes, mp.astype(planes.dtype),
-                                            n, target, cmask, cval)
-
-                stages.append(xla_stage)
+        ops = fu.lower_gates(self.gates)
+        structure = fu.structure_of(ops)
+        wfn = pk.make_window_fn(n, structure, block_pow=block_pow,
+                                interpret=interpret)
 
         def fn(planes):
-            for stage in stages:
-                planes = stage(planes)
-            return planes
+            return wfn(planes, *fu.dense_operands(ops, planes.dtype))
 
+        fn.sweeps = wfn.sweeps
         return fn
 
     def compile_fn(self, n: int):

@@ -225,6 +225,117 @@ def dense_window_program(n: int, structure: Tuple, dtype):
 
 
 # ---------------------------------------------------------------------------
+# single-sweep Pallas kernel lowering — cost-model-selected against the
+# XLA window chain above.  The kernel streams the ket through VMEM once
+# per planned segment (ops/pallas_kernels.py) instead of once per gate,
+# with the SAME runtime-operand layout and structure-only cache keys,
+# so choosing it never changes retrace behavior — only the lowering.
+# ---------------------------------------------------------------------------
+
+KERNEL_BACKENDS = ("tpu", "axon")
+
+
+def kernel_mode() -> str:
+    """``QRACK_TPU_FUSE_KERNEL``: auto (default — kernel on TPU-class
+    backends, XLA chain elsewhere), on (force the kernel everywhere;
+    interpret-lowered off-TPU, parity-grade not perf-grade), off (PR 5
+    XLA window path, byte-for-byte)."""
+    v = os.environ.get("QRACK_TPU_FUSE_KERNEL", "auto").strip().lower()
+    return v if v in ("auto", "on", "off") else "auto"
+
+
+def kernel_lowering(n: int, structure: Tuple, backend: str = None):
+    """Cost model: should this window flush through the Pallas kernel?
+
+    Returns ``(plan, fallback_reason)`` — exactly one is non-None.
+    ``plan`` is ``{"interpret": bool, "block_pow": int, "sweeps": int}``.
+
+    The decision inputs are the window length, op mix (how many planned
+    segments the cross-tile non-diagonals force), width and block_pow:
+
+    * mode off — never (reason ``mode_off``).
+    * mode on — always; off-TPU the kernel runs under the Pallas
+      interpreter (correctness harness, ~14x slower than the XLA chain
+      on CPU — docs/PERFORMANCE.md).
+    * mode auto — TPU-class backends only (reason ``cpu_backend``
+      elsewhere: the CPU XLA chain is measured compute-bound at these
+      widths, so a single-sweep lowering cannot beat it and interpret
+      certainly cannot).  On TPU the kernel wins when it saves HBM
+      sweeps: windows whose planned segment count is not below the op
+      count (e.g. every op a cross-tile gen) fall back with reason
+      ``no_sweep_gain``; single-op windows with ``single_op`` (the
+      eager per-gate programs already pay one sweep).
+    """
+    from . import pallas_kernels as pk
+
+    mode = kernel_mode()
+    if mode == "off":
+        return None, "mode_off"
+    if backend is None:
+        backend = jax.default_backend()
+    bp = min(pk.DEFAULT_BLOCK_POW, n)
+    sweeps = pk.plan_sweeps(structure, bp)
+    plan = {"interpret": backend not in KERNEL_BACKENDS,
+            "block_pow": bp, "sweeps": sweeps}
+    if mode == "on":
+        return plan, None
+    if backend not in KERNEL_BACKENDS:
+        return None, "cpu_backend"
+    if len(structure) <= 1:
+        return None, "single_op"
+    if sweeps >= len(structure):
+        return None, "no_sweep_gain"
+    return plan, None
+
+
+def kernel_window_program(n: int, structure: Tuple, dtype,
+                          interpret: bool = False,
+                          block_pow: int = None):
+    """The Pallas twin of :func:`dense_window_program`: one guarded
+    jitted program per (lowering, width, dtype, structure) in the SAME
+    shared cache — same-structure windows with different angles are a
+    compile.fuse hit on this path too."""
+    from . import pallas_kernels as pk
+
+    bp = min(pk.DEFAULT_BLOCK_POW, n) if block_pow is None else block_pow
+    key = ("kernel", "interp" if interpret else "mosaic", bp, n,
+           str(jnp.dtype(dtype)), structure)
+
+    def build():
+        fn = pk.make_window_fn(n, structure, block_pow=bp,
+                               interpret=interpret)
+        return _res.instrument_dispatch(
+            "tpu.fuse.flush",
+            _tele.instrument_jit("fuse.window", jax.jit(fn,
+                                                        donate_argnums=(0,))))
+
+    return PROGRAMS.get_or_build(key, build)
+
+
+def record_kernel_flush(name: str, nops: int, sweeps: int) -> None:
+    """A window flushed through the Pallas kernel: count it and the HBM
+    sweeps it actually paid (telemetry_report derives sweeps/window)."""
+    if _tele._ENABLED:
+        _tele.inc("fuse.kernel.windows")
+        _tele.inc("fuse.kernel.ops", nops)
+        _tele.inc("fuse.kernel.sweeps", sweeps)
+
+
+def record_xla_flush(name: str, nops: int) -> None:
+    """A multi-op window flushed through the XLA op chain (~one sweep
+    per op)."""
+    if _tele._ENABLED:
+        _tele.inc("fuse.xla.windows")
+        _tele.inc("fuse.xla.ops", nops)
+        _tele.inc("fuse.xla.sweeps", nops)
+
+
+def record_kernel_fallback(reason: str) -> None:
+    if _tele._ENABLED:
+        _tele.inc(f"fuse.kernel.fallback.{reason}")
+
+
+# ---------------------------------------------------------------------------
 # sharded ('pages'-mesh) parametric window lowering — QPager wraps the
 # body in ONE shard_map program (parallel/pager.py _p_fuse_window), so a
 # flushed window costs one dispatch regardless of how many paged-target
@@ -314,6 +425,188 @@ def sharded_operands(ops: Sequence[FusedOp], L: int, dtype) -> List:
             out.extend(jnp.asarray(v, dtype=jnp.int32)
                        for v in split_masks(op.cmask, op.cval, L))
     return out
+
+
+# ---------------------------------------------------------------------------
+# per-page Pallas variant of the sharded window — local runs stream each
+# page's shard through the single-sweep kernel; paged-target 2x2s keep
+# the ppermute pair-exchange path byte-for-byte (the exchange IS the
+# sweep there, and Mosaic can't express cross-device pairs anyway)
+# ---------------------------------------------------------------------------
+
+def _sharded_segments(structure: Tuple, L: int):
+    """Split a sharded window structure into kernel-lowered local runs
+    and pass-through global (paged-target) gens."""
+    segs: List[Tuple] = []
+    cur: List[Tuple] = []
+    for idx, (kind, target, has_ctrl) in enumerate(structure):
+        if kind == "gen" and target >= L:
+            if cur:
+                segs.append(("run", cur))
+                cur = []
+            segs.append(("global", (idx, target, has_ctrl)))
+        else:
+            cur.append((idx, kind, target, has_ctrl))
+    if cur:
+        segs.append(("run", cur))
+    return segs
+
+
+def _sharded_run_structure(run, L: int) -> Tuple:
+    """Dense-kernel structure for one local run.  Page-level mask and
+    target bits can't ride the dense masks (they sit above the shard),
+    so they fold into the runtime payloads against page_id instead:
+    every mapped op is 'controlled' with the LOCAL mask halves, and a
+    page-bit cphase/diag degrades to a target-agnostic diag whose two
+    factors are equal (d0 == d1 makes the target bit irrelevant)."""
+    out = []
+    for (idx, kind, target, has_ctrl) in run:
+        if target >= L:  # cphase/diag on a page bit
+            out.append(("diag", 0, True))
+        else:
+            out.append((kind, target, True))
+    return tuple(out)
+
+
+def _sharded_run_operands(run, L: int, operands, offs, pid, dtype):
+    """Traced per-shard dense-layout operands for one local run: local
+    masks pass through, page-level tests collapse into the payload
+    (identity payload when this page misses the page-mask)."""
+    lbits = (1 << L) - 1
+    one = jnp.ones((), dtype)
+    zero = jnp.zeros((), dtype)
+    ident_planes = jnp.asarray(
+        [[[1.0, 0.0], [0.0, 1.0]], [[0.0, 0.0], [0.0, 0.0]]], dtype)
+    out: List = []
+    for (idx, kind, target, has_ctrl) in run:
+        p = operands[offs[idx]]
+        if kind == "cphase":
+            if has_ctrl:
+                clo = operands[offs[idx] + 1]
+                chi = operands[offs[idx] + 2]
+            else:
+                comb = 1 << target
+                clo = jnp.int32(comb & lbits)
+                chi = jnp.int32(comb >> L)
+            page_ok = (pid & chi) == chi
+            fre = jnp.where(page_ok, p[0], one)
+            fim = jnp.where(page_ok, p[1], zero)
+            if target < L:
+                out.append(jnp.stack([fre, fim]))
+                cm = clo & jnp.int32(~(1 << target) & lbits)
+            else:
+                d = jnp.stack([fre, fim])
+                out.append(jnp.stack([d, d]))
+                cm = clo
+            out.extend([jnp.asarray(cm, jnp.int32),
+                        jnp.asarray(cm, jnp.int32)])
+            continue
+        if has_ctrl:
+            lm, lv, gm, gv = operands[offs[idx] + 1:offs[idx] + 5]
+        else:
+            lm = lv = gm = gv = jnp.int32(0)
+        page_ok = (pid & gm) == gv
+        if kind == "diag":
+            if target < L:
+                ident = jnp.asarray([[1.0, 0.0], [1.0, 0.0]], dtype)
+                out.append(jnp.where(page_ok, p, ident))
+            else:
+                tb = (pid & jnp.int32((1 << target) >> L)) != 0
+                d = jnp.where(tb, p[1], p[0])
+                dre = jnp.where(page_ok, d[0], one)
+                dim = jnp.where(page_ok, d[1], zero)
+                d = jnp.stack([dre, dim])
+                out.append(jnp.stack([d, d]))
+        else:  # gen, target < L (globals were split out)
+            out.append(jnp.where(page_ok, p, ident_planes))
+        out.extend([jnp.asarray(lm, jnp.int32), jnp.asarray(lv, jnp.int32)])
+    return out
+
+
+def _sharded_offs(structure: Tuple) -> List[int]:
+    offs: List[int] = []
+    o = 0
+    for kind, target, has_ctrl in structure:
+        offs.append(o)
+        o += 1 + ((2 if kind == "cphase" else 4) if has_ctrl else 0)
+    return offs
+
+
+def sharded_kernel_sweeps(structure: Tuple, L: int,
+                          block_pow: int = None) -> int:
+    """HBM sweeps the per-page kernel lowering pays: one per planned
+    kernel segment inside each local run, one per ppermute exchange."""
+    from . import pallas_kernels as pk
+
+    bp = min(pk.DEFAULT_BLOCK_POW, L) if block_pow is None else block_pow
+    total = 0
+    for seg in _sharded_segments(structure, L):
+        if seg[0] == "global":
+            total += 1
+        else:
+            total += pk.plan_sweeps(_sharded_run_structure(seg[1], L), bp)
+    return total
+
+
+def sharded_kernel_lowering(L: int, structure: Tuple, backend: str = None):
+    """Pager twin of :func:`kernel_lowering` — same mode/backend gates,
+    sweeps counted through the run/exchange split."""
+    from . import pallas_kernels as pk
+
+    mode = kernel_mode()
+    if mode == "off":
+        return None, "mode_off"
+    if backend is None:
+        backend = jax.default_backend()
+    bp = min(pk.DEFAULT_BLOCK_POW, L)
+    sweeps = sharded_kernel_sweeps(structure, L, bp)
+    plan = {"interpret": backend not in KERNEL_BACKENDS,
+            "block_pow": bp, "sweeps": sweeps}
+    if mode == "on":
+        return plan, None
+    if backend not in KERNEL_BACKENDS:
+        return None, "cpu_backend"
+    if len(structure) <= 1:
+        return None, "single_op"
+    if sweeps >= len(structure):
+        return None, "no_sweep_gain"
+    return plan, None
+
+
+def sharded_kernel_window_body(L: int, npg: int, structure: Tuple,
+                               block_pow: int = None,
+                               interpret: bool = False):
+    """Per-shard traced body fn(local, *operands) — SAME sharded operand
+    layout as :func:`sharded_window_body`, kernel-lowered local runs."""
+    from . import pallas_kernels as pk
+    from . import sharded as shb
+
+    bp = min(pk.DEFAULT_BLOCK_POW, L) if block_pow is None else block_pow
+    segments = _sharded_segments(structure, L)
+    offs = _sharded_offs(structure)
+    runs = {id(seg): pk.make_window_fn(L, _sharded_run_structure(seg[1], L),
+                                       block_pow=bp, interpret=interpret)
+            for seg in segments if seg[0] == "run"}
+
+    def fn(local, *operands):
+        pid = shb.page_id()
+        for seg in segments:
+            if seg[0] == "global":
+                idx, target, has_ctrl = seg[1]
+                p = operands[offs[idx]]
+                if has_ctrl:
+                    lm, lv, gm, gv = operands[offs[idx] + 1:offs[idx] + 5]
+                else:
+                    lm = lv = gm = gv = 0
+                local = shb.apply_global_2x2(local, p, npg, target - L,
+                                             lm, lv, gm, gv)
+            else:
+                dops = _sharded_run_operands(seg[1], L, operands, offs,
+                                             pid, local.dtype)
+                local = runs[id(seg)](local, *dops)
+        return local
+
+    return fn
 
 
 # ---------------------------------------------------------------------------

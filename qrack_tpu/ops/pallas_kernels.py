@@ -1,28 +1,46 @@
-"""Pallas TPU kernels: fused gate-segment sweep.
+"""Parametric single-sweep Pallas window kernels.
 
-The fused XLA circuit programs (QCircuit.compile_fn) still materialize
-the ket between most gates — each non-diagonal 2x2 is its own
-HBM read+write.  This kernel applies a whole SEGMENT of gates in one
-pass: each (2, BLOCK) tile of the split-plane ket is pulled into VMEM
-once, the entire gate queue runs on it in-register, and it is written
-back once — HBM traffic per segment drops from (gates) to 1 read+write
-(reference analogue: the per-gate OpenCL kernel chain,
-src/qengine/opencl.cpp:412-500, collapsed into one sweep).
+A fused gate window (ops/fusion.py) lowers here to ONE Pallas sweep per
+*segment*: the ket streams through VMEM tile by tile and every in-tile
+window op is applied while the tile is resident, instead of the one
+full HBM read+write per gate the XLA op-chain pays.  Matrices, control
+masks and phase operands enter as RUNTIME arguments in exactly the
+dense operand layout of fusion.window_fn — the compiled program is
+keyed by the window's *structure* tuple alone, so same-structure
+windows with different rotation angles never retrace (the property the
+XLA window path already had; the old baked-constant segment kernel did
+not).
 
-Segment compatibility (enforced by the planner in
-QCircuit.compile_fn_pallas):
-  * diagonal payloads: ANY target/controls (high bits resolve to a
-    scalar per tile via the grid index);
-  * non-diagonal payloads: target below the tile width (pairs live
-    inside one tile); controls anywhere.
+Vocabulary (everything the fuser emits):
 
-Opt-in via QRACK_USE_PALLAS=1 (off by default until validated on a
-healthy chip); `interpret=True` runs the same kernel on CPU for tests.
+* cphase / diag — ANY target and controls.  The combined/control mask
+  splits at runtime inside the kernel into a tile-local part tested
+  against the in-tile index and a high part tested against the grid
+  block id, so high targets cost one scalar compare per tile.
+* inv / gen with target < block_pow — in-tile pair mix via a static
+  (2, high, 2, low) reshape; controls anywhere (runtime mask split).
+* inv / gen with target >= block_pow — CROSS-TILE: the planner starts a
+  new segment led by the op, and the segment's grid maps block PAIRS:
+  the planes array is passed twice, the second BlockSpec index-mapping
+  ``i -> i ^ (1 << (target - block_pow))``, so each program instance
+  sees its own tile and its partner tile and computes its own row of
+  the 2x2 mix (inputs are read-only, so the duplicated read is pure).
+  This replaces the old ``target < block_pow`` refusal.
+
+``sweeps == len(segments)``: a window with no cross-tile non-diagonal
+op is exactly one sweep; each cross-tile op opens one more.
+
+Scalar operands ride in two packed SMEM refs (floats and int32 masks),
+a (K, 1) column each — TPU SMEM wants 2-D refs.  ``interpret=True``
+runs the same kernel under the Pallas interpreter for CPU parity
+tests; the interpreter re-materializes full buffers per grid step, so
+it is a CORRECTNESS harness, not a fast path (docs/PERFORMANCE.md,
+"interpret caveat").
 """
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -30,80 +48,374 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+try:  # SMEM memory space: TPU lowering + honoured by the interpreter
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - non-TPU pallas builds
+    pltpu = None
+
+DEFAULT_BLOCK_POW = 16
+
+# floats each op contributes to the packed scalar vector (dense layout
+# order: cphase [f.re,f.im]; diag [d0.re,d0.im,d1.re,d1.im];
+# inv [tr.re,tr.im,bl.re,bl.im]; gen mtrx_planes (2,2,2) row-major)
+_NFLOATS = {"cphase": 2, "diag": 4, "inv": 4, "gen": 8}
+
 
 def segment_compatible(kind: str, target: int, block_pow: int) -> bool:
-    return kind == "diag" or target < block_pow
+    """Can this op join an in-tile segment?  diag/cphase always can
+    (high bits resolve against the grid block id); non-diagonal ops
+    need their pair partner inside the tile.  An incompatible op is NOT
+    an error any more — the planner opens a pair-mapped cross-tile
+    segment for it (plan_window), so callers never see the old
+    mid-plan ValueError."""
+    return kind in ("cphase", "diag") or target < block_pow
 
 
-def make_segment_fn(ops: List[Tuple], n: int, block_pow: int = 16,
-                    interpret: bool = False):
-    """ops: list of (kind, target, cmask, cval, m) with kind in
-    {'diag','gen'} and m a complex 2x2 (host).  Returns fn(planes)."""
-    N = 1 << n
-    bp = min(block_pow, n)
-    BLOCK = 1 << bp
-    nblk = N // BLOCK
-    baked = []
-    for (kind, target, cmask, cval, m) in ops:
-        m = np.asarray(m, dtype=np.complex128)
-        if not segment_compatible(kind, target, bp):
-            raise ValueError("op not segment-compatible")
-        baked.append((kind, int(target), int(cmask), int(cval), m))
+def plan_window(structure: Tuple, block_pow: int) -> List[dict]:
+    """Split a window structure into single-sweep segments.
 
-    def kernel(in_ref, out_ref):
+    Returns a list of ``{"xgen": slot | None, "ops": [slot, ...]}``
+    where each slot is ``(op_index, kind, target, has_ctrl)``.  A
+    cross-tile inv/gen (target >= block_pow) leads its own segment —
+    the pair-mapped grid mixes partner tiles for exactly one op, then
+    the rest of the segment applies in-tile."""
+    segs: List[dict] = []
+    cur = {"xgen": None, "ops": []}
+    for idx, (kind, target, has_ctrl) in enumerate(structure):
+        slot = (idx, kind, target, has_ctrl)
+        if not segment_compatible(kind, target, block_pow):
+            if cur["ops"] or cur["xgen"] is not None:
+                segs.append(cur)
+            cur = {"xgen": slot, "ops": []}
+        else:
+            cur["ops"].append(slot)
+    segs.append(cur)
+    return segs
+
+
+def plan_sweeps(structure: Tuple, block_pow: int = DEFAULT_BLOCK_POW,
+                n: Optional[int] = None) -> int:
+    """HBM sweeps the kernel lowering pays for this window (the XLA
+    window chain pays ~len(structure))."""
+    bp = min(block_pow, n) if n is not None else block_pow
+    return len(plan_window(structure, bp))
+
+
+def _operand_slots(structure: Tuple):
+    """Per-op (float, int) offsets into the packed scalar vectors."""
+    slots = []
+    f = i = 0
+    for kind, target, has_ctrl in structure:
+        slots.append((f, i))
+        f += _NFLOATS[kind]
+        i += 2 if has_ctrl else 0
+    return slots, f, i
+
+
+def pack_operands(structure: Tuple, operands: Sequence, dtype=jnp.float32):
+    """Flatten a dense-layout operand vector (fusion.dense_operands)
+    into the kernel's packed scalar columns: fv (F, 1) float, iv (I, 1)
+    int32.  Trace-safe — composes under jit with traced operands."""
+    fs: List = []
+    iv: List = []
+    k = 0
+    for kind, target, has_ctrl in structure:
+        p = operands[k]
+        k += 1
+        if kind == "cphase":
+            fs += [p[0], p[1]]
+        elif kind in ("diag", "inv"):
+            fs += [p[0, 0], p[0, 1], p[1, 0], p[1, 1]]
+        else:  # gen: mtrx_planes (2, 2, 2) [plane, row, col]
+            fs += [p[0, 0, 0], p[0, 0, 1], p[0, 1, 0], p[0, 1, 1],
+                   p[1, 0, 0], p[1, 0, 1], p[1, 1, 0], p[1, 1, 1]]
+        if has_ctrl:
+            iv += [operands[k], operands[k + 1]]
+            k += 2
+    fv = jnp.stack([jnp.asarray(x, dtype) for x in fs]).reshape(-1, 1)
+    if not iv:
+        iv = [jnp.int32(0)]  # pallas refs must be non-empty; dead slot
+    ivec = jnp.stack([jnp.asarray(x, jnp.int32) for x in iv])
+    return fv, ivec.reshape(-1, 1)
+
+
+# ---------------------------------------------------------------------------
+# shared tile math — pure jnp on VALUES, used by the Pallas kernel body
+# below AND by the per-chunk / per-page window bodies (engines/
+# turboquant.py _mk_fuse_window, fusion.sharded_window_body) so every
+# stack applies window ops through one implementation
+# ---------------------------------------------------------------------------
+
+def tile_cphase(v, lidx, hi_id, clo, chi, fre, fim):
+    """Combined-mask phase on one tile; returns (planes, hi_ok)."""
+    hi_ok = (hi_id & chi) == chi
+    hit = ((lidx & clo) == clo) & hi_ok
+    one = jnp.ones((), v.dtype)
+    zero = jnp.zeros((), v.dtype)
+    f_re = jnp.where(hit, fre, one)
+    f_im = jnp.where(hit, fim, zero)
+    return jnp.stack([v[0] * f_re - v[1] * f_im,
+                      v[0] * f_im + v[1] * f_re]), hi_ok
+
+
+def tile_diag(v, lidx, hi_id, target, L,
+              d0re, d0im, d1re, d1im, lm, lv, gm, gv):
+    """Diagonal on one (2, 2^L) tile, target anywhere: in-tile targets
+    select per element, higher targets per tile via hi_id's bit."""
+    tmask_lo = (1 << target) if target < L else 0
+    tb_hi = 0 if target < L else (1 << (target - L))
+    hi_bit = (hi_id & tb_hi) != 0
+    bit = ((lidx & tmask_lo) != 0) | hi_bit
+    fre = jnp.where(bit, d1re, d0re)
+    fim = jnp.where(bit, d1im, d0im)
+    hi_ok = (hi_id & gm) == gv
+    active = ((lidx & lm) == lv) & hi_ok
+    one = jnp.ones((), v.dtype)
+    zero = jnp.zeros((), v.dtype)
+    f_re = jnp.where(active, fre, one)
+    f_im = jnp.where(active, fim, zero)
+    return jnp.stack([v[0] * f_re - v[1] * f_im,
+                      v[0] * f_im + v[1] * f_re]), hi_ok
+
+
+def tile_local_2x2(v, lidx, hi_id, target, mp, lm, lv, gm, gv):
+    """Generic 2x2 with the pair inside the tile (target < tile pow);
+    mp indexes like mtrx_planes (2, 2, 2) [plane, row, col] but may be
+    a nested list of traced scalars."""
+    block = v.shape[-1]
+    high = block >> (target + 1)
+    low = 1 << target
+    vv = v.reshape(2, high, 2, low)
+    a0r, a1r = vv[0, :, 0, :], vv[0, :, 1, :]
+    a0i, a1i = vv[1, :, 0, :], vv[1, :, 1, :]
+    n0r = (mp[0][0][0] * a0r - mp[1][0][0] * a0i
+           + mp[0][0][1] * a1r - mp[1][0][1] * a1i)
+    n0i = (mp[0][0][0] * a0i + mp[1][0][0] * a0r
+           + mp[0][0][1] * a1i + mp[1][0][1] * a1r)
+    n1r = (mp[0][1][0] * a0r - mp[1][1][0] * a0i
+           + mp[0][1][1] * a1r - mp[1][1][1] * a1i)
+    n1i = (mp[0][1][0] * a0i + mp[1][1][0] * a0r
+           + mp[0][1][1] * a1i + mp[1][1][1] * a1r)
+    nv = jnp.stack([
+        jnp.stack([n0r, n1r], axis=1),
+        jnp.stack([n0i, n1i], axis=1)]).reshape(2, block)
+    hi_ok = (hi_id & gm) == gv
+    sel = ((lidx & lm) == lv) & hi_ok
+    return jnp.where(sel, nv, v), hi_ok
+
+
+def tile_local_invert(v, lidx, hi_id, target,
+                      trre, trim, blre, blim, lm, lv, gm, gv):
+    """Anti-diagonal 2x2 (X/Y-like) with the pair inside the tile."""
+    block = v.shape[-1]
+    high = block >> (target + 1)
+    low = 1 << target
+    vv = v.reshape(2, high, 2, low)
+    a0r, a1r = vv[0, :, 0, :], vv[0, :, 1, :]
+    a0i, a1i = vv[1, :, 0, :], vv[1, :, 1, :]
+    n0r = trre * a1r - trim * a1i
+    n0i = trre * a1i + trim * a1r
+    n1r = blre * a0r - blim * a0i
+    n1i = blre * a0i + blim * a0r
+    nv = jnp.stack([
+        jnp.stack([n0r, n1r], axis=1),
+        jnp.stack([n0i, n1i], axis=1)]).reshape(2, block)
+    hi_ok = (hi_id & gm) == gv
+    sel = ((lidx & lm) == lv) & hi_ok
+    return jnp.where(sel, nv, v), hi_ok
+
+
+# ---------------------------------------------------------------------------
+# the Pallas window program (dense single-shard layout)
+# ---------------------------------------------------------------------------
+
+def _scalar_specs(nf: int, ni: int):
+    if pltpu is not None:
+        sm = pl.BlockSpec(memory_space=pltpu.SMEM)
+        return sm, sm
+    return (pl.BlockSpec((ni, 1), lambda i: (0, 0)),
+            pl.BlockSpec((nf, 1), lambda i: (0, 0)))
+
+
+def _apply_slot(v, lidx, blk, slot, slots, iv_ref, fv_ref, bp):
+    """Apply one in-tile window op to the loaded tile value.  Masks are
+    runtime scalars; the lo/hi split happens here (dense widths are
+    int32-safe: engines/tpu.py MAX_DENSE_QB)."""
+    idx, kind, target, has_ctrl = slot
+    foff, ioff = slots[idx]
+    lbits = (1 << bp) - 1
+    if has_ctrl:
+        cm = iv_ref[ioff, 0]
+        cv = iv_ref[ioff + 1, 0]
+    else:
+        cm = jnp.int32(0)
+        cv = jnp.int32(0)
+    if kind == "cphase":
+        comb = jnp.int32(1 << target) | cm
+        v, _ = tile_cphase(v, lidx, blk, comb & lbits, comb >> bp,
+                           fv_ref[foff, 0], fv_ref[foff + 1, 0])
+    elif kind == "diag":
+        v, _ = tile_diag(v, lidx, blk, target, bp,
+                         fv_ref[foff, 0], fv_ref[foff + 1, 0],
+                         fv_ref[foff + 2, 0], fv_ref[foff + 3, 0],
+                         cm & lbits, cv & lbits, cm >> bp, cv >> bp)
+    elif kind == "inv":
+        v, _ = tile_local_invert(v, lidx, blk, target,
+                                 fv_ref[foff, 0], fv_ref[foff + 1, 0],
+                                 fv_ref[foff + 2, 0], fv_ref[foff + 3, 0],
+                                 cm & lbits, cv & lbits, cm >> bp, cv >> bp)
+    else:
+        mp = [[[fv_ref[foff + 4 * plane + 2 * row + col, 0]
+                for col in range(2)]
+               for row in range(2)]
+              for plane in range(2)]
+        v, _ = tile_local_2x2(v, lidx, blk, target, mp,
+                              cm & lbits, cv & lbits, cm >> bp, cv >> bp)
+    return v
+
+
+def _segment_program(n: int, bp: int, seg: dict, slots, nf: int, ni: int,
+                     interpret: bool):
+    """One pl.pallas_call for one segment: run(planes, iv, fv)."""
+    block = 1 << bp
+    nblk = 1 << (n - bp)
+    lbits = block - 1
+    xgen = seg["xgen"]
+    iv_spec, fv_spec = _scalar_specs(nf, ni)
+    tile_spec = pl.BlockSpec((2, block), lambda i: (0, i))
+
+    def in_tile_ops(v, blk, iv_ref, fv_ref):
+        lidx = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)[0]
+        for slot in seg["ops"]:
+            v = _apply_slot(v, lidx, blk, slot, slots, iv_ref, fv_ref, bp)
+        return v
+
+    if xgen is None:
+        def kernel(iv_ref, fv_ref, in_ref, out_ref):
+            out_ref[...] = in_tile_ops(in_ref[...], pl.program_id(0),
+                                       iv_ref, fv_ref)
+
+        def run(planes, iv, fv):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((2, 1 << n), planes.dtype),
+                grid=(nblk,),
+                in_specs=[iv_spec, fv_spec, tile_spec],
+                out_specs=tile_spec,
+                interpret=interpret,
+            )(iv, fv, planes)
+
+        return run
+
+    # cross-tile segment: partner-pair grid for the leading inv/gen
+    idx, kind, target, has_ctrl = xgen
+    h = target - bp
+    foff_x, ioff_x = slots[idx]
+
+    def kernel(iv_ref, fv_ref, in_ref, pa_ref, out_ref):
         blk = pl.program_id(0)
-        v = in_ref[...]  # (2, BLOCK) planes in VMEM
-        lidx = jax.lax.broadcasted_iota(jnp.int32, (1, BLOCK), 1)[0]
-        one = jnp.ones((), v.dtype)
-        zero = jnp.zeros((), v.dtype)
-        for (kind, target, cmask, cval, m) in baked:
-            lm, lv = cmask & (BLOCK - 1), cval & (BLOCK - 1)
-            hm, hv = cmask >> bp, cval >> bp
-            ok_hi = (blk & hm) == hv  # scalar per tile
-            sel = ((lidx & lm) == lv) & ok_hi
-            if kind == "diag":
-                if target < bp:
-                    bit = ((lidx >> target) & 1) == 1
-                else:
-                    bit = ((blk >> (target - bp)) & 1) == 1  # scalar
-                fre = jnp.where(bit, jnp.asarray(m[1, 1].real, v.dtype),
-                                jnp.asarray(m[0, 0].real, v.dtype))
-                fim = jnp.where(bit, jnp.asarray(m[1, 1].imag, v.dtype),
-                                jnp.asarray(m[0, 0].imag, v.dtype))
-                fre = jnp.where(sel, fre, one)
-                fim = jnp.where(sel, fim, zero)
-                v = jnp.stack([v[0] * fre - v[1] * fim,
-                               v[0] * fim + v[1] * fre])
-            else:
-                high = BLOCK >> (target + 1)
-                low = 1 << target
-                vv = v.reshape(2, high, 2, low)
-                a0r, a1r = vv[0, :, 0, :], vv[0, :, 1, :]
-                a0i, a1i = vv[1, :, 0, :], vv[1, :, 1, :]
-                m00r, m00i = float(m[0, 0].real), float(m[0, 0].imag)
-                m01r, m01i = float(m[0, 1].real), float(m[0, 1].imag)
-                m10r, m10i = float(m[1, 0].real), float(m[1, 0].imag)
-                m11r, m11i = float(m[1, 1].real), float(m[1, 1].imag)
-                n0r = m00r * a0r - m00i * a0i + m01r * a1r - m01i * a1i
-                n0i = m00r * a0i + m00i * a0r + m01r * a1i + m01i * a1r
-                n1r = m10r * a0r - m10i * a0i + m11r * a1r - m11i * a1i
-                n1i = m10r * a0i + m10i * a0r + m11r * a1i + m11i * a1r
-                new = jnp.stack([
-                    jnp.stack([n0r, n1r], axis=1),
-                    jnp.stack([n0i, n1i], axis=1),
-                ]).reshape(2, BLOCK)
-                v = jnp.where(sel, new, v)
-        out_ref[...] = v
+        b = (blk >> h) & 1
+        mine = in_ref[...]
+        other = pa_ref[...]
+        # target-bit-0 / target-bit-1 operands of the 2x2, from my side
+        lo_r = jnp.where(b == 0, mine[0], other[0])
+        lo_i = jnp.where(b == 0, mine[1], other[1])
+        hi_r = jnp.where(b == 0, other[0], mine[0])
+        hi_i = jnp.where(b == 0, other[1], mine[1])
+        if kind == "gen":
+            # my row of the matrix: row b -> (m[b,0], m[b,1]);
+            # fv holds mtrx_planes flat: [re00,re01,re10,re11,im...]
+            m0r = jnp.where(b == 0, fv_ref[foff_x + 0, 0],
+                            fv_ref[foff_x + 2, 0])
+            m0i = jnp.where(b == 0, fv_ref[foff_x + 4, 0],
+                            fv_ref[foff_x + 6, 0])
+            m1r = jnp.where(b == 0, fv_ref[foff_x + 1, 0],
+                            fv_ref[foff_x + 3, 0])
+            m1i = jnp.where(b == 0, fv_ref[foff_x + 5, 0],
+                            fv_ref[foff_x + 7, 0])
+        else:  # inv rows: (0, tr) and (bl, 0); fv holds [tr.re,tr.im,bl...]
+            zero = jnp.zeros((), mine.dtype)
+            m0r = jnp.where(b == 0, zero, fv_ref[foff_x + 2, 0])
+            m0i = jnp.where(b == 0, zero, fv_ref[foff_x + 3, 0])
+            m1r = jnp.where(b == 0, fv_ref[foff_x + 0, 0], zero)
+            m1i = jnp.where(b == 0, fv_ref[foff_x + 1, 0], zero)
+        nr = m0r * lo_r - m0i * lo_i + m1r * hi_r - m1i * hi_i
+        nim = m0r * lo_i + m0i * lo_r + m1r * hi_i + m1i * hi_r
+        nv = jnp.stack([nr, nim])
+        if has_ctrl:
+            cm = iv_ref[ioff_x, 0]
+            cv = iv_ref[ioff_x + 1, 0]
+            lidx = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)[0]
+            sel = (((lidx & (cm & lbits)) == (cv & lbits))
+                   & ((blk & (cm >> bp)) == (cv >> bp)))
+            nv = jnp.where(sel, nv, mine)
+        out_ref[...] = in_tile_ops(nv, blk, iv_ref, fv_ref)
+
+    def run(planes, iv, fv):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((2, 1 << n), planes.dtype),
+            grid=(nblk,),
+            in_specs=[iv_spec, fv_spec, tile_spec,
+                      pl.BlockSpec((2, block), lambda i: (0, i ^ (1 << h)))],
+            out_specs=tile_spec,
+            interpret=interpret,
+        )(iv, fv, planes, planes)
+
+    return run
+
+
+def make_window_fn(n: int, structure: Tuple,
+                   block_pow: int = DEFAULT_BLOCK_POW,
+                   interpret: bool = False):
+    """The parametric window kernel: fn(planes, *operands) with the
+    dense fusion operand layout, lowering to ``fn.sweeps`` Pallas
+    sweeps (one per planned segment).  Trace it under jit exactly like
+    fusion.window_fn — fusion.kernel_window_program does, with the
+    shared structure-only cache key."""
+    bp = min(block_pow, n)
+    segments = plan_window(structure, bp)
+    slots, nf, ni = _operand_slots(structure)
+    programs = [_segment_program(n, bp, seg, slots, nf, max(ni, 1), interpret)
+                for seg in segments]
+
+    def fn(planes, *operands):
+        fv, iv = pack_operands(structure, operands, planes.dtype)
+        for run in programs:
+            planes = run(planes, iv, fv)
+        return planes
+
+    fn.sweeps = len(segments)
+    fn.block_pow = bp
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# baked-segment back-compat (QCircuit.compile_fn_pallas)
+# ---------------------------------------------------------------------------
+
+def make_segment_fn(ops: Sequence[Tuple], n: int,
+                    block_pow: int = DEFAULT_BLOCK_POW,
+                    interpret: bool = False):
+    """Back-compat shim for the old baked-constant segment API:
+    ``ops`` is a list of (kind, target, cmask, cval, m) tuples.  Now a
+    thin closure over the runtime-operand window kernel — matrices ride
+    the operand vector instead of being baked into the trace (one
+    compiled program per structure, not per angle), and cross-tile
+    targets plan into pair-mapped segments instead of raising
+    ValueError."""
+    from . import fusion as fu
+
+    fused = [fu.FusedOp(fu.classify(np.asarray(m), cmask, cval), target,
+                        cmask, cval, np.asarray(m))
+             for (kind, target, cmask, cval, m) in ops]
+    structure = fu.structure_of(fused)
+    wfn = make_window_fn(n, structure, block_pow=block_pow,
+                         interpret=interpret)
+    operands = fu.dense_operands(fused, jnp.float32)
 
     def fn(planes):
-        call = pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct((2, N), planes.dtype),
-            grid=(nblk,),
-            in_specs=[pl.BlockSpec((2, BLOCK), lambda i: (0, i))],
-            out_specs=pl.BlockSpec((2, BLOCK), lambda i: (0, i)),
-            interpret=interpret,
-        )
-        return call(planes)
+        return wfn(planes, *operands)
 
+    fn.sweeps = wfn.sweeps
     return fn

@@ -450,20 +450,44 @@ class QPager(QEngine):
         # targets included (the pair exchange runs inside the program)
         return True
 
-    def _p_fuse_window(self, structure, n_operands: int):
+    def _p_fuse_window(self, structure, n_operands: int, kernel_plan=None):
         from ..ops import fusion as fu
 
         L, mesh, npg = self.local_bits, self.mesh, self.n_pages
 
+        if kernel_plan is None:
+            def build():
+                body = fu.sharded_window_body(L, npg, structure)
+                return _tele.instrument_jit("fuse.window", jax.jit(
+                    _compat_shard_map(body, mesh=mesh,
+                                      in_specs=_state_specs(n_operands),
+                                      out_specs=P(None, "pages")),
+                    donate_argnums=(0,)))
+
+            return _program(self._key("fusewin", str(self.dtype), structure),
+                            build, site="tpu.fuse.flush")
+
+        interpret = kernel_plan["interpret"]
+        bp = kernel_plan["block_pow"]
+
         def build():
-            body = fu.sharded_window_body(L, npg, structure)
+            body = fu.sharded_kernel_window_body(L, npg, structure,
+                                                 block_pow=bp,
+                                                 interpret=interpret)
+            # pallas_call inside shard_map trips the replication checker
+            # on per-shard refs; the body is manifestly per-page, so the
+            # check is safely off for this one program (compat translates
+            # to check_rep on legacy jax)
             return _tele.instrument_jit("fuse.window", jax.jit(
                 _compat_shard_map(body, mesh=mesh,
                                   in_specs=_state_specs(n_operands),
-                                  out_specs=P(None, "pages")),
+                                  out_specs=P(None, "pages"),
+                                  check_vma=False),
                 donate_argnums=(0,)))
 
-        return _program(self._key("fusewin", str(self.dtype), structure),
+        return _program(self._key("fusewin-k",
+                                  "interp" if interpret else "mosaic", bp,
+                                  str(self.dtype), structure),
                         build, site="tpu.fuse.flush")
 
     def _fuse_flush(self, gates) -> int:
@@ -502,8 +526,15 @@ class QPager(QEngine):
             for kind, target, _ in structure:
                 if kind == "gen" and target >= L:
                     self._tele_exchange("global_2x2", nb)
-        prog = self._p_fuse_window(structure, len(operands))
+        plan, why = fu.sharded_kernel_lowering(L, structure)
+        prog = self._p_fuse_window(structure, len(operands),
+                                   kernel_plan=plan)
         self._state = prog(self._state, *operands)
+        if plan is not None:
+            fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"])
+        else:
+            fu.record_kernel_fallback(why)
+            fu.record_xla_flush(self._tele_name, len(ops))
         return 1
 
     def _k_apply_4x4(self, m4, q1, q2) -> None:

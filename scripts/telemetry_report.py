@@ -9,7 +9,12 @@ sum; spans merge).  Sections:
   * compile-cache traffic: hit/miss/eviction per cache, miss ratio
   * fusion: gate-window queue/flush/drop traffic per engine, sweeps
     saved vs gates queued (saved_ratio); mean flushed window length
-    rides the spans section (fuse.<engine>.window_len)
+    rides the spans section (fuse.<engine>.window_len); kernel lowering
+    rates ride the same section — fuse.kernel.hit_rate (kernel windows
+    over all multi-op windows), fuse.kernel.sweeps_per_window /
+    ops_per_sweep (HBM passes the kernel actually paid), and
+    fuse.kernel.fallback_rate with per-reason fuse.kernel.fallback.*
+    counters (docs/PERFORMANCE.md)
   * exchange traffic: pager/ICI event counts and bytes
   * serving: jobs admitted/shed/expired/completed, batch occupancy
     (batched jobs per dispatch), queue-depth / latency gauges
@@ -138,6 +143,23 @@ def report(snap: dict, top: int) -> dict:
         if gates:
             out["fusion"][f"fuse.{eng}.saved_ratio"] = round(
                 out["fusion"].get(f"fuse.{eng}.sweeps_saved", 0) / gates, 4)
+    # kernel lowering: how many multi-op windows took the Pallas kernel,
+    # the HBM sweeps each paid, and why the rest fell back to the chain
+    kw = out["fusion"].get("fuse.kernel.windows", 0)
+    xw = out["fusion"].get("fuse.xla.windows", 0)
+    if kw + xw:
+        out["fusion"]["fuse.kernel.hit_rate"] = round(kw / (kw + xw), 4)
+    if kw:
+        ks = out["fusion"].get("fuse.kernel.sweeps", 0)
+        out["fusion"]["fuse.kernel.sweeps_per_window"] = round(ks / kw, 3)
+        if ks:
+            out["fusion"]["fuse.kernel.ops_per_sweep"] = round(
+                out["fusion"].get("fuse.kernel.ops", 0) / ks, 3)
+    fallbacks = sum(v for k, v in out["fusion"].items()
+                    if k.startswith("fuse.kernel.fallback."))
+    if fallbacks + kw:
+        out["fusion"]["fuse.kernel.fallback_rate"] = round(
+            fallbacks / (fallbacks + kw), 4)
     dispatches = out["serve"].get("serve.batch.dispatches", 0)
     if dispatches:
         out["serve"]["batch_occupancy"] = round(

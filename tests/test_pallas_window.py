@@ -1,0 +1,335 @@
+"""Single-sweep Pallas window kernels (ops/pallas_kernels.py +
+ops/fusion.py kernel lowering): interpret-mode parity vs the CPU oracle
+across the fuser vocabulary on every stack, the fuzz soak with the
+kernel forced on, corruption detect-and-repair and exactly-once
+escalation THROUGH the kernel flush, the ``off`` byte-for-byte
+restoration of the PR 5 XLA path, the one-sweep telemetry contract,
+and the w20/block_pow=8 planner regression (cross-tile targets split
+into pair-grid segments instead of raising mid-plan).
+
+Off-TPU the kernel runs under the Pallas interpreter — correctness
+grade, not perf grade (docs/PERFORMANCE.md) — which is exactly what
+these tests exercise.
+"""
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU, create_quantum_interface
+from qrack_tpu import resilience as res
+from qrack_tpu import telemetry as tele
+from qrack_tpu.engines.tpu import QEngineTPU
+from qrack_tpu.ops import fusion as fu
+from qrack_tpu.ops import pallas_kernels as pk
+from qrack_tpu.resilience import faults
+from qrack_tpu.resilience import integrity as integ
+from qrack_tpu.utils.rng import QrackRandom
+
+from test_fuzz_api import _ops
+
+N = 6
+
+
+@pytest.fixture(autouse=True)
+def _clean_layers(monkeypatch):
+    monkeypatch.delenv("QRACK_TPU_FUSE_KERNEL", raising=False)
+    faults.clear()
+    res.reset_breaker()
+    res.configure(max_retries=2, backoff_s=0.0, timeout_s=0.0)
+    integ.reset()
+    yield
+    faults.clear()
+    res.reset_breaker()
+    res.configure()
+    res.disable()
+    integ.reset()
+    tele.disable()
+    tele.reset()
+
+
+def _fidelity(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real * np.vdot(b, b).real)
+
+
+# The whole fuser vocabulary in one stream: generic 2x2 (H/RY), invert
+# (X/CNOT), diag (RZ/T/S), cphase (CZ), with controls and targets both
+# low and HIGH — at n_pages=4 qubits 4/5 are page bits, so the pager
+# rows exercise page-folded payloads and the global ppermute path too.
+_VOCAB = [
+    ("H", (0,)), ("H", (5,)),
+    ("RZ", (0.3, 2)), ("T", (4,)), ("S", (1,)),
+    ("CZ", (1, 3)), ("CZ", (5, 0)),
+    ("CNOT", (0, 1)), ("CNOT", (5, 2)),
+    ("X", (3,)), ("RY", (0.7, 3)),
+    ("RZ", (1.1, 5)), ("CNOT", (2, 4)),
+]
+
+_STACKS = [
+    ("tpu", {}, 1 - 1e-6),
+    ("pager", {"n_pages": 4}, 1 - 1e-6),
+    ("turboquant", {"bits": 16, "chunk_qb": 3, "block_pow": 2}, 1 - 1e-5),
+]
+
+
+# ---------------------------------------------------------------------------
+# parity matrix: vocabulary stream, kernel ON, windows 1 and 16
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("window", [1, 16])
+@pytest.mark.parametrize("name,kw,floor", _STACKS,
+                         ids=[s[0] for s in _STACKS])
+def test_kernel_parity_matrix(name, kw, floor, window, monkeypatch):
+    monkeypatch.setenv("QRACK_TPU_FUSE_KERNEL", "on")
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", str(window))
+    tele.enable()
+    o = QEngineCPU(N, rng=QrackRandom(3), rand_global_phase=False)
+    s = create_quantum_interface(name, N, rng=QrackRandom(3),
+                                 rand_global_phase=False, **kw)
+    for op, args in _VOCAB:
+        getattr(o, op)(*args)
+        getattr(s, op)(*args)
+    assert _fidelity(s.GetQuantumState(), o.GetQuantumState()) > floor
+    if window == 16 and name in ("tpu", "pager"):
+        # the window really flushed through the kernel, not a fallback
+        c = tele.snapshot(include_events=False)["counters"]
+        assert c.get("fuse.kernel.windows", 0) >= 1, c
+
+
+# ---------------------------------------------------------------------------
+# fuzz soak: the fusion soak vocabulary with the kernel forced on
+# ---------------------------------------------------------------------------
+
+def _draw_op(rng):
+    # SetBit measures: cross-stack rng streams legitimately diverge on
+    # measuring ops (working notes), so the soak skips it.
+    while True:
+        name, args = _ops(rng)
+        if name != "SetBit":
+            return name, args
+
+
+_FUZZ_STACKS = [
+    ("tpu", {}, 1 - 1e-6, 3e-5),
+    ("pager", {"n_pages": 4}, 1 - 1e-6, 3e-5),
+    ("turboquant", {"bits": 16, "chunk_qb": 3, "block_pow": 2},
+     1 - 1e-5, 5e-4),                      # lossy int16 codes
+]
+
+
+@pytest.mark.parametrize("name,kw,floor,ptol",
+                         _FUZZ_STACKS, ids=[s[0] for s in _FUZZ_STACKS])
+@pytest.mark.parametrize("trial", range(2))
+def test_fuzz_vocabulary_kernel_on(name, kw, floor, ptol, trial,
+                                   monkeypatch):
+    monkeypatch.setenv("QRACK_TPU_FUSE_KERNEL", "on")
+    rng = np.random.Generator(np.random.PCG64(9100 + trial))
+    o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
+    s = create_quantum_interface(name, N, rng=QrackRandom(trial),
+                                 rand_global_phase=False, **kw)
+    for step in range(25):
+        op, args = _draw_op(rng)
+        getattr(o, op)(*args)
+        getattr(s, op)(*args)
+        if rng.integers(0, 8) == 0:        # mid-stream reads force flushes
+            qb = int(rng.integers(0, N))
+            assert abs(o.Prob(qb) - s.Prob(qb)) < ptol, (trial, step, op)
+    assert _fidelity(s.GetQuantumState(), o.GetQuantumState()) > floor, trial
+
+
+# ---------------------------------------------------------------------------
+# integrity: a one-shot amp-corrupt on the KERNEL flush is detected at
+# the flush verify and repaired by scoped window replay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("stack,kw", [("tpu", {}),
+                                      ("pager", {"n_pages": 4})],
+                         ids=["tpu", "pager"])
+def test_detect_and_repair_through_kernel_flush(stack, kw, monkeypatch):
+    monkeypatch.setenv("QRACK_TPU_FUSE_KERNEL", "on")
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "16")
+    tele.enable()
+    res.enable()
+    o = QEngineCPU(N, rng=QrackRandom(3), rand_global_phase=False)
+    s = create_quantum_interface(stack, N, rng=QrackRandom(3),
+                                 rand_global_phase=False, **kw)
+    faults.inject("tpu.fuse.flush", "amp-corrupt", after_n=0, times=1)
+    for name, args in _VOCAB:
+        getattr(o, name)(*args)
+        getattr(s, name)(*args)
+    _ = s.Prob(0)   # drain the fuser OUTSIDE suspension
+    c = tele.snapshot()["counters"]
+    assert sum(sp.fired for sp in faults.specs()) == 1
+    assert c.get("integrity.violation", 0) >= 1
+    assert c.get("integrity.replay.repaired", 0) >= 1
+    assert c.get("fuse.kernel.windows", 0) >= 1
+    with faults.suspended():
+        a = np.asarray(o.GetQuantumState())
+        b = np.asarray(s.GetQuantumState())
+    assert _fidelity(a, b) > 1 - 1e-6
+
+
+# ---------------------------------------------------------------------------
+# exactly-once under escalation: a persistently-failing kernel flush
+# escalates (CPU failover / pager shrink) without losing or
+# double-applying any queued gate
+# ---------------------------------------------------------------------------
+
+def test_failover_exactly_once_kernel_on(monkeypatch):
+    """The failover snapshot (taken under faults.suspended()) re-runs
+    the flush on the CPU engine — same contract as the XLA path."""
+    monkeypatch.setenv("QRACK_TPU_FUSE_KERNEL", "on")
+    res.enable()
+    q = create_quantum_interface("tpu", N, rng=QrackRandom(3),
+                                 rand_global_phase=False)
+    o = QEngineCPU(N, rng=QrackRandom(3), rand_global_phase=False)
+    for e in (q, o):
+        e.H(0)
+        e.CNOT(0, 1)
+        e.RZ(0.7, 2)
+        e.X(3)
+    faults.inject("tpu.fuse.flush", "raise", after_n=0, times=None)
+    p = q.Prob(1)                          # read flushes; the fault fires here
+    assert type(q.engine).__name__ == "QEngineCPU"
+    assert abs(p - o.Prob(1)) < 1e-6
+    assert _fidelity(q.GetQuantumState(), o.GetQuantumState()) > 1 - 1e-6
+
+
+def test_pager_shrink_midwindow_kernel_on(monkeypatch):
+    """A device flap mid-flight of a kernel-lowered pager window shrinks
+    the mesh, the job finishes degraded, and the final state matches the
+    oracle — the shrunk layout recompiles its own kernel programs."""
+    monkeypatch.setenv("QRACK_TPU_FUSE_KERNEL", "on")
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "16")
+    tele.enable()
+    res.enable()
+    q = create_quantum_interface("pager", N, n_pages=4, rng=QrackRandom(3),
+                                 rand_global_phase=False)
+    cut = len(_VOCAB) // 2
+    for name, args in _VOCAB[:cut]:
+        getattr(q, name)(*args)
+    faults.inject("*", "flap", after_n=0, times=1)
+    for name, args in _VOCAB[cut:]:
+        getattr(q, name)(*args)
+    q.GetAmplitude(0)   # read boundary: flush + failover
+    q.Prob(0)           # post-recovery boundary: the probe grows back
+    c = tele.snapshot()["counters"]
+    assert c.get("elastic.repage.shrink", 0) >= 1
+    assert type(q.engine).__name__ == "QPager"
+    with faults.suspended():
+        got = np.asarray(q.GetQuantumState())
+    o = QEngineCPU(N, rng=QrackRandom(3), rand_global_phase=False)
+    for name, args in _VOCAB:
+        getattr(o, name)(*args)
+    assert _fidelity(got, o.GetQuantumState()) > 1 - 1e-6
+
+
+# ---------------------------------------------------------------------------
+# the off-switch: QRACK_TPU_FUSE_KERNEL=off IS the PR 5 XLA window path
+# ---------------------------------------------------------------------------
+
+def test_kernel_off_is_pr5_xla_path_byte_for_byte(monkeypatch):
+    """``off`` and the auto-mode CPU fallback both dispatch the SAME
+    cached dense XLA window program — byte-identical states — and the
+    fallback reasons are distinguishable in telemetry."""
+    def run(mode):
+        if mode is None:
+            monkeypatch.delenv("QRACK_TPU_FUSE_KERNEL", raising=False)
+        else:
+            monkeypatch.setenv("QRACK_TPU_FUSE_KERNEL", mode)
+        tele.reset()
+        tele.enable()
+        eng = QEngineTPU(N, rng=QrackRandom(5), rand_global_phase=False)
+        for name, args in _VOCAB:
+            getattr(eng, name)(*args)
+        eng.Prob(0)
+        c = tele.snapshot(include_events=False)["counters"]
+        tele.disable()
+        return np.asarray(eng.GetQuantumState()), c
+
+    s_off, c_off = run("off")
+    s_auto, c_auto = run(None)             # auto on a CPU backend
+    assert np.array_equal(s_off, s_auto)   # byte-for-byte, not allclose
+    for c in (c_off, c_auto):
+        assert c.get("fuse.kernel.windows", 0) == 0
+        assert c.get("fuse.xla.windows", 0) >= 1
+    assert c_off.get("fuse.kernel.fallback.mode_off", 0) >= 1
+    assert c_auto.get("fuse.kernel.fallback.cpu_backend", 0) >= 1
+    # and the interpret kernel agrees numerically with that path
+    s_on, c_on = run("on")
+    assert c_on.get("fuse.kernel.windows", 0) >= 1
+    assert np.allclose(s_on, s_off, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# telemetry contract: a 16-gate diagonal window pays ONE HBM sweep
+# ---------------------------------------------------------------------------
+
+def test_sixteen_gate_window_records_one_sweep(monkeypatch):
+    monkeypatch.setenv("QRACK_TPU_FUSE_KERNEL", "on")
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "16")
+    tele.enable()
+    eng = QEngineTPU(N, rng=QrackRandom(8), rand_global_phase=False)
+    for q in range(N):                     # amplitude everywhere first
+        eng.H(q)
+    eng.Prob(0)                            # flush the H window out of the way
+    tele.reset()
+    tele.enable()
+    # a 16-gate CNOT ladder: each gate's control is the previous gate's
+    # target, so nothing commutes past anything and no merge fires —
+    # all in-tile inverts, ONE planned segment
+    for j in range(16):
+        t = j % N
+        eng.CNOT(t, (t + 1) % N)
+    eng.Prob(0)
+    c = tele.snapshot(include_events=False)["counters"]
+    assert c.get("fuse.kernel.windows", 0) == 1, c
+    assert c.get("fuse.kernel.ops", 0) == 16, c
+    assert c.get("fuse.kernel.sweeps", 0) == 1, c   # one HBM pass, 16 gates
+    # the XLA chain would have paid ~one sweep per op
+    assert c.get("fuse.xla.windows", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# planner regression: cross-tile non-diagonal targets SPLIT, never raise
+# ---------------------------------------------------------------------------
+
+def test_segment_compatible_is_a_predicate_not_a_raise():
+    assert pk.segment_compatible("cphase", 19, 8)
+    assert pk.segment_compatible("diag", 19, 8)
+    assert not pk.segment_compatible("gen", 10, 8)   # False, no ValueError
+    assert pk.segment_compatible("gen", 7, 8)
+
+
+def test_w20_qft_block_pow8_plans_and_builds():
+    """The PR 5 path raised ValueError mid-plan on any w20 circuit at
+    block_pow=8 (cross-tile H targets); the planner now leads each
+    cross-tile gen with its own pair-grid segment."""
+    from qrack_tpu.models.qft import qft_qcircuit
+
+    circ = qft_qcircuit(20)
+    fn = circ.compile_fn_pallas(20, block_pow=8, interpret=True)
+    ops = fu.lower_gates(circ.gates)
+    assert 1 <= fn.sweeps < len(ops)
+    # the plan covers every op exactly once, in order
+    structure = fu.structure_of(ops)
+    plan = pk.plan_window(structure, 8)
+    covered = [s[0] for seg in plan
+               for s in ([seg["xgen"]] if seg["xgen"] else []) + seg["ops"]]
+    assert covered == list(range(len(ops)))
+
+
+def test_w12_qft_block_pow8_numeric_parity():
+    import jax.numpy as jnp
+    from qrack_tpu.models.qft import basis_planes, qft_qcircuit
+
+    circ = qft_qcircuit(12)
+    ops = fu.lower_gates(circ.gates)
+    structure = fu.structure_of(ops)
+    operands = fu.dense_operands(ops, jnp.float32)
+    planes = jnp.asarray(basis_planes(12, 1234 & ((1 << 12) - 1)))
+    want = np.asarray(fu.window_fn(12, structure)(planes, *operands))
+    fn = circ.compile_fn_pallas(12, block_pow=8, interpret=True)
+    got = np.asarray(fn(jnp.asarray(basis_planes(12, 1234 & ((1 << 12) - 1)))))
+    assert fn.sweeps < len(ops)
+    assert float(np.max(np.abs(want - got))) < 3e-5
