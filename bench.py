@@ -28,6 +28,10 @@ Env knobs:
   QRACK_BENCH_BUDGET=780     total wall-clock budget (s)
   QRACK_BENCH_SWEEP=a:b      optional per-width sweep (inclusive)
   QRACK_BENCH_PLATFORM=cpu   pin platform + measure in-process
+  QRACK_BENCH_PAGER=1        MULTICHIP line: engine-path QFT over an
+                             n_pages mesh with exchange.pager.* evidence
+  QRACK_BENCH_PAGES=8        page count for the MULTICHIP line
+  QRACK_TPU_REMAP=auto|off   remap planner mode for the MULTICHIP A/B
 """
 
 import json
@@ -218,10 +222,81 @@ def _measure_unit_stack(width: int, samples: int):
     return _stats(times[1:])  # first sample excluded (interpreter warmup)
 
 
+def _measure_pager(width: int, samples: int):
+    """MULTICHIP line: the engine-path QFT through QPager over an
+    n_pages mesh (virtual host devices when pinned to cpu, real chips
+    otherwise), telemetry on, so the line carries per-width exchange
+    evidence: `exchange.pager.*` counts and bytes, remaps inserted, and
+    exchange bytes per gate.  The remap planner obeys QRACK_TPU_REMAP
+    (auto/off), which is how the parent's A/B children disagree."""
+    n_pages = int(os.environ.get("QRACK_BENCH_PAGES", "8"))
+    if os.environ.get("QRACK_BENCH_PLATFORM") == "cpu":
+        from qrack_tpu.utils.platform import pin_host_cpu
+
+        pin_host_cpu(n_pages)
+    import jax
+
+    from qrack_tpu import telemetry as tele
+    from qrack_tpu.parallel.pager import QPager
+    from qrack_tpu.utils.rng import QrackRandom
+
+    ndev = len(jax.devices())
+    n_pages = min(n_pages, 1 << (ndev.bit_length() - 1))
+    tele.enable()
+    times = []
+    snap0 = None
+    perm = 12345 & ((1 << width) - 1)
+    for s in range(samples + 1):
+        q = QPager(width, n_pages=n_pages, rng=QrackRandom(s),
+                   rand_global_phase=False)
+        q.SetPermutation(perm)
+        if s == 1:  # warmup run 0 (compiles) stays out of the deltas
+            snap0 = tele.snapshot(include_events=False)["counters"]
+        t0 = time.perf_counter()
+        q.QFT(0, width)
+        q.Finish()
+        _ = q.GetAmplitude(0)  # honest device->host read
+        times.append(time.perf_counter() - t0)
+    snap1 = tele.snapshot(include_events=False)["counters"]
+    delta = {k: snap1.get(k, 0) - (snap0 or {}).get(k, 0)
+             for k in set(snap1) | set(snap0 or {})
+             if k.startswith(("exchange.pager.", "remap.pager."))}
+    per_run = {k: v / samples for k, v in delta.items() if v}
+    st = _stats(times[1:])
+    st["platform"] = jax.default_backend()
+    st["sync"] = "devget"
+    st["n_pages"] = n_pages
+    st["remap_mode"] = os.environ.get("QRACK_TPU_REMAP", "auto")
+    st["exchange"] = {k: round(v, 1) for k, v in sorted(per_run.items())}
+    gates = width + width * (width - 1) // 2  # H ladder + cphases
+    st["exchange_bytes_per_gate"] = round(
+        per_run.get("exchange.pager.bytes", 0.0) / gates, 1)
+    # IQFT leg: ascending gen order is the planner's sweet case (every
+    # hot global pairs with a gen-done local, so no pay-back remaps) —
+    # this is where the >=2x exchange-bytes drop shows; counted
+    # separately so the headline QFT numbers stay clean
+    s0 = tele.snapshot(include_events=False)["counters"]
+    q = QPager(width, n_pages=n_pages, rng=QrackRandom(99),
+               rand_global_phase=False)
+    q.SetPermutation(perm)
+    q.IQFT(0, width)
+    q.Finish()
+    _ = q.GetAmplitude(0)
+    s1 = tele.snapshot(include_events=False)["counters"]
+    st["iqft_exchange"] = {
+        k: round(s1.get(k, 0) - s0.get(k, 0), 1)
+        for k in sorted(set(s1) | set(s0))
+        if k.startswith(("exchange.pager.", "remap.pager."))
+        and s1.get(k, 0) != s0.get(k, 0)}
+    return st
+
+
 def _measure(width: int, samples: int):
     """Compile + warm-run once (excluded), then time `samples` runs."""
     if WORKLOAD == "qft_unit":
         return _measure_unit_stack(width, samples)
+    if os.environ.get("QRACK_BENCH_PAGER"):
+        return _measure_pager(width, samples)
     import jax
 
     plat = os.environ.get("QRACK_BENCH_PLATFORM")
@@ -602,6 +677,24 @@ def main() -> None:
                 finally:
                     WORKLOAD = "qft"
 
+        # 1a') MULTICHIP exchange evidence: the engine-path QFT over an
+        #      8-virtual-device host mesh, remap planner auto vs off —
+        #      the A/B pair quotes `exchange.pager.*` counts/bytes and
+        #      remaps inserted per width (fail-soft like the kernel A/B:
+        #      a lost child leaves a *_timed_out line, never silence)
+        if WORKLOAD == "qft":
+            pg_width = min(WIDTH, 22)
+            for tag, env in (
+                    ("_multichip_remap_auto", {"QRACK_BENCH_PAGER": "1"}),
+                    ("_multichip_remap_off", {"QRACK_BENCH_PAGER": "1",
+                                              "QRACK_TPU_REMAP": "off"})):
+                st = _run_child(pg_width, min(SAMPLES, 3),
+                                min(150.0, _remaining() - 20),
+                                platform="cpu", extra_env=env)
+                if st:
+                    _emit(pg_width, st, label_suffix=tag)
+                    emitted = True
+
         # 1b) Committed on-chip evidence from an earlier healthy window
         #     (clearly labeled as a replay) — outranks the CPU fallback
         #     in the last-line-parsed slot only if no live line follows.
@@ -641,6 +734,7 @@ def main() -> None:
             tpu_alive = True
             if (WORKLOAD == "qft"
                     and not os.environ.get("QRACK_BENCH_QFT_FORM")
+                    and not os.environ.get("QRACK_BENCH_PAGER")
                     and _remaining() > 360):
                 kernel_ab_done = _kernel_ab(FIRST_WIDTH)
 
@@ -667,6 +761,7 @@ def main() -> None:
             tpu_alive = True
             if (not kernel_ab_done and WORKLOAD == "qft"
                     and not os.environ.get("QRACK_BENCH_QFT_FORM")
+                    and not os.environ.get("QRACK_BENCH_PAGER")
                     and _remaining() > 360):
                 kernel_ab_done = _kernel_ab(w)
         elif not tpu_alive:

@@ -116,6 +116,16 @@ class QCircuit:
 
     # ------------------------------------------------------------------
 
+    def _lookahead_entries(self) -> List[Tuple[str, int]]:
+        """(kind, target) stream for the remap planner's multi-window
+        lookahead (ops/fusion.py plan_remaps) — same iteration order as
+        :meth:`Run`'s dispatch loop, so the fuser's cursor tracks it."""
+        out: List[Tuple[str, int]] = []
+        for g in self.gates:
+            for _perm, m in g.payloads.items():
+                out.append(("diag" if mat.is_phase(m) else "gen", g.target))
+        return out
+
     def Run(self, qsim) -> None:
         """Execute on any QInterface (reference: src/qcircuit.cpp:173)."""
         if getattr(qsim, "_is_routed", False):
@@ -123,9 +133,21 @@ class QCircuit:
             # caller thread, then dispatch into the chosen stack (the
             # serve path splits these across threads — route/router.py)
             qsim = qsim.route_for(self)
-        for g in self.gates:
-            for perm, m in g.payloads.items():
-                qsim.MCMtrxPerm(g.controls, m, g.target, perm)
+        # prime the engine fuser's lookahead with the full gate list so
+        # the remap planner sees past the pending window; never clobber
+        # a horizon an outer driver (serve batch) already installed
+        fuser = getattr(qsim, "_fuser", None)
+        primed = False
+        if fuser is not None and fuser.lookahead is None:
+            fuser.set_lookahead(self._lookahead_entries())
+            primed = True
+        try:
+            for g in self.gates:
+                for perm, m in g.payloads.items():
+                    qsim.MCMtrxPerm(g.controls, m, g.target, perm)
+        finally:
+            if primed:
+                fuser.clear_lookahead()
 
     def _check_fused_range(self, n: int) -> None:
         # the per-gate path validates through _check_qubit; the fused
@@ -201,10 +223,10 @@ class QCircuit:
             ops = fu.lower_gates(self.gates)
             if not ops:
                 return
-            structure = fu.sharded_structure_of(ops)
-            operands = fu.sharded_operands(ops, qsim.local_bits, qsim.dtype)
-            prog = qsim._p_fuse_window(structure, len(operands))
-            qsim._state = prog(qsim._state, *operands)
+            # whole circuit in one horizon: the engine plans remaps over
+            # the entire op list and lowers remap + windows into one
+            # shard_map program (pager._run_fused_ops)
+            qsim._run_fused_ops(ops)
             return
         self.Run(qsim)
 

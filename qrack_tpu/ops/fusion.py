@@ -336,6 +336,101 @@ def record_kernel_fallback(reason: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# communication-minimizing qubit remapping (mpiQulacs discipline,
+# arXiv:2203.16044): the pager keeps a logical->physical placement table
+# and the planner below swaps hot globally-placed target qubits into the
+# local range before a window flushes, so runs of high-order gates
+# execute as local sweeps.  The swaps lower into the SAME shard_map
+# program as the window (apply_remap prologue), so a remapped span is
+# still one dispatch.
+# ---------------------------------------------------------------------------
+
+def remap_mode() -> str:
+    """``QRACK_TPU_REMAP``: auto (default — plan remaps on multi-page
+    pagers), on (alias of auto; reserved for future forced-eager
+    variants), off (identity table, PR 9 exchange behavior)."""
+    v = os.environ.get("QRACK_TPU_REMAP", "auto").strip().lower()
+    return v if v in ("auto", "on", "off") else "auto"
+
+
+#: exchange cost of one paged-target 2x2, in units of state nbytes
+#: (half a page out + half back, summed over pages)
+GEN_GLOBAL_COST = 1.0
+#: exchange cost of one remap transposition touching a page bit: one
+#: half-buffer (mixed) or half-the-pages whole-buffer (page-page)
+#: ppermute — half the traffic of a pair-exchange gate
+REMAP_PAIR_COST = 0.5
+
+
+def plan_remaps(ops: Sequence[FusedOp], L: int, qmap: Sequence[int],
+                lookahead=None):
+    """Score the pending window (+ multi-window lookahead) and pick
+    placement swaps that turn globally-placed gen targets into local
+    sweeps.  Returns ``(swaps, new_qmap)``: PHYSICAL transpositions for
+    the window prologue and the table after them.
+
+    Cost model (units of state nbytes): a gen/inv on a physical-global
+    target pays ~1.0 per hit (ppermute pair exchange); one remap
+    transposition pays ~0.5 once.  cphase/diag are collective-free at
+    any placement, so only non-diagonal hits score.  Greedy pairing:
+    hottest global logical qubit against coldest local one, firing while
+    hits[hot] > hits[cold] + 0.5 (the cold qubit inherits the global
+    slot, so its own future hits count against the move)."""
+    n = len(qmap)
+    if L >= n:
+        return (), list(qmap)
+    hits = [0.0] * n
+    for op in ops:
+        if op.kind in ("gen", "inv") and op.target < n:
+            hits[op.target] += 1.0
+    if lookahead:
+        for kind, target in lookahead:
+            if kind in ("gen", "inv") and 0 <= target < n:
+                hits[target] += 1.0
+    new_qmap = list(qmap)
+    swaps = []
+    while True:
+        glob = [(hits[q], -q) for q in range(n)
+                if new_qmap[q] >= L and hits[q] > 0]
+        loc = [(hits[q], q) for q in range(n) if new_qmap[q] < L]
+        if not glob or not loc:
+            break
+        gh, negg = max(glob)
+        vh, v = min(loc)
+        if gh <= vh + REMAP_PAIR_COST:
+            break
+        g = -negg
+        p_g, p_v = new_qmap[g], new_qmap[v]
+        swaps.append((p_v, p_g))
+        new_qmap[g], new_qmap[v] = p_v, p_g
+    return tuple(swaps), new_qmap
+
+
+def translate_ops(ops: Sequence[FusedOp], qmap: Sequence[int]):
+    """Rewrite ops from logical qubit indices to physical bit positions
+    under ``qmap``.  Fresh FusedOps — the caller's (possibly re-flushed)
+    window must keep its logical form for escalation replays."""
+    if all(q == p for q, p in enumerate(qmap)):
+        return list(ops)
+    out = []
+    for op in ops:
+        cmask = 0
+        cval = 0
+        m = op.cmask
+        q = 0
+        while m:
+            if m & 1:
+                p = qmap[q]
+                cmask |= 1 << p
+                if (op.cval >> q) & 1:
+                    cval |= 1 << p
+            m >>= 1
+            q += 1
+        out.append(FusedOp(op.kind, qmap[op.target], cmask, cval, op.m))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # sharded ('pages'-mesh) parametric window lowering — QPager wraps the
 # body in ONE shard_map program (parallel/pager.py _p_fuse_window), so a
 # flushed window costs one dispatch regardless of how many paged-target
@@ -351,17 +446,21 @@ def sharded_structure_of(ops: Sequence[FusedOp]) -> Tuple:
                   op.target, op.cmask != 0) for op in ops)
 
 
-def sharded_window_body(L: int, npg: int, structure: Tuple):
+def sharded_window_body(L: int, npg: int, structure: Tuple, remap=()):
     """Per-shard traced body fn(local, *operands) for one window.  Masks
     arrive pre-split host-side into (local, page) int32 halves — same
     exact-past-int32 discipline as the eager pager kernels: cphase takes
     2 combined-mask scalars, diag/gen take 4 split-mask scalars, and
-    uncontrolled ops take none (their masks stay static in the trace)."""
+    uncontrolled ops take none (their masks stay static in the trace).
+    ``remap`` is the planner's physical-transposition prologue — applied
+    before the ops, inside the same program."""
     from . import sharded as shb
 
     lbits = (1 << L) - 1
 
     def fn(local, *operands):
+        if remap:
+            local = shb.apply_remap(local, npg, L, remap)
         i = 0
         for kind, target, has_ctrl in structure:
             p = operands[i]
@@ -575,9 +674,10 @@ def sharded_kernel_lowering(L: int, structure: Tuple, backend: str = None):
 
 def sharded_kernel_window_body(L: int, npg: int, structure: Tuple,
                                block_pow: int = None,
-                               interpret: bool = False):
+                               interpret: bool = False, remap=()):
     """Per-shard traced body fn(local, *operands) — SAME sharded operand
-    layout as :func:`sharded_window_body`, kernel-lowered local runs."""
+    layout as :func:`sharded_window_body`, kernel-lowered local runs,
+    with the optional remap prologue ahead of the first segment."""
     from . import pallas_kernels as pk
     from . import sharded as shb
 
@@ -589,6 +689,8 @@ def sharded_kernel_window_body(L: int, npg: int, structure: Tuple,
             for seg in segments if seg[0] == "run"}
 
     def fn(local, *operands):
+        if remap:
+            local = shb.apply_remap(local, npg, L, remap)
         pid = shb.page_id()
         for seg in segments:
             if seg[0] == "global":
@@ -625,7 +727,8 @@ class GateStreamFuser:
     KEPT — the resilience retry/failover machinery re-reads state under
     faults.suspended(), which re-runs the flush."""
 
-    __slots__ = ("engine", "window", "gates", "_raw", "_flushing")
+    __slots__ = ("engine", "window", "gates", "_raw", "_flushing",
+                 "lookahead", "lookahead_pos")
 
     def __init__(self, engine, window: int):
         self.engine = engine
@@ -633,15 +736,42 @@ class GateStreamFuser:
         self.gates: List = []   # merged QCircuitGate window
         self._raw = 0           # gates queued since last flush (pre-merge)
         self._flushing = False
+        # multi-window lookahead for the remap planner: (kind, target)
+        # LOGICAL tuples for the gates a circuit/batch driver is about
+        # to stream, consumed one entry per queued gate.  Heuristic —
+        # identity-skipped gates drift the cursor, which only costs
+        # planning accuracy, never correctness.
+        self.lookahead = None
+        self.lookahead_pos = 0
 
     @property
     def pending(self) -> bool:
         return bool(self.gates)
 
+    def set_lookahead(self, entries) -> None:
+        self.lookahead = tuple(entries)
+        self.lookahead_pos = 0
+
+    def clear_lookahead(self) -> None:
+        self.lookahead = None
+        self.lookahead_pos = 0
+
+    def lookahead_rest(self):
+        """Entries beyond the pending window (the window itself is
+        scored from its lowered ops)."""
+        la = self.lookahead
+        if not la:
+            return None
+        return la[self.lookahead_pos:] or None
+
     def queue(self, controls, m, target: int, perm: int) -> bool:
         """Admit one gate into the window.  Returns False (after flushing
         any pending window, to preserve order) when the op cannot join —
         the caller then dispatches it eagerly."""
+        if self.lookahead is not None and self.lookahead_pos < len(self.lookahead):
+            # the gate is consumed from the driver's stream either way
+            # (fused or eager), so the cursor advances unconditionally
+            self.lookahead_pos += 1
         eng = self.engine
         if not eng._fuse_admit(m, target, controls):
             self.flush("ineligible")

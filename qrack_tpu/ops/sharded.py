@@ -189,6 +189,61 @@ def compose_ring(a_local, b, npg: int, L_in: int, start: int, n1: int, n2: int):
     return out
 
 
+def page_swap(local, npg: int, g1: int, g2: int):
+    """Swap two page bits: pure page permutation over ICI (reference
+    MetaSwap, src/qpager.cpp:1314).  Only pages whose g1/g2 bits differ
+    move; the rest map to themselves (ppermute requires a total map)."""
+    def permute(j):
+        b1 = (j >> g1) & 1
+        b2 = (j >> g2) & 1
+        return j if b1 == b2 else j ^ ((1 << g1) | (1 << g2))
+
+    perm = [(j, permute(j)) for j in range(npg)]
+    return jax.lax.ppermute(local, "pages", perm)
+
+
+def mixed_swap(local, npg: int, L: int, lpos: int, gpos: int):
+    """Swap one in-page bit against one page bit: half-buffer exchange.
+
+    Each page keeps the half of its slab whose l-bit equals its own
+    g-bit (those amplitudes don't move) and ships the other half to its
+    bit-flipped partner — whose shipped half is exactly the slab this
+    page needs.  One ppermute, half a page per payload: the same traffic
+    bound as a paged-target 2x2, but a pure relabeling (no arithmetic)."""
+    pid = page_id()
+    b = (pid >> gpos) & 1
+    lo = 1 << lpos
+    hi = local.shape[-1] // (2 * lo)
+    arr = local.reshape(local.shape[0], hi, 2, lo)
+    a0 = arr[:, :, 0, :]
+    a1 = arr[:, :, 1, :]
+    keep = jnp.where(b == 0, a0, a1)   # l-bit == own g-bit: stays
+    away = jnp.where(b == 0, a1, a0)   # l-bit != g-bit: belongs to partner
+    perm = [(j, j ^ (1 << gpos)) for j in range(npg)]
+    got = jax.lax.ppermute(away, "pages", perm)
+    s0 = jnp.where(b == 0, keep, got)
+    s1 = jnp.where(b == 0, got, keep)
+    return jnp.stack([s0, s1], axis=2).reshape(local.shape)
+
+
+def apply_remap(local, npg: int, L: int, swaps):
+    """Batched placement change: apply a sequence of PHYSICAL bit-position
+    transpositions (p1, p2), each local-local (free axis shuffle),
+    page-page (MetaSwap ppermute) or mixed (half-buffer exchange).  The
+    planner (ops/fusion.py plan_remaps) emits these as the prologue of a
+    fused window program, so remap + window is ONE dispatch."""
+    for p1, p2 in swaps:
+        if p1 > p2:
+            p1, p2 = p2, p1
+        if p2 < L:
+            local = gk.swap_bits(local, L, p1, p2)
+        elif p1 >= L:
+            local = page_swap(local, npg, p1 - L, p2 - L)
+        else:
+            local = mixed_swap(local, npg, L, p1, p2 - L)
+    return local
+
+
 def split_masks(mask: int, val: int, local_bits: int):
     lmask = mask & ((1 << local_bits) - 1)
     lval = val & ((1 << local_bits) - 1)

@@ -130,7 +130,8 @@ class QPager(QEngine):
     _fuse_capable = True  # gate stream fuses into sharded window programs
 
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
-                 n_pages: Optional[int] = None, dtype=None, **kwargs):
+                 n_pages: Optional[int] = None, dtype=None,
+                 remap: Optional[str] = None, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
         if dtype is None:
             # FPPOW policy (config.py device_real_dtype; enables x64
@@ -175,6 +176,10 @@ class QPager(QEngine):
 
         self._fuser = _fusion.make_fuser(self)
         self._state_raw = None
+        # per-instance remap-planner override (None = QRACK_TPU_REMAP):
+        # soaks/tests arm the placement table without touching process env
+        self._remap = remap
+        self._map_reset()
         self.SetPermutation(init_state)
 
     # ------------------------------------------------------------------
@@ -210,6 +215,129 @@ class QPager(QEngine):
         if f is not None and f.gates and not f._flushing:
             f.drop("overwritten")
         self._state_raw = local
+
+    # ------------------------------------------------------------------
+    # logical->physical placement table (mpiQulacs-style qubit remapping,
+    # arXiv:2203.16044).  ``_qmap[l]`` is the ket bit position holding
+    # logical qubit ``l``; ``_qinv`` is the inverse.  The remap planner
+    # (ops/fusion.py plan_remaps) swaps hot globally-placed qubits into
+    # the local range ahead of a fused window; every host-visible read/
+    # write translates through the table (docs/PERFORMANCE.md).
+    # ------------------------------------------------------------------
+
+    def _map_reset(self, n: Optional[int] = None) -> None:
+        n = self.qubit_count if n is None else n
+        self._qmap = list(range(n))
+        self._qinv = list(range(n))
+
+    def _map_assign(self, qmap) -> None:
+        self._qmap = list(qmap)
+        inv = [0] * len(self._qmap)
+        for q, p in enumerate(self._qmap):
+            inv[p] = q
+        self._qinv = inv
+
+    def _map_nonid(self) -> bool:
+        return any(q != p for q, p in enumerate(self._qmap))
+
+    def _map_index(self, idx: int) -> int:
+        """Logical basis index -> physical basis index (exact at any
+        width: pure Python ints)."""
+        out = 0
+        q = 0
+        while idx:
+            if idx & 1:
+                out |= 1 << self._qmap[q]
+            idx >>= 1
+            q += 1
+        return out
+
+    def _unmap_index(self, idx: int) -> int:
+        out = 0
+        p = 0
+        while idx:
+            if idx & 1:
+                out |= 1 << self._qinv[p]
+            idx >>= 1
+            p += 1
+        return out
+
+    def _map_mask(self, mask: int, val: int):
+        """Translate a (mask, val) control/selection pair bitwise."""
+        pm = pv = 0
+        q = 0
+        while mask:
+            if mask & 1:
+                p = self._qmap[q]
+                pm |= 1 << p
+                if (val >> q) & 1:
+                    pv |= 1 << p
+            mask >>= 1
+            q += 1
+        return pm, pv
+
+    def _remap_active(self) -> bool:
+        from ..ops import fusion as fu
+
+        mode = self._remap if self._remap is not None else fu.remap_mode()
+        return mode != "off" and self.n_pages > 1
+
+    def _p_remap(self, swaps):
+        """One program applying a batch of physical transpositions —
+        local axis shuffles, MetaSwap page permutations and mixed
+        half-buffer exchanges, all inside one shard_map dispatch."""
+        from ..ops import sharded as shb
+
+        L, mesh, npg = self.local_bits, self.mesh, self.n_pages
+
+        def build():
+            def f(local):
+                return shb.apply_remap(local, npg, L, swaps)
+
+            return jax.jit(_compat_shard_map(
+                f, mesh=mesh, in_specs=P(None, "pages"),
+                out_specs=P(None, "pages")), donate_argnums=(0,))
+
+        return _program(self._key("remap", swaps), build,
+                        site="pager.exchange")
+
+    def _tele_remap(self, swaps) -> None:
+        """Count placement-transposition traffic: local-local pairs are
+        free axis shuffles; any pair touching a page bit ships half the
+        state once (exchange.pager.remap — half a global_2x2's cost)."""
+        if not (_tele._ENABLED and swaps):
+            return
+        L = self.local_bits
+        nb = self._state_raw.nbytes
+        _tele.inc("remap.pager.pairs", len(swaps))
+        for p1, p2 in swaps:
+            if max(p1, p2) >= L:
+                self._tele_exchange("remap", nb / 2)
+
+    def _unmap(self) -> None:
+        """Physically restore logical bit order (identity table) in one
+        remap dispatch — selection-sort cycle decomposition, <= n-1
+        transpositions.  Structural reshapes and split-index kernels
+        assume logical==physical and call this first."""
+        self._settle()
+        if not self._map_nonid():
+            return
+        qmap = list(self._qmap)
+        qinv = list(self._qinv)
+        swaps = []
+        for l in range(len(qmap)):
+            p = qmap[l]
+            if p == l:
+                continue
+            o = qinv[l]
+            swaps.append((l, p))
+            qmap[l], qmap[o] = l, p
+            qinv[l], qinv[p] = l, o
+        if _tele._ENABLED:
+            _tele.inc("remap.pager.unmap")
+        self._tele_remap(tuple(swaps))
+        self._state = self._p_remap(tuple(swaps))(self._state)
+        self._map_reset()
 
     @property
     def local_bits(self) -> int:
@@ -417,7 +545,15 @@ class QPager(QEngine):
     # ------------------------------------------------------------------
 
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:
+        self._settle()
         cmask, cval = self._cmask_cval(controls, perm)
+        if self._map_nonid():
+            cmask, cval = self._map_mask(cmask, cval)
+            target = self._qmap[target]
+        self._apply_2x2_phys(m2, target, cmask, cval)
+
+    def _apply_2x2_phys(self, m2, target, cmask, cval) -> None:
+        """2x2 on PHYSICAL bit positions — placement already applied."""
         lmask, lval, gmask, gval = _split_masks(cmask, cval, self.local_bits)
         mp = gk.mtrx_planes(m2, self.dtype)
         if target < self.local_bits:
@@ -430,7 +566,11 @@ class QPager(QEngine):
             self._state = self._p_global_2x2(gpos)(self._state, mp, lmask, lval, gmask, gval)
 
     def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
+        self._settle()
         cmask, cval = self._cmask_cval(controls, perm)
+        if self._map_nonid():
+            cmask, cval = self._map_mask(cmask, cval)
+            target = self._qmap[target]
         lmask, lval, gmask, gval = _split_masks(cmask, cval, self.local_bits)
         tmask = 1 << target
         tlo = tmask & ((1 << self.local_bits) - 1)
@@ -450,21 +590,23 @@ class QPager(QEngine):
         # targets included (the pair exchange runs inside the program)
         return True
 
-    def _p_fuse_window(self, structure, n_operands: int, kernel_plan=None):
+    def _p_fuse_window(self, structure, n_operands: int, kernel_plan=None,
+                       remap=()):
         from ..ops import fusion as fu
 
         L, mesh, npg = self.local_bits, self.mesh, self.n_pages
 
         if kernel_plan is None:
             def build():
-                body = fu.sharded_window_body(L, npg, structure)
+                body = fu.sharded_window_body(L, npg, structure, remap=remap)
                 return _tele.instrument_jit("fuse.window", jax.jit(
                     _compat_shard_map(body, mesh=mesh,
                                       in_specs=_state_specs(n_operands),
                                       out_specs=P(None, "pages")),
                     donate_argnums=(0,)))
 
-            return _program(self._key("fusewin", str(self.dtype), structure),
+            return _program(self._key("fusewin", str(self.dtype), structure,
+                                      remap),
                             build, site="tpu.fuse.flush")
 
         interpret = kernel_plan["interpret"]
@@ -473,7 +615,8 @@ class QPager(QEngine):
         def build():
             body = fu.sharded_kernel_window_body(L, npg, structure,
                                                  block_pow=bp,
-                                                 interpret=interpret)
+                                                 interpret=interpret,
+                                                 remap=remap)
             # pallas_call inside shard_map trips the replication checker
             # on per-shard refs; the body is manifestly per-page, so the
             # check is safely off for this one program (compat translates
@@ -487,18 +630,46 @@ class QPager(QEngine):
 
         return _program(self._key("fusewin-k",
                                   "interp" if interpret else "mosaic", bp,
-                                  str(self.dtype), structure),
+                                  str(self.dtype), structure, remap),
                         build, site="tpu.fuse.flush")
 
     def _fuse_flush(self, gates) -> int:
         from ..ops import fusion as fu
 
         ops = fu.lower_gates(gates)
+        la = self._fuser.lookahead_rest() if self._fuser is not None else None
+        return self._dispatch_ops(ops, lookahead=la)
+
+    def _run_fused_ops(self, ops) -> None:
+        """RunFused entry (layers/qcircuit.py): dispatch a whole lowered
+        circuit as one sharded window program, remap planning included —
+        the full gate list IS the planning horizon here."""
+        if not ops:
+            return
+        self._settle()
+        self._dispatch_ops(ops)
+
+    def _dispatch_ops(self, ops, lookahead=None) -> int:
+        """Lower + dispatch one window of LOGICAL ops: plan placement
+        swaps against the window + lookahead, translate ops onto the
+        post-remap table, run remap prologue + window as ONE shard_map
+        program, and commit the table only after the dispatch returns —
+        shrink-retry and exception paths replan from the unchanged
+        table (the kept window stays logical)."""
+        from ..ops import fusion as fu
+
         L = self.local_bits
-        if len(ops) == 1:
-            # merged down to one op: the shared eager programs already
-            # exist and are cheaper than a fresh one-op window structure
-            op = ops[0]
+        swaps = ()
+        new_qmap = self._qmap
+        if self._remap_active():
+            swaps, new_qmap = fu.plan_remaps(ops, L, self._qmap, lookahead)
+        tops = (fu.translate_ops(ops, new_qmap)
+                if (swaps or self._map_nonid()) else ops)
+        if len(tops) == 1 and not swaps:
+            # merged down to one op on the current placement: the shared
+            # eager programs already exist and are cheaper than a fresh
+            # one-op window structure
+            op = tops[0]
             m = np.asarray(op.m)
             lmask, lval, gmask, gval = _split_masks(op.cmask, op.cval, L)
             if op.kind in ("cphase", "diag"):
@@ -519,17 +690,21 @@ class QPager(QEngine):
                     self._state = self._p_global_2x2(op.target - L)(
                         self._state, mp, lmask, lval, gmask, gval)
             return 1
-        structure = fu.sharded_structure_of(ops)
-        operands = fu.sharded_operands(ops, L, self.dtype)
+        structure = fu.sharded_structure_of(tops)
+        operands = fu.sharded_operands(tops, L, self.dtype)
         if _tele._ENABLED:
             nb = self._state.nbytes
             for kind, target, _ in structure:
                 if kind == "gen" and target >= L:
                     self._tele_exchange("global_2x2", nb)
+            if swaps:
+                _tele.inc("remap.pager.windows")
+            self._tele_remap(swaps)
         plan, why = fu.sharded_kernel_lowering(L, structure)
         prog = self._p_fuse_window(structure, len(operands),
-                                   kernel_plan=plan)
+                                   kernel_plan=plan, remap=swaps)
         self._state = prog(self._state, *operands)
+        self._map_assign(new_qmap)
         if plan is not None:
             fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"])
         else:
@@ -544,24 +719,30 @@ class QPager(QEngine):
         apply_small_unitary_via_primitive(self, np.asarray(m4, dtype=np.complex128), (q1, q2))
 
     def _k_swap_bits(self, q1, q2) -> None:
+        self._settle()
         L = self.local_bits
-        if q1 > q2:
-            q1, q2 = q2, q1
-        if q2 < L:
-            self._state = self._p_local_swap(q1, q2)(self._state)
-        elif q1 >= L:
+        # a Swap is a pure basis relabeling: applying the PHYSICAL
+        # transposition of the two qubits' current positions implements
+        # it exactly, at any table state
+        p1, p2 = self._qmap[q1], self._qmap[q2]
+        if p1 > p2:
+            p1, p2 = p2, p1
+        if p2 < L:
+            self._state = self._p_local_swap(p1, p2)(self._state)
+        elif p1 >= L:
             if _tele._ENABLED:
                 # page-pointer permutation: the half of the pages whose
                 # g1/g2 bits differ ship their whole local buffer
                 self._tele_exchange("meta_swap", self._state.nbytes / 2)
-            self._state = self._p_meta_swap(q1 - L, q2 - L)(self._state)
+            self._state = self._p_meta_swap(p1 - L, p2 - L)(self._state)
         else:
-            # mixed local/global: 3 controlled inverts through the
-            # pair-exchange path (reference falls back to gate synthesis)
-            x2 = mat.X2
-            self._k_apply_2x2(x2, q2, (q1,), 1)
-            self._k_apply_2x2(x2, q1, (q2,), 1)
-            self._k_apply_2x2(x2, q2, (q1,), 1)
+            # mixed local/global: ONE half-buffer placement transposition
+            # (was 3 controlled inverts through the pair-exchange path —
+            # 3 full-state exchanges vs half of one)
+            if _tele._ENABLED:
+                _tele.inc("remap.pager.swap")
+                self._tele_exchange("remap", self._state.nbytes / 2)
+            self._state = self._p_remap(((p1, p2),))(self._state)
 
     def _global_iota(self):
         """Sharded full-width index vector (int32-safe only to 31 qubits)."""
@@ -586,7 +767,9 @@ class QPager(QEngine):
         return _program(self._key("phaseapply"), build)
 
     def _k_phase_fn(self, fn, split=None) -> None:
-        self._settle()
+        # split-index diagonals compute factors from the LOGICAL basis
+        # index — restore identity placement first
+        self._unmap()
         if split is not None and self._wide_alu:
             self._phase_fn_wide(split)
             return
@@ -641,7 +824,8 @@ class QPager(QEngine):
         return self.force_wide_alu or self.qubit_count > 31
 
     def _k_gather(self, src_fn, split=None) -> None:
-        self._settle()
+        # basis permutations are written against logical bit order
+        self._unmap()
         if not self._wide_alu:
             src = src_fn(self._global_iota())
             self._state = self._p_gather()(self._state, src)
@@ -704,7 +888,7 @@ class QPager(QEngine):
             # forms (MUL/DIV/*ModNOut included); reaching this kernel
             # wide means a new op needs its own split form
             raise NotImplementedError("see the `split=` gather forms")
-        self._settle()
+        self._unmap()
         src_idx = jnp.asarray(src_idx, dtype=gk.IDX_DTYPE)
         dst_idx = jnp.asarray(dst_idx, dtype=gk.IDX_DTYPE)
         if passthrough_cmask is not None:
@@ -714,25 +898,34 @@ class QPager(QEngine):
             self._state = self._p_out_of_place(False)(self._state, src_idx, dst_idx)
 
     def _k_probs(self) -> np.ndarray:
-        if not self._state.is_fully_addressable:
+        self._settle()
+        if self._map_nonid() or not self._state.is_fully_addressable:
+            # _fetch returns the LOGICAL view (host-side unpermute)
             planes = self._fetch(0, 1 << self.qubit_count)
             return planes[0] ** 2 + planes[1] ** 2
         return np.asarray(jax.jit(gk.probs)(self._state), dtype=np.float64)
 
     def _k_prob_mask(self, mask, perm) -> float:
         self._settle()
+        if self._map_nonid():
+            # collective-free under any placement: the mask translates
+            mask, perm = self._map_mask(mask, perm)
         lmask, lval, gmask, gval = _split_masks(mask, perm, self.local_bits)
         p = float(_host_read(self._p_prob_mask()(self._state, lmask, lval, gmask, gval)))
         return min(max(p, 0.0), 1.0)
 
     def _k_collapse(self, mask, val, nrm_sq) -> None:
         self._settle()
+        if self._map_nonid():
+            mask, val = self._map_mask(mask, val)
         lmask, lval, gmask, gval = _split_masks(mask, val, self.local_bits)
         self._state = self._p_collapse()(self._state, lmask, lval, gmask, gval, nrm_sq)
 
     def MAll(self) -> int:
         """Two-stage sample: page marginals (psum over mesh), then an
-        in-page draw — only one page ever reaches the host."""
+        in-page draw — only one page ever reaches the host.  The draw
+        runs in PHYSICAL order (the marginals are physical) and the
+        result translates back through the table."""
         self._settle()
         pp = self._p_page_probs()(self._state)
         if not pp.is_fully_addressable:
@@ -742,10 +935,10 @@ class QPager(QEngine):
         page_probs = np.asarray(pp, dtype=np.float64)
         page = int(self.rng.choice_from_probs(page_probs, 1)[0])
         L = self.local_bits
-        local = self._fetch(page << L, 1 << L)
+        local = self._fetch(page << L, 1 << L, raw=True)
         p_local = local[0] ** 2 + local[1] ** 2
         sub = int(self.rng.choice_from_probs(p_local, 1)[0])
-        result = (page << L) | sub
+        result = self._unmap_index((page << L) | sub)
         self.SetPermutation(result)
         return result
 
@@ -753,7 +946,9 @@ class QPager(QEngine):
         self._state = jax.jit(gk.normalize, donate_argnums=(0,))(self._state, nrm_sq)
 
     def _k_sum_sqr_diff(self, other) -> float:
+        self._unmap()
         if isinstance(other, QPager) and other.n_pages == self.n_pages:
+            other._unmap()
             b = other._state
         else:
             b = jax.device_put(gk.to_planes(other.GetQuantumState(), self.dtype), self.sharding)
@@ -813,18 +1008,23 @@ class QPager(QEngine):
                         site="pager.exchange")
 
     def _k_compose(self, other, start) -> None:
+        self._settle()
         n1, n2 = self.qubit_count, other.qubit_count
         if self._mesh_would_change(n1 + n2):
             # ket was below the page count (tiny): host-stage the regrow
+            # (_fetch returns the logical view under any placement)
             a = self._fetch(0, 1 << n1)
             a = a[0] + 1j * a[1]
             b = np.asarray(other.GetQuantumState())
             full = gk.compose(gk.to_planes(a, self.dtype),
                               gk.to_planes(b, self.dtype), n1, n2, start)
             self._state = jax.device_put(full, self._sharding_for(n1 + n2))
+            self._map_reset(n1 + n2)
             return
+        self._unmap()  # the outer-product reshape assumes logical order
         if (isinstance(other, QPager)
                 and list(other.mesh.devices.flat) == list(self.mesh.devices.flat)):
+            other._unmap()
             b = other._state  # device-to-device: same device set
         else:
             b = gk.to_planes(np.asarray(other.GetQuantumState()), self.dtype)
@@ -846,6 +1046,7 @@ class QPager(QEngine):
             new_state = self._p_compose(n1, n2, start)(self._state, b)
         self._sharding_for(n1 + n2)
         self._state = new_state
+        self._map_reset(n1 + n2)
 
     def _p_decompose(self, n, start, length, with_dest: bool):
         dtype = self.dtype
@@ -905,11 +1106,15 @@ class QPager(QEngine):
         return dest
 
     def _k_decompose(self, start, length) -> np.ndarray:
+        self._unmap()  # the span reshape assumes logical order
         n = self.qubit_count
         if self._mesh_would_change(n - length):
-            return self._host_split(start, length, None)
+            dest = self._host_split(start, length, None)
+            self._map_reset(n - length)
+            return dest
         rem, dest = self._p_decompose(n, start, length, True)(self._state)
         self._state = rem
+        self._map_reset(n - length)
         d = np.asarray(_host_read(dest), dtype=np.float64)
         vec = d[0] + 1j * d[1]
         nrm = np.linalg.norm(vec)
@@ -936,14 +1141,17 @@ class QPager(QEngine):
         return _program(self._key("disposeperm", n, start, length), build)
 
     def _k_dispose(self, start, length, perm) -> None:
+        self._unmap()
         n = self.qubit_count
         if self._mesh_would_change(n - length):
             self._host_split(start, length, perm)
+            self._map_reset(n - length)
             return
         if perm is not None:
             self._state = self._p_dispose_perm(n, start, length)(self._state, perm)
         else:
             self._state = self._p_decompose(n, start, length, False)(self._state)
+        self._map_reset(n - length)
 
     def _p_allocate(self, n, start, length):
         dtype = self.dtype
@@ -963,10 +1171,12 @@ class QPager(QEngine):
         return _program(self._key("allocate", n, start, length), build)
 
     def _k_allocate(self, start, length) -> None:
+        self._unmap()
         n = self.qubit_count
         new_state = self._p_allocate(n, start, length)(self._state)
         self._sharding_for(n + length)
         self._state = new_state
+        self._map_reset(n + length)
 
     def _device_pool(self):
         """Device preference order for (re-)paging: the construction
@@ -1052,6 +1262,9 @@ class QPager(QEngine):
             self.mesh = mesh
             self.sharding = sharding
             self._state = planes
+            # `state` is a LOGICAL-order ket (failover snapshots read
+            # through GetQuantumState), so the placement table resets
+            self._map_reset()
         else:
             self._repage(new_g)
         self._max_g = new_g
@@ -1083,6 +1296,9 @@ class QPager(QEngine):
         self.mesh = mesh
         self.sharding = sharding
         self._state = new_state
+        # the gathered planes were the LOGICAL view (_fetch unpermutes),
+        # so the re-paged ket starts from an identity table
+        self._map_reset()
 
     def expand_pages(self) -> bool:
         """Grow back toward the construction page count.  True on
@@ -1245,13 +1461,39 @@ class QPager(QEngine):
     # state access
     # ------------------------------------------------------------------
 
-    def _fetch(self, offset: int, length: int) -> np.ndarray:
-        """(2, length) host-side planes window, float64.
+    def _host_unpermute(self, planes: np.ndarray) -> np.ndarray:
+        """Reorder a full-ket host window from physical to logical bit
+        order — a pure axis transpose, zero exchange bytes (the table
+        pays nothing on full-ket reads)."""
+        n = self.qubit_count
+        a = np.asarray(planes).reshape((2,) + (2,) * n)
+        axes = [0] * (n + 1)
+        for l in range(n):
+            # index bit b lives on axis (n - b); logical bit l reads
+            # from physical bit _qmap[l]
+            axes[n - l] = n - self._qmap[l]
+        return np.ascontiguousarray(np.transpose(a, axes)).reshape(2, -1)
+
+    def _fetch(self, offset: int, length: int, raw: bool = False) -> np.ndarray:
+        """(2, length) host-side planes window, float64, in LOGICAL bit
+        order (``raw=True`` reads the physical layout as stored — MAll's
+        page draw and checkpoint capture want exactly that).
+
+        Under a non-identity placement table a full-ket read unpermutes
+        host-side (free), a single amplitude translates its index, and
+        any other window physically restores logical order first.
 
         Multi-host safe: when this process cannot address every shard
         (a mesh spanning jax.distributed processes), the window is
         replicated through a collective program first — the only legal
         read pattern on such meshes (see parallel/cluster.py)."""
+        self._settle()
+        if not raw and self._map_nonid():
+            if offset == 0 and length == (1 << self.qubit_count):
+                return self._host_unpermute(self._fetch(0, length, raw=True))
+            if length == 1:
+                return self._fetch(self._map_index(offset), 1, raw=True)
+            self._unmap()
         if _tele._ENABLED:
             itemsize = jnp.dtype(self.dtype).itemsize
             _tele.inc("exchange.pager.host_fetch")
@@ -1289,6 +1531,7 @@ class QPager(QEngine):
         if st.shape[0] != (1 << self.qubit_count):
             raise ValueError("state length mismatch")
         self._state = jax.device_put(gk.to_planes(st, self.dtype), self.sharding)
+        self._map_reset()
 
     def GetAmplitude(self, perm: int) -> complex:
         amp = self._fetch(perm, 1)
@@ -1297,6 +1540,7 @@ class QPager(QEngine):
     def SetAmplitude(self, perm: int, amp: complex) -> None:
         amp = complex(amp)
         self._settle()
+        perm = self._map_index(perm) if self._map_nonid() else perm
 
         sh = self.sharding
 
@@ -1319,6 +1563,7 @@ class QPager(QEngine):
 
         prog = _program(self._key("setperm", n), build)
         self._state = prog(perm, jnp.asarray([ph.real, ph.imag], dtype=self.dtype))
+        self._map_reset()
         self.running_norm = 1.0
 
     def Clone(self) -> "QPager":
@@ -1326,16 +1571,19 @@ class QPager(QEngine):
         c = QPager(
             self.qubit_count, n_pages=self.n_pages,
             devices=list(self.mesh.devices.flat), dtype=self.dtype,
+            remap=self._remap,
             rng=self.rng.spawn(), do_normalize=self.do_normalize,
             rand_global_phase=self.rand_global_phase,
         )
         c._state = jax.jit(jnp.copy)(self._state)
+        c._map_assign(self._qmap)  # physical copy carries the placement
         return c
 
     def CloneEmpty(self) -> "QPager":
         return QPager(
             self.qubit_count, n_pages=self.n_pages,
             devices=list(self.mesh.devices.flat), dtype=self.dtype,
+            remap=self._remap,
             rng=self.rng.spawn(), do_normalize=self.do_normalize,
             rand_global_phase=self.rand_global_phase,
         )
@@ -1353,6 +1601,7 @@ class QPager(QEngine):
         self._state = jax.device_put(
             jnp.zeros_like(self._state), self.sharding
         )
+        self._map_reset()
 
     def IsZeroAmplitude(self) -> bool:
         self._settle()
@@ -1368,7 +1617,7 @@ class QPager(QEngine):
         return planes[0] + 1j * planes[1]
 
     def SetAmplitudePage(self, page, offset: int) -> None:
-        self._settle()
+        self._unmap()  # the window writes at logical offsets
         sh = self.sharding
 
         def build():
@@ -1391,13 +1640,19 @@ class QPager(QEngine):
     _ckpt_kind = "pager"
 
     def _ckpt_capture(self, capture_child):
+        self._settle()
         L = self.local_bits
-        arrays = {f"page_{p}": self.GetAmplitudePage(p << L, 1 << L)
-                  for p in range(self.n_pages)}
+        arrays = {}
+        for p in range(self.n_pages):
+            # RAW (physical-layout) pages: capture must not dispatch a
+            # device-side unmap, and the table rides the meta instead
+            planes = self._fetch(p << L, 1 << L, raw=True)
+            arrays[f"page_{p}"] = planes[0] + 1j * planes[1]
         return {"kind": "pager",
                 "meta": {"n": self.qubit_count, "dtype": str(self.dtype),
                          "n_pages": self.n_pages, "page_len": 1 << L,
-                         "running_norm": float(self.running_norm)},
+                         "running_norm": float(self.running_norm),
+                         "qmap": list(self._qmap)},
                 "arrays": arrays}
 
     def _ckpt_restore(self, arrays, meta, children, restore_child):
@@ -1406,7 +1661,14 @@ class QPager(QEngine):
         plen = int(meta["page_len"])
         if int(meta["n_pages"]) * plen != (1 << self.qubit_count):
             raise ValueError("checkpoint page layout inconsistent")
+        qm = meta.get("qmap")
+        if qm is not None and len(qm) != self.qubit_count:
+            raise ValueError("checkpoint placement table inconsistent")
+        self._settle()
+        self._map_reset()  # pages land raw; the saved table re-attaches
         for i in range(int(meta["n_pages"])):
             self.SetAmplitudePage(np.asarray(arrays[f"page_{i}"],
                                              dtype=np.complex128), i * plen)
+        if qm is not None:
+            self._map_assign([int(x) for x in qm])
         self.running_norm = float(meta.get("running_norm", 1.0))

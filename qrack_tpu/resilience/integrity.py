@@ -283,6 +283,11 @@ def guarded_flush(eng, flush_fn, site: str = "tpu.fuse.flush") -> int:
     planes restored, handing the existing shrink/failover chain an
     uncorrupted base."""
     keep = _snapshot(eng)
+    # the placement table travels with the planes: a flush that commits
+    # a remap before verify catches corruption must roll BOTH back, or
+    # the replay would translate the kept gates through the wrong table
+    keep_map = getattr(eng, "_qmap", None)
+    keep_map = list(keep_map) if keep_map is not None else None
     keep_fp = host_fingerprint(keep, getattr(eng, "n_pages", 1))
     corrupt_fp = None
     cause = None
@@ -294,6 +299,8 @@ def guarded_flush(eng, flush_fn, site: str = "tpu.fuse.flush") -> int:
             _violation(site, e.detail, attempt=attempt)
             corrupt_fp, cause = e.fp, e
             _restore(eng, keep)
+            if keep_map is not None:
+                eng._map_assign(keep_map)
             continue
         if attempt:
             _attribute(eng, corrupt_fp, clean_fp, site)
@@ -306,6 +313,8 @@ def guarded_flush(eng, flush_fn, site: str = "tpu.fuse.flush") -> int:
     # restore the good planes, and escalate to shrink/failover
     _attribute(eng, corrupt_fp, keep_fp, site)
     _restore(eng, keep)
+    if keep_map is not None:
+        eng._map_assign(keep_map)
     if _tele._ENABLED:
         _tele.event("integrity.replay.giveup", site=site,
                     replays=max_replays())

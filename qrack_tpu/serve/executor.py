@@ -144,10 +144,18 @@ class Executor:
                 if (job.kind == "circuit" and job.session is not None
                         and self.canary.should_sample()):
                     self.canary.capture_pre(job)
-        if batch[0].batchable:
-            self._run_batched(batch)
-        else:
-            self._run_single(batch[0])
+        # remap-planner horizon: a session executing several queued
+        # circuits plans placement across the WHOLE batch, not just the
+        # window in hand (ops/fusion.py plan_remaps lookahead)
+        primed = self._prime_lookahead(batch)
+        try:
+            if batch[0].batchable:
+                self._run_batched(batch)
+            else:
+                self._run_single(batch[0])
+        finally:
+            for fuser in primed:
+                fuser.clear_lookahead()
         # job-boundary mis-route probe: a stabilizer forced off-tableau
         # or a QBdt past its node budget escalates (once) right here,
         # before the next job lands on the wrong representation
@@ -156,6 +164,31 @@ class Executor:
             if (job.kind == "circuit" and sess is not None
                     and getattr(sess.engine, "_is_routed", False)):
                 sess.engine.misroute_check()
+
+    def _prime_lookahead(self, batch: List[Job]) -> List[object]:
+        """Install a batch-wide lookahead on each session fuser that is
+        about to execute more than one circuit job.  Single-circuit
+        sessions are left alone — QCircuit.Run primes its own horizon
+        (set-if-None), and these entries concatenate in execution order
+        so the fuser's cursor stays aligned across job boundaries."""
+        groups = {}
+        for job in batch:
+            if job.kind != "circuit" or job.session is None:
+                continue
+            groups.setdefault(id(job.session), []).append(job)
+        primed = []
+        for jobs in groups.values():
+            if len(jobs) < 2:
+                continue
+            fuser = getattr(jobs[0].session.engine, "_fuser", None)
+            if fuser is None or fuser.lookahead is not None:
+                continue
+            entries: List = []
+            for job in jobs:
+                entries.extend(job.circuit._lookahead_entries())
+            fuser.set_lookahead(entries)
+            primed.append(fuser)
+        return primed
 
     # -- batched circuit path ------------------------------------------
 

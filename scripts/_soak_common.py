@@ -44,10 +44,12 @@ from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
 __all__ = ["REPO", "N", "_ops", "STACKS", "fidelity", "submit_retry",
            "resilience_up", "resilience_down", "soak_main"]
 
-# stacks that exercise each guarded dispatch family
+# stacks that exercise each guarded dispatch family; the second pager
+# lane forces the placement planner on so remapped windows soak too
 STACKS = [
     ("tpu", {}),
     ("pager", {"n_pages": 4}),
+    ("pager", {"n_pages": 4, "remap": "on"}),
     ("hybrid", {"tpu_threshold_qubits": 3}),
 ]
 

@@ -11,8 +11,12 @@ site that actually carries state commits for the trial's
 
     tpu   @ window 1  -> tpu.compile     (eager single-op dispatch)
     tpu   @ window 16 -> tpu.fuse.flush  (fused window program)
-    pager @ window 1  -> pager.exchange  (single global-qubit op)
-    pager @ window 16 -> tpu.fuse.flush  (fused window on the pager)
+    pager(remap off) @ window 1 -> pager.exchange (per-gate pair exchange)
+    pager @ anything else       -> tpu.fuse.flush (fused/remapped window;
+                                   the placement planner routes hot paged
+                                   targets through remap prologues, so
+                                   the pair-exchange site only carries
+                                   commits with the planner off)
 
 The integrity guard plane (resilience/integrity.py) must then detect
 every fired corruption at the next flush verify, repair it by scoped
@@ -52,7 +56,8 @@ from qrack_tpu import telemetry as tele  # noqa: E402
 from qrack_tpu.resilience import integrity as integ  # noqa: E402
 from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
-STACKS = [("tpu", {}), ("pager", {"n_pages": 4})]
+STACKS = [("tpu", {}), ("pager", {"n_pages": 4, "remap": "off"}),
+          ("pager", {"n_pages": 4, "remap": "on"})]
 
 GATES1 = ("H", "X", "Y", "Z", "S", "T")
 ROTS = ("RX", "RY", "RZ")
@@ -75,17 +80,21 @@ def _fusable_op(rng):
     return "CCNOT", (0, 1, 2 + int(rng.integers(0, N - 2)))
 
 
-def _site_for(stack_name: str, window: int) -> str:
+def _site_for(stack_name: str, kw: dict, window: int) -> str:
     if stack_name == "tpu":
         return "tpu.compile" if window == 1 else "tpu.fuse.flush"
-    return "pager.exchange" if window == 1 else "tpu.fuse.flush"
+    if window == 1 and kw.get("remap") == "off":
+        return "pager.exchange"  # per-gate pair exchanges still dispatch
+    # the placement planner turns hot paged targets into remapped
+    # windows, so state commits ride the fused flush at ANY window size
+    return "tpu.fuse.flush"
 
 
 def run_trial(trial: int, seed: int) -> dict:
     rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
     stack_name, kw = STACKS[trial % len(STACKS)]
     window = 1 if (trial // 2) % 2 else 16
-    site = _site_for(stack_name, window)
+    site = _site_for(stack_name, kw, window)
     # window-16 merging can collapse a 24-gate trial to a SINGLE
     # matching dispatch, so any after_n > 0 risks a trial where nothing
     # ever fires; window-1 streams dispatch per gate and can wait

@@ -16,6 +16,8 @@ sum; spans merge).  Sections:
     fuse.kernel.fallback_rate with per-reason fuse.kernel.fallback.*
     counters (docs/PERFORMANCE.md)
   * exchange traffic: pager/ICI event counts and bytes
+  * remap: placement-planner traffic — windows planned, swap pairs
+    issued by kind, windows that needed no remap (docs/PERFORMANCE.md)
   * serving: jobs admitted/shed/expired/completed, batch occupancy
     (batched jobs per dispatch), queue-depth / latency gauges
   * routing: decisions and executed jobs per stack with per-stack hit
@@ -100,6 +102,7 @@ def report(snap: dict, top: int) -> dict:
         "compile": {},
         "fusion": {},
         "exchange": {},
+        "remap": {},
         "serve": {},
         "route": {},
         "checkpoint": {},
@@ -119,6 +122,8 @@ def report(snap: dict, top: int) -> dict:
             out["fusion"][k] = v
         elif k.startswith("exchange."):
             out["exchange"][k] = v
+        elif k.startswith("remap."):
+            out["remap"][k] = v
         elif k.startswith("serve."):
             out["serve"][k] = v
         elif k.startswith("route."):
@@ -206,6 +211,10 @@ def main(argv=None) -> int:
     for name, v in sorted(rep["exchange"].items()):
         shown = _fmt_bytes(v) if name.endswith("bytes") else f"{v:.0f}"
         print(f"  {name:<40s} {shown:>12s}")
+    if rep["remap"]:
+        print("== remap ==")
+        for name, v in sorted(rep["remap"].items()):
+            print(f"  {name:<40s} {v:>12.0f}")
     if rep["serve"]:
         print("== serve ==")
         for name, v in sorted(rep["serve"].items()):

@@ -163,6 +163,23 @@ run_stage pallas_on_w26 300 env QRACK_USE_PALLAS=1 QRACK_BENCH_SUFFIX=_pallas \
 run_stage grover_w20 360 env QRACK_BENCH=grover QRACK_BENCH_QB=20 \
   QRACK_BENCH_QB_FIRST=20 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
   QRACK_BENCH_BUDGET=330 python bench.py
+
+# ---- pager exchange evidence: kernel cost model on auto + remap planner
+#      A/B, so the healthy window quotes on-chip sweeps AND exchange
+#      bytes (exchange.pager.*, remaps inserted) in the same stage pair.
+#      On a single chip the mesh degenerates to 1 page (still a valid
+#      engine-path line); on a pod slice the A/B is the real number.
+run_stage pager_remap_w22 420 env QRACK_BENCH_PAGER=1 \
+  QRACK_TPU_FUSE_KERNEL=auto QRACK_BENCH_SUFFIX=_multichip_remap_auto \
+  QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=390 \
+  python bench.py
+run_stage pager_remap_off_w22 420 env QRACK_BENCH_PAGER=1 \
+  QRACK_TPU_REMAP=off QRACK_TPU_FUSE_KERNEL=auto \
+  QRACK_BENCH_SUFFIX=_multichip_remap_off \
+  QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=390 \
+  python bench.py
 run_stage xeb_w22 300 env QRACK_BENCH=xeb QRACK_BENCH_QB=22 \
   QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
   QRACK_BENCH_BUDGET=280 python bench.py

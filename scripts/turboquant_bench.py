@@ -27,13 +27,22 @@ def main() -> None:
 
     import jax
 
+    # the cost model picks the single-sweep Pallas kernel per window on
+    # TPU-class backends; the campaign quotes the resolved choice and
+    # the sweep counts it actually paid (ROADMAP item 3)
+    os.environ.setdefault("QRACK_TPU_FUSE_KERNEL", "auto")
+
     w = int(sys.argv[1]) if len(sys.argv) > 1 else 28
     bits = int(sys.argv[2]) if len(sys.argv) > 2 else 8
     chain = int(sys.argv[3]) if len(sys.argv) > 3 else 4
     samples = int(sys.argv[4]) if len(sys.argv) > 4 else 3
 
+    from qrack_tpu import telemetry as tele
     from qrack_tpu.engines.turboquant import QEngineTurboQuant
+    from qrack_tpu.ops import fusion as fu
     from qrack_tpu.utils.rng import QrackRandom
+
+    tele.enable()
 
     eng = QEngineTurboQuant(w, bits=bits, rng=QrackRandom(7),
                             rand_global_phase=False)
@@ -61,6 +70,7 @@ def main() -> None:
         g()          # warm/compile — excluded
         sync()
         s0 = empty_sync_s()
+        snap0 = tele.snapshot(include_events=False)["counters"]
         times = []
         for _ in range(samples):
             t0 = time.perf_counter()
@@ -68,6 +78,11 @@ def main() -> None:
                 g()
             sync()
             times.append(max(time.perf_counter() - t0 - s0, 0.0) / chain)
+        snap1 = tele.snapshot(include_events=False)["counters"]
+        sweeps = {k: snap1.get(k, 0) - snap0.get(k, 0)
+                  for k in ("fuse.kernel.windows", "fuse.kernel.sweeps",
+                            "fuse.xla.windows", "fuse.xla.sweeps")
+                  if snap1.get(k, 0) != snap0.get(k, 0)}
         avg = sum(times) / len(times)
         print(json.dumps({
             "gate": name, "width": w, "bits": bits,
@@ -80,6 +95,9 @@ def main() -> None:
             "implied_codes_gbps": round(
                 2 * res_bytes / max(avg, 1e-12) / 1e9, 1),
             "platform": jax.default_backend(),
+            "fuse_kernel": fu.kernel_mode(),
+            "remap": fu.remap_mode(),
+            "sweeps": sweeps,
         }), flush=True)
 
 

@@ -152,7 +152,11 @@ def test_quarantine_strikes_and_reset(monkeypatch):
 _MATRIX = [
     ("tpu", 1, "tpu.compile", {}),
     ("tpu", 16, "tpu.fuse.flush", {}),
-    ("pager", 1, "pager.exchange", {"n_pages": 4}),
+    # remap off: the placement planner would turn the lone global op
+    # into a remapped local window (tpu.fuse.flush) and the pair
+    # exchange under test would never dispatch (test_remap.py covers
+    # the planner path)
+    ("pager", 1, "pager.exchange", {"n_pages": 4, "remap": "off"}),
     ("pager", 16, "tpu.fuse.flush", {"n_pages": 4}),
 ]
 
@@ -192,7 +196,7 @@ def test_page_pinned_strike_attribution():
     the clean replay of the same deterministic window is the oracle."""
     tele.enable()
     res.enable()
-    s = create_quantum_interface("pager", N, n_pages=4,
+    s = create_quantum_interface("pager", N, n_pages=4, remap="off",
                                  rng=QrackRandom(3),
                                  rand_global_phase=False)
     s.H(4)          # global gate: the pager.exchange envelope
@@ -218,7 +222,7 @@ def test_quarantine_feeds_elastic_repage(monkeypatch):
     tele.enable()
     res.enable()
     o = QEngineCPU(N, rng=QrackRandom(3), rand_global_phase=False)
-    s = create_quantum_interface("pager", N, n_pages=4,
+    s = create_quantum_interface("pager", N, n_pages=4, remap="off",
                                  rng=QrackRandom(3),
                                  rand_global_phase=False)
     pager = s.engine

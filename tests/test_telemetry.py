@@ -103,7 +103,18 @@ def test_qft20_optimal_counts_three_layers():
 def test_exchange_counters_on_pager():
     tele.enable()
     q = create_quantum_interface("pager", 6, n_pages=4)
-    q.H(5)  # global qubit: half-page ppermute exchange
+    q.H(5)  # global qubit: pair exchange, or a remap under the planner
+    q.GetQuantumState()
+    counters = tele.snapshot()["counters"]
+    assert (counters.get("exchange.pager.global_2x2", 0) >= 1
+            or counters.get("exchange.pager.remap", 0) >= 1)
+    assert counters.get("exchange.pager.bytes", 0) > 0
+
+
+def test_exchange_counters_remap_off():
+    tele.enable()
+    q = create_quantum_interface("pager", 6, n_pages=4, remap="off")
+    q.H(5)  # planner disabled: the global target pays the pair exchange
     q.GetQuantumState()
     counters = tele.snapshot()["counters"]
     assert counters.get("exchange.pager.global_2x2", 0) >= 1
