@@ -32,14 +32,14 @@ __all__ = [
     "save_container", "load_container",
     "capture", "restore_into", "build",
     "save_state", "load_state", "load_snapshot",
-    "CheckpointStore", "StoreLeaseHeld", "enable_warm_start",
-    "ProgramManifest",
+    "CheckpointStore", "StoreLeaseHeld", "StoreLockTimeout",
+    "enable_warm_start", "ProgramManifest",
 ]
 
 
 def __getattr__(name):
     # store/warmstart stay un-imported until first touched
-    if name in ("CheckpointStore", "StoreLeaseHeld"):
+    if name in ("CheckpointStore", "StoreLeaseHeld", "StoreLockTimeout"):
         from . import store
 
         return getattr(store, name)

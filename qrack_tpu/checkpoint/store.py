@@ -63,10 +63,28 @@ from .registry import load_state, save_state
 MANIFEST_VERSION = 1
 CIRCUIT_KIND = "qrack-circuit"
 DEFAULT_LEASE_TTL_S = 300.0
+DEFAULT_LOCK_TIMEOUT_S = 30.0
 
 
 class StoreLeaseHeld(CheckpointError):
     """Another live process holds this store's recovery lease."""
+
+
+class StoreLockTimeout(CheckpointError):
+    """.store.lock was held past QRACK_CKPT_LOCK_TIMEOUT_S.
+
+    A peer wedged mid-manifest-write (SIGSTOP, a hung device read under
+    its flock, a dead NFS client) must not block a healthy worker's
+    save/register forever — the caller gets this typed error after the
+    timeout and decides (the fleet supervisor treats it like any other
+    worker fault; a library caller can retry)."""
+
+    def __init__(self, path: str, waited_s: float):
+        self.path = path
+        self.waited_s = waited_s
+        super().__init__(
+            f"{path}: lock not acquired after {waited_s:.1f}s "
+            "(QRACK_CKPT_LOCK_TIMEOUT_S) — a peer is wedged holding it")
 
 
 # -- circuit <-> container (WAL entries + warm-start program manifest) --
@@ -159,9 +177,31 @@ class CheckpointStore:
         """Advisory exclusive lock serializing manifest read-merge-write
         cycles across every process sharing this root (flock works
         between threads of one process too — each entry opens its own
-        file description)."""
+        file description).  Acquisition is BOUNDED: LOCK_NB polled up to
+        ``QRACK_CKPT_LOCK_TIMEOUT_S`` (default 30 s, 0 = wait forever),
+        then :class:`StoreLockTimeout` — a peer wedged under the flock
+        must not wedge every healthy worker's save with it."""
+        timeout_s = float(os.environ.get("QRACK_CKPT_LOCK_TIMEOUT_S",
+                                         str(DEFAULT_LOCK_TIMEOUT_S)))
         with open(self._lock_path, "a+") as f:
-            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            if timeout_s <= 0:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            else:
+                deadline = time.monotonic() + timeout_s
+                delay = 0.001
+                while True:
+                    try:
+                        fcntl.flock(f.fileno(),
+                                    fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        break
+                    except OSError:
+                        if time.monotonic() >= deadline:
+                            if _tele._ENABLED:
+                                _tele.inc("checkpoint.lock.timeout")
+                            raise StoreLockTimeout(self._lock_path,
+                                                   timeout_s)
+                        time.sleep(delay)
+                        delay = min(delay * 2, 0.05)
             try:
                 yield
             finally:
@@ -354,12 +394,28 @@ class CheckpointStore:
     def has_state(self, sid: str) -> bool:
         return os.path.exists(self._state_path(sid))
 
-    def save(self, sid: str, engine) -> str:
+    def save(self, sid: str, engine,
+             wal_seq: Optional[int] = None) -> str:
         """Persist `engine`'s full state for `sid` (spill or explicit
-        checkpoint — the caller decides whether to drop residency)."""
+        checkpoint — the caller decides whether to drop residency).
+
+        `wal_seq` records the highest journal sequence whose effect the
+        snapshot already CONTAINS (manifest ``wal_high``): recovery
+        skips entries at or below it, so the
+        snapshot-then-settle order of QRACK_SERVE_CKPT_EVERY_JOB can
+        never double-replay the job a crash interrupted mid-settle."""
         path = self._state_path(sid)
         save_state(engine, path)
-        self._mark_clean(sid)  # disk now captures the state exactly
+        rec = self._manifest["sessions"].get(sid)
+        if rec is not None:
+            changed = rec.get("dirty", True)
+            rec["dirty"] = False  # disk now captures the state exactly
+            if wal_seq is not None and int(wal_seq) > rec.get("wal_high",
+                                                              -1):
+                rec["wal_high"] = int(wal_seq)
+                changed = True
+            if changed:
+                self._write_manifest()
         self._enforce_budget(protect=path)
         self._update_gauge()
         return path
@@ -425,27 +481,62 @@ class CheckpointStore:
         out.sort(key=lambda t: t[1])
         return out
 
-    def wal_append(self, sid: str, circuit) -> str:
+    def wal_append(self, sid: str, circuit,
+                   tag: Optional[str] = None) -> str:
         """Journal a submitted circuit; the executor deletes the entry
         at job completion, so entries still present at startup are
-        exactly the jobs a crash interrupted."""
+        exactly the jobs a crash interrupted.  `tag` is an opaque
+        caller token persisted in the entry's meta — the fleet front
+        door stamps each RPC submit so a resubmit decision after a
+        worker death can check :meth:`wal_pending_tags` instead of
+        guessing (docs/FLEET.md exactly-once discussion)."""
         with self._wal_lock:
             seq = self._wal_seq
             self._wal_seq += 1
         path = os.path.join(self._wal_dir, f"{seq:09d}-{sid}.qckpt")
-        save_circuit(path, circuit, extra_meta={"sid": sid, "seq": seq})
+        meta = {"sid": sid, "seq": seq}
+        if tag is not None:
+            meta["tag"] = str(tag)
+        save_circuit(path, circuit, extra_meta=meta)
         self._update_gauge()
         return path
+
+    def wal_pending_tags(self, sids: Optional[Iterable[str]] = None
+                         ) -> set:
+        """Tags of journal entries still pending (optionally scoped to
+        `sids`).  A tag present here is a submit whose effect WILL be
+        applied by whichever process adopts the session — the caller
+        must not resubmit it.  Damaged entries are left for
+        wal_entries() to reap."""
+        want = None if sids is None else set(sids)
+        tags = set()
+        for path, _, sid in self._wal_files():
+            if want is not None and sid not in want:
+                continue
+            try:
+                _, meta = load_circuit(path)
+            except (CheckpointCorrupt, CheckpointError):
+                continue
+            tag = meta.get("tag")
+            if tag is not None:
+                tags.add(tag)
+        return tags
 
     def wal_remove(self, path: str) -> None:
         self._unlink(path)
         self._update_gauge()
 
-    def wal_entries(self) -> List[Tuple[str, int, object]]:
+    def wal_entries(self, sids: Optional[Iterable[str]] = None
+                    ) -> List[Tuple[str, int, object]]:
         """[(sid, seq, circuit)] in submit order; damaged entries (torn
-        writes at crash time) are skipped and removed."""
+        writes at crash time) are skipped and removed.  With `sids`,
+        only those sessions' entries are returned — scoped adoption
+        (fleet re-placement) must not read a live peer's journal."""
+        want = None if sids is None else set(sids)
         out = []
         for path, seq, sid in self._wal_files():
+            if want is not None and sid not in want:
+                continue
             try:
                 circ, _ = load_circuit(path)
             except (CheckpointCorrupt, CheckpointError):
@@ -454,8 +545,15 @@ class CheckpointStore:
             out.append((sid, seq, circ))
         return out
 
-    def clear_wal(self) -> None:
-        for path, _, _ in self._wal_files():
+    def clear_wal(self, sids: Optional[Iterable[str]] = None) -> None:
+        """Drop journal entries — all of them (legacy whole-store
+        adoption), or only the named sessions' (scoped adoption: a
+        fleet peer adopting a dead worker's sids must leave every other
+        worker's pending entries in place)."""
+        want = None if sids is None else set(sids)
+        for path, _, sid in self._wal_files():
+            if want is not None and sid not in want:
+                continue
             self._unlink(path)
         self._update_gauge()
 

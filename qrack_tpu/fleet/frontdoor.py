@@ -1,0 +1,211 @@
+"""Fleet front door: one service surface over N supervised workers.
+
+The front door mirrors :class:`~qrack_tpu.serve.QrackService`'s API
+(create/apply/measure/sample/state/destroy) and hides worker death
+behind it:
+
+* **routing** — every call asks the supervisor for the sid's live
+  client.  ``None`` means the session is between owners (its worker
+  died and adoption is in flight); the front door WAITS instead of
+  erroring, so a tenant's only observable symptom of a kill -9 is a
+  latency blip bounded by detection + adoption time.
+* **exactly-once submits** — each submit carries a fresh tag and uses
+  the two-frame protocol (fleet/rpc.py).  A transport death AFTER the
+  journaled frame never resubmits: the WAL entry is durable and
+  adoption replays it (or the wal_high dedup proves the snapshot
+  already holds it).  A transport death BEFORE the frame consults, in
+  order: the supervisor's adopted-tag record (the dead worker's
+  pending journal, scanned before adoption) and the current owner's
+  in-memory ``tag_seen`` set (the live-worker case) — only a tag
+  NEITHER has seen is resubmitted.  The one residual double-apply
+  window is a worker that journals, executes, settles AND snapshots a
+  submit in the microseconds before writing the first frame — see
+  docs/FLEET.md for why that is accepted.
+* **retryable reads** — reads that lose their connection re-route and
+  re-ask; a read that lands after an adoption executes against the
+  restored snapshot (rng stream included), so retried measurements
+  stay deterministic.
+
+The front door holds no engine, no jax, no store — it is pure
+routing, importable anywhere.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Optional
+
+from .. import telemetry as _tele
+from .rpc import FleetClient, FleetRemoteError, FleetRPCError
+
+DEFAULT_ROUTE_TIMEOUT_S = 120.0
+
+
+class SessionUnroutable(RuntimeError):
+    """No live owner for the session within the routing timeout."""
+
+    def __init__(self, sid: str, waited_s: float):
+        super().__init__(
+            f"session {sid!r}: no live owner after {waited_s:.1f}s "
+            "(worker dead and adoption did not complete in time)")
+        self.sid = sid
+
+
+class FleetFrontDoor:
+    def __init__(self, supervisor,
+                 route_timeout_s: float = DEFAULT_ROUTE_TIMEOUT_S):
+        self.sup = supervisor
+        self.route_timeout_s = route_timeout_s
+
+    # -- routing core --------------------------------------------------
+
+    def _client(self, sid: str, deadline: float) -> FleetClient:
+        while True:
+            c = self.sup.route(sid)
+            if c is not None:
+                return c
+            if time.monotonic() >= deadline:
+                raise SessionUnroutable(
+                    sid, self.route_timeout_s)
+            time.sleep(0.05)
+
+    def _retrying(self, sid: str, fn, timeout_s: Optional[float] = None):
+        """Run `fn(client)` against the sid's live owner, re-routing on
+        transport death — the idempotent-call path (reads, destroys)."""
+        deadline = time.monotonic() + (timeout_s or self.route_timeout_s)
+        while True:
+            client = self._client(sid, deadline)
+            try:
+                return fn(client)
+            except FleetRPCError:
+                if _tele._ENABLED:
+                    _tele.inc("fleet.frontdoor.reroute")
+                if time.monotonic() >= deadline:
+                    raise SessionUnroutable(sid, timeout_s
+                                            or self.route_timeout_s)
+                time.sleep(0.05)
+
+    # -- sessions ------------------------------------------------------
+
+    def create_session(self, width: int, layers=None,
+                       seed: Optional[int] = None,
+                       timeout_s: Optional[float] = None,
+                       **engine_kwargs) -> str:
+        """Place and build a session; sids are front-door-issued so
+        they stay globally unique across every worker sharing the
+        store."""
+        layers = self.sup.layers if layers is None else layers
+        sid = f"f{uuid.uuid4().hex[:12]}"
+        deadline = time.monotonic() + (timeout_s or self.route_timeout_s)
+        while True:
+            self.sup.place_session(sid, layers, width)
+            client = self._client(sid, deadline)
+            try:
+                client.create(width, sid=sid, layers=layers, seed=seed,
+                              **engine_kwargs)
+                return sid
+            except FleetRPCError:
+                # worker died before (or while) building the engine; no
+                # store record exists yet, so just re-place and rebuild
+                if _tele._ENABLED:
+                    _tele.inc("fleet.frontdoor.create_retry")
+                if time.monotonic() >= deadline:
+                    self.sup.note_destroyed(sid)
+                    raise SessionUnroutable(sid, timeout_s
+                                            or self.route_timeout_s)
+                time.sleep(0.05)
+            except FleetRemoteError as e:
+                if e.etype == "RuntimeError" and "draining" in str(e):
+                    # raced a rolling restart: place elsewhere
+                    time.sleep(0.05)
+                    continue
+                self.sup.note_destroyed(sid)
+                raise
+
+    def destroy_session(self, sid: str) -> None:
+        try:
+            self._retrying(sid, lambda c: c.destroy(sid))
+        finally:
+            self.sup.note_destroyed(sid)
+
+    # -- circuit submission (exactly-once) -----------------------------
+
+    def apply(self, sid: str, circuit,
+              timeout_s: Optional[float] = None) -> dict:
+        """Apply `circuit` to `sid` exactly once, riding out worker
+        death mid-submit.  Returns ``{"resubmits": n, "adopted": bool}``
+        describing how the effect landed."""
+        tag = uuid.uuid4().hex
+        deadline = time.monotonic() + (timeout_s or self.route_timeout_s)
+        resubmits = 0
+        while True:
+            client = self._client(sid, deadline)
+            try:
+                client.submit(sid, circuit, tag=tag)
+                return {"resubmits": resubmits, "adopted": False}
+            except FleetRPCError as e:
+                landed = self._submit_landed(
+                    sid, tag, bool(getattr(e, "journaled", False)),
+                    deadline)
+                if landed:
+                    return {"resubmits": resubmits, "adopted": True}
+                resubmits += 1
+                if _tele._ENABLED:
+                    _tele.inc("fleet.frontdoor.resubmit")
+                if time.monotonic() >= deadline:
+                    raise SessionUnroutable(sid, timeout_s
+                                            or self.route_timeout_s)
+                # the owner may be dead-but-undetected for up to one
+                # monitor tick; don't spin the connect loop hot
+                time.sleep(0.02)
+
+    def _submit_landed(self, sid: str, tag: str, journaled: bool,
+                       deadline: float) -> bool:
+        """The transport died mid-submit: decide whether the effect is
+        (or will be) applied.  Wait for the session to be routable
+        first — only after adoption settles can the answer be final."""
+        client = self._client(sid, deadline)
+        if journaled:
+            # frame 1 arrived: the WAL entry was durable when the
+            # worker died — adoption replays or wal_high-dedups it
+            return True
+        if self.sup.tag_adopted(tag):
+            # the dead worker's pending journal held our tag at scan
+            # time; the adopter replays it
+            return True
+        try:
+            rep = client.request({"op": "tag_seen", "tag": tag})
+            return bool(rep.get("seen"))
+        except (FleetRPCError, FleetRemoteError):
+            # owner changed again mid-question; the next apply() loop
+            # iteration re-decides from scratch
+            return False
+
+    # -- reads ---------------------------------------------------------
+
+    def measure_all(self, sid: str,
+                    timeout_s: Optional[float] = None) -> int:
+        return self._retrying(sid, lambda c: c.measure_all(sid),
+                              timeout_s)
+
+    def prob(self, sid: str, qubit: int,
+             timeout_s: Optional[float] = None) -> float:
+        return self._retrying(sid, lambda c: c.prob(sid, qubit),
+                              timeout_s)
+
+    def sample(self, sid: str, shots: int, qubits=None,
+               timeout_s: Optional[float] = None):
+        return self._retrying(sid, lambda c: c.sample(sid, shots,
+                                                      qubits=qubits),
+                              timeout_s)
+
+    def get_state(self, sid: str, timeout_s: Optional[float] = None):
+        return self._retrying(sid, lambda c: c.get_state(sid), timeout_s)
+
+    def stats(self) -> dict:
+        return self.sup.stats()
+
+
+__all__ = ["FleetFrontDoor", "SessionUnroutable",
+           "DEFAULT_ROUTE_TIMEOUT_S"]

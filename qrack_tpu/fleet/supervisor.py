@@ -1,0 +1,557 @@
+"""Fleet supervisor: spawn, watch, restart, and migrate N workers.
+
+One supervisor process owns the fleet's control plane:
+
+* **liveness** — every monitor tick checks each worker twice: pid
+  death via its Popen handle (instant; catches kill -9) and heartbeat
+  age against ``beat_s * deadline_beats`` (catches live-but-wedged —
+  fleet/heartbeat.py).  A missed-beat worker is SIGKILLed first so the
+  two paths converge on one death-handling routine.
+* **adoption before restart** — a dead worker's sessions are evicted
+  from placement, re-placed first-fit-decreasing onto healthy peers,
+  and each adopter runs the store's scoped recovery
+  (``QrackService.recover(sids=...)``) under the store lease: snapshot
+  restore + WAL replay with wal_high dedup = zero loss, exactly once.
+  The dead worker's pending WAL tags are recorded BEFORE adoption so
+  the front door can answer "was my unacked submit adopted?" without
+  guessing (docs/FLEET.md).  While a sid is between owners it sits in
+  the migrating set and :meth:`route` returns None — the front door's
+  signal to wait, not error.
+* **restart discipline** — each worker carries its own
+  :class:`~qrack_tpu.resilience.breaker.CircuitBreaker` as a restart
+  budget: every crash is a recorded failure and restarts back off
+  exponentially; ``threshold`` crashes OPEN it and the worker is
+  QUARANTINED — placement stops routing to it and no respawn happens
+  until the cooldown lets the breaker half-open, at which point
+  exactly one probe restart is attempted.  A worker that stays ready
+  ``stable_s`` closes its breaker.
+* **rolling restart** — drain (sessions handed to peers via the same
+  adoption plane), SIGTERM, reap (probe.py's SIGTERM→SIGKILL ladder),
+  respawn, wait ready — one worker at a time, so capacity never drops
+  by more than one worker and no session is ever lost or paused longer
+  than one adoption.
+
+The monitor never holds the placement lock across process waits or
+RPC: detection runs under the lock, actions (kill, adopt, respawn)
+outside it, so the front door keeps routing unaffected sessions while
+a death is being handled.
+
+Fault hooks (resilience/faults.py): ``fleet.worker:kill:after_n``
+makes the monitor SIGKILL one healthy worker (the chaos-monkey the
+soak uses); ``fleet.heartbeat:hang`` is acted out worker-side.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import telemetry as _tele
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.probe import reap_child
+from .heartbeat import (DEFAULT_DEADLINE_BEATS, DEFAULT_INTERVAL_S,
+                        read_heartbeat)
+from .placement import Placement, session_cost
+from .rpc import FleetClient, FleetRemoteError, FleetRPCError
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_RESTART_THRESHOLD = 3      # crashes before quarantine
+DEFAULT_RESTART_COOLDOWN_S = 10.0  # quarantine length before one probe
+DEFAULT_BACKOFF_BASE_S = 0.25
+DEFAULT_BACKOFF_CAP_S = 5.0
+DEFAULT_STABLE_S = 10.0            # ready this long -> breaker closes
+
+
+class WorkerHandle:
+    def __init__(self, name: str, socket_path: str, hb_path: str,
+                 log_path: str, threshold: int, cooldown_s: float):
+        self.name = name
+        self.socket_path = socket_path
+        self.hb_path = hb_path
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.client = FleetClient(socket_path)
+        # the restart budget IS a circuit breaker: crash = failure,
+        # open = quarantined, half-open = one probe restart
+        self.breaker = CircuitBreaker(threshold=threshold,
+                                      cooldown_s=cooldown_s)
+        self.crashes = 0           # lifetime, for stats
+        self.restarts = 0
+        self.consecutive_crashes = 0
+        self.ready_since: Optional[float] = None
+        self.next_restart_at = 0.0
+        self.restarting = False    # a respawn owns this handle right now
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+
+class FleetSupervisor:
+    def __init__(self, n_workers: int, root: str, *,
+                 store_dir: Optional[str] = None,
+                 layers: str = "cpu",
+                 engine_kwargs: Optional[str] = None,
+                 beat_s: float = DEFAULT_INTERVAL_S,
+                 deadline_beats: float = DEFAULT_DEADLINE_BEATS,
+                 restart_threshold: int = DEFAULT_RESTART_THRESHOLD,
+                 restart_cooldown_s: float = DEFAULT_RESTART_COOLDOWN_S,
+                 backoff_base_s: float = DEFAULT_BACKOFF_BASE_S,
+                 stable_s: float = DEFAULT_STABLE_S,
+                 tick_s: float = 0.2,
+                 ready_timeout_s: float = 180.0,
+                 python: Optional[str] = None,
+                 extra_env: Optional[dict] = None):
+        self.root = os.path.abspath(root)
+        self.store_dir = store_dir or os.path.join(self.root, "store")
+        self.layers = layers
+        self.engine_kwargs = engine_kwargs or "{}"
+        self.beat_s = beat_s
+        self.deadline_s = beat_s * deadline_beats
+        self.backoff_base_s = backoff_base_s
+        self.stable_s = stable_s
+        self.tick_s = tick_s
+        self.ready_timeout_s = ready_timeout_s
+        self.python = python or sys.executable
+        self.extra_env = dict(extra_env or {})
+        os.makedirs(self.store_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.root, "logs"), exist_ok=True)
+        self.placement = Placement()
+        self._lock = threading.RLock()
+        self._workers: Dict[str, WorkerHandle] = {}
+        self._adopted_tags: set = set()
+        self._migrating: set = set()               # sids between owners
+        self._session_meta: Dict[str, tuple] = {}  # sid -> (layers, width)
+        self._kill_rr = 0
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        # supervisor-side read-only store view (pending-tag scans);
+        # built lazily so the checkpoint package only loads on first use
+        self._store = None
+        for i in range(n_workers):
+            name = f"w{i}"
+            h = WorkerHandle(
+                name,
+                socket_path=os.path.join(self.root, f"{name}.sock"),
+                hb_path=os.path.join(self.root, f"{name}.hb"),
+                log_path=os.path.join(self.root, "logs", f"{name}.log"),
+                threshold=restart_threshold, cooldown_s=restart_cooldown_s)
+            self._workers[name] = h
+            self.placement.add_worker(name)
+
+    # -- process plumbing ----------------------------------------------
+
+    def _spawn(self, h: WorkerHandle) -> None:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # fleet-wide warm artifacts: the shared store dir carries the
+        # XLA cache + ProgramManifest, and every worker pre-traces at
+        # boot — a restarted worker's TTFR is the warm number
+        env.setdefault("QRACK_SERVE_PREWARM", "1")
+        env.update(self.extra_env)
+        for p in (h.hb_path, h.socket_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        cmd = [self.python, "-m", "qrack_tpu.fleet.worker",
+               "--socket", h.socket_path, "--store", self.store_dir,
+               "--heartbeat", h.hb_path, "--name", h.name,
+               "--layers", self.layers, "--beat-s", str(self.beat_s),
+               "--engine-kwargs", self.engine_kwargs]
+        log = open(h.log_path, "ab")
+        try:
+            h.proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=env)
+        finally:
+            log.close()
+        h.ready_since = None
+        if _tele._ENABLED:
+            _tele.event("fleet.worker.spawn", worker=h.name, pid=h.proc.pid)
+
+    def _is_ready(self, h: WorkerHandle) -> bool:
+        rec = read_heartbeat(h.hb_path)
+        return bool(rec is not None and rec.get("ready")
+                    and not rec.get("draining")
+                    and h.proc is not None and rec.get("pid") == h.proc.pid)
+
+    def start(self) -> "FleetSupervisor":
+        for h in self._workers.values():
+            self._spawn(h)
+        self.wait_ready(timeout_s=self.ready_timeout_s)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True, name="fleet-monitor")
+        self._monitor.start()
+        return self
+
+    def wait_ready(self, names: Optional[Sequence[str]] = None,
+                   timeout_s: float = 180.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        pending = set(names if names is not None else self._workers)
+        while pending:
+            for name in sorted(pending):
+                h = self._workers[name]
+                if self._is_ready(h):
+                    h.ready_since = time.monotonic()
+                    pending.discard(name)
+                elif h.proc is not None and h.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"worker {name} exited rc={h.proc.returncode} "
+                        f"during boot — see {h.log_path}")
+            if not pending:
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"workers not ready after {timeout_s}s: "
+                    f"{sorted(pending)}")
+            time.sleep(min(self.beat_s / 2, 0.25))
+
+    # -- monitoring ----------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                if _tele._ENABLED:
+                    _tele.inc("fleet.monitor.tick_error")
+
+    def _tick(self) -> None:
+        self._maybe_inject_kill()
+        now = time.monotonic()
+        deaths: List[Tuple[WorkerHandle, str]] = []
+        restarts: List[WorkerHandle] = []
+        probes: List[WorkerHandle] = []
+        with self._lock:
+            for h in self._workers.values():
+                if h.restarting:
+                    continue  # a respawn owns it; hands off
+                state = self.placement.state(h.name)
+                if state == "draining":
+                    continue  # rolling restart owns it end-to-end
+                if state == "dead":
+                    if now >= h.next_restart_at:
+                        restarts.append(h)
+                    continue
+                if state == "quarantined":
+                    probes.append(h)
+                    continue
+                if h.proc is not None and h.proc.poll() is not None:
+                    deaths.append((h, "exit"))
+                    continue
+                age = self._beat_age(h)
+                if age is not None and age > self.deadline_s:
+                    deaths.append((h, "missed-beats"))
+                    continue
+                if (h.ready_since is not None
+                        and now - h.ready_since > self.stable_s):
+                    h.breaker.record_success()
+                    h.consecutive_crashes = 0
+        # slow actions run OUTSIDE the lock: routing for unaffected
+        # sessions must not stall behind a process wait or an RPC
+        for h, reason in deaths:
+            if reason == "missed-beats":
+                # live pid, dead heart: converge on the one death path
+                try:
+                    h.proc.kill()
+                    h.proc.wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            self._on_death(h, reason)
+        for h in restarts:
+            self._maybe_restart(h)
+        for h in probes:
+            self._maybe_probe_restart(h)
+
+    def _beat_age(self, h: WorkerHandle) -> Optional[float]:
+        rec = read_heartbeat(h.hb_path)
+        if rec is None or (h.proc is not None
+                           and rec.get("pid") != h.proc.pid):
+            # no beat from THIS incarnation yet: boot liveness is
+            # covered by the pid check + wait_ready, not beat age
+            return None
+        return time.time() - float(rec.get("t", 0.0))
+
+    def _maybe_inject_kill(self) -> None:
+        try:
+            from ..resilience import faults as _faults
+
+            directive = _faults.check("fleet.worker")
+        except Exception:  # noqa: BLE001 — raise-kinds meaningless here
+            directive = None
+        if directive != "kill":
+            return
+        with self._lock:
+            healthy = self.placement.workers("healthy")
+            if not healthy:
+                return
+            victim = self._workers[healthy[self._kill_rr % len(healthy)]]
+            self._kill_rr += 1
+        if victim.proc is not None:
+            try:
+                victim.proc.kill()
+            except OSError:
+                pass
+        if _tele._ENABLED:
+            _tele.event("fleet.fault.kill", worker=victim.name)
+
+    # -- death / adoption / restart ------------------------------------
+
+    def _on_death(self, h: WorkerHandle, reason: str) -> None:
+        with self._lock:
+            if self.placement.state(h.name) == "dead":
+                return  # already handled
+            h.crashes += 1
+            h.consecutive_crashes += 1
+            h.breaker.record_failure(site=f"fleet.{h.name}")
+            self.placement.set_state(h.name, "dead")
+            evicted = self.placement.evict(h.name)
+            self._migrating |= {sid for sid, _ in evicted}
+            # exponential backoff before respawn; quarantine is decided
+            # at restart time by the breaker, not here
+            delay = min(
+                self.backoff_base_s * (2 ** (h.consecutive_crashes - 1)),
+                DEFAULT_BACKOFF_CAP_S)
+            h.next_restart_at = time.monotonic() + delay
+        if _tele._ENABLED:
+            _tele.event("fleet.worker.dead", worker=h.name, reason=reason,
+                        crashes=h.crashes)
+        if evicted:
+            self._adopt_from(h, evicted)
+
+    def _adopt_from(self, dead: WorkerHandle,
+                    evicted: List[Tuple[str, float]]) -> None:
+        """Re-place a dead worker's sessions and have each adopter run
+        scoped recovery.  Slow path — takes the lock only for placement
+        mutation, never across RPC."""
+        sids = [sid for sid, _ in evicted]
+        try:
+            tags = self._store_view().wal_pending_tags(sids=sids)
+        except Exception:  # noqa: BLE001 — tags are advisory
+            tags = set()
+        with self._lock:
+            self._adopted_tags |= tags
+            mapping = self.placement.place_all(evicted,
+                                               exclude=[dead.name])
+        by_adopter: Dict[str, List[str]] = {}
+        for sid, name in mapping.items():
+            by_adopter.setdefault(name, []).append(sid)
+        for name, batch in sorted(by_adopter.items()):
+            out = self._adopt_batch(self._workers[name], batch)
+            with self._lock:
+                self._migrating -= set(batch)
+            if out is None:
+                # adopter is also failing: leave the batch assigned to
+                # it — when it dies, eviction re-places the sids again
+                # (self-healing); routing meanwhile returns typed
+                # remote errors the front door retries on
+                if _tele._ENABLED:
+                    _tele.event("fleet.adopt.failed", adopter=name,
+                                sids=batch)
+                continue
+            if _tele._ENABLED:
+                _tele.inc("fleet.adopt.sessions", len(batch))
+                _tele.event("fleet.adopt", adopter=name,
+                            source=dead.name,
+                            sessions=len(out.get("sessions", [])),
+                            wal_replayed=out.get("wal_replayed", 0),
+                            wal_deduped=out.get("wal_deduped", 0),
+                            wal_skipped=out.get("wal_skipped", 0))
+
+    def _adopt_batch(self, adopter: WorkerHandle, sids: List[str],
+                     timeout_s: float = 60.0) -> Optional[dict]:
+        """Scoped recovery RPC with retry: StoreLeaseHeld (a peer mid-
+        adoption) and transport blips heal within the window; the
+        lease's same-host pid check guarantees a dead holder is
+        evicted rather than waited out."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                return adopter.client.adopt(sids)
+            except (FleetRPCError, FleetRemoteError):
+                if _tele._ENABLED:
+                    _tele.inc("fleet.adopt.retry")
+                time.sleep(0.1)
+        return None
+
+    def _maybe_restart(self, h: WorkerHandle) -> None:
+        try:
+            h.breaker.allow(site=f"fleet.{h.name}")
+        except Exception:  # noqa: BLE001 — BreakerOpen: quarantine
+            with self._lock:
+                self.placement.set_state(h.name, "quarantined")
+            if _tele._ENABLED:
+                _tele.inc("fleet.worker.quarantined")
+                _tele.event("fleet.worker.quarantine", worker=h.name,
+                            crashes=h.crashes)
+            return
+        self._respawn(h)
+
+    def _maybe_probe_restart(self, h: WorkerHandle) -> None:
+        """Quarantined worker: the breaker's half-open transition admits
+        exactly one probe restart after the cooldown."""
+        try:
+            h.breaker.allow(site=f"fleet.{h.name}")
+        except Exception:  # noqa: BLE001 — still open
+            return
+        if _tele._ENABLED:
+            _tele.event("fleet.worker.probe_restart", worker=h.name)
+        self._respawn(h)
+
+    def _respawn(self, h: WorkerHandle) -> None:
+        h.restarting = True
+        try:
+            h.restarts += 1
+            with self._lock:
+                # no routing until the new process proves ready
+                self.placement.set_state(h.name, "dead")
+            self._spawn(h)
+            try:
+                self.wait_ready([h.name], timeout_s=self.ready_timeout_s)
+            except (TimeoutError, RuntimeError):
+                h.next_restart_at = 0.0  # next tick: breaker decides
+                self._on_death(h, reason="boot-failure")
+                return
+            with self._lock:
+                self.placement.set_state(h.name, "healthy")
+            if _tele._ENABLED:
+                _tele.event("fleet.worker.restarted", worker=h.name,
+                            restarts=h.restarts)
+        finally:
+            h.restarting = False
+
+    # -- rolling restart (live migration) ------------------------------
+
+    def rolling_restart(self) -> dict:
+        """Restart every worker one at a time with zero session loss:
+        drain (handing sessions to peers through the store), SIGTERM +
+        reap, respawn, wait ready.  Returns per-worker migration
+        counts."""
+        out = {}
+        for name in sorted(self._workers):
+            out[name] = self._restart_one(name)
+        if _tele._ENABLED:
+            _tele.event("fleet.rolling_restart",
+                        migrated=sum(len(v["migrated"]) for v in
+                                     out.values()))
+        return out
+
+    def _restart_one(self, name: str) -> dict:
+        h = self._workers[name]
+        with self._lock:
+            self.placement.set_state(name, "draining")
+            moved = self.placement.evict(name)
+            self._migrating |= {sid for sid, _ in moved}
+        # worker-side drain persists idle sessions and disowns them;
+        # busy ones settle their in-flight jobs under the SIGTERM
+        # handler's drain loop, so after reap_child the full set is
+        # durably on the store
+        try:
+            h.client.drain()
+        except (FleetRPCError, FleetRemoteError):
+            pass  # SIGTERM's graceful drain covers it
+        reaped = reap_child(h.proc)
+        with self._lock:
+            migrated = self.placement.place_all(moved, exclude=[name])
+        by_adopter: Dict[str, List[str]] = {}
+        for sid, adopter in migrated.items():
+            by_adopter.setdefault(adopter, []).append(sid)
+        for adopter, batch in sorted(by_adopter.items()):
+            self._adopt_batch(self._workers[adopter], batch)
+            with self._lock:
+                self._migrating -= set(batch)
+        self._respawn(h)
+        if _tele._ENABLED:
+            _tele.event("fleet.rolling_restart.worker", worker=name,
+                        migrated=len(migrated), killed=reaped.killed)
+        return {"migrated": migrated, "needed_kill": reaped.killed}
+
+    # -- front-door surface --------------------------------------------
+
+    def place_session(self, sid: str, layers, width: int) -> str:
+        with self._lock:
+            name = self.placement.place(sid, layers, width)
+            self._session_meta[sid] = (layers, width)
+            return name
+
+    def owner_of(self, sid: str) -> Optional[str]:
+        with self._lock:
+            return self.placement.owner_of(sid)
+
+    def route(self, sid: str) -> Optional[FleetClient]:
+        """The live client currently serving `sid`, or None while the
+        session is between owners (migration/adoption in flight) — the
+        front door waits and re-asks instead of erroring."""
+        with self._lock:
+            if sid in self._migrating:
+                return None
+            name = self.placement.owner_of(sid)
+            if name is None:
+                return None
+            if self.placement.state(name) not in ("healthy", "draining"):
+                return None
+            return self._workers[name].client
+
+    def note_destroyed(self, sid: str) -> None:
+        with self._lock:
+            self.placement.release(sid)
+            self._session_meta.pop(sid, None)
+
+    def tag_adopted(self, tag: str) -> bool:
+        """True when `tag` was pending in a dead worker's journal at
+        adoption time — its effect is (being) applied; never resubmit."""
+        with self._lock:
+            return tag in self._adopted_tags
+
+    def client(self, name: str) -> FleetClient:
+        return self._workers[name].client
+
+    def worker_names(self) -> List[str]:
+        return sorted(self._workers)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "placement": self.placement.snapshot(),
+                "workers": {name: {
+                    "pid": h.pid, "crashes": h.crashes,
+                    "restarts": h.restarts,
+                    "breaker": h.breaker.snapshot(),
+                    "state": self.placement.state(name),
+                    "beat": read_heartbeat(h.hb_path),
+                } for name, h in self._workers.items()},
+                "migrating": sorted(self._migrating),
+                "adopted_tags": len(self._adopted_tags),
+            }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None and self._monitor.is_alive():
+            self._monitor.join(timeout=max(self.tick_s * 10, 5.0))
+        for h in self._workers.values():
+            if h.proc is not None and h.proc.poll() is None:
+                reap_child(h.proc)
+
+    def __enter__(self) -> "FleetSupervisor":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    def _store_view(self):
+        if self._store is None:
+            from ..checkpoint.store import CheckpointStore
+
+            self._store = CheckpointStore(self.store_dir)
+        return self._store
+
+
+__all__ = ["FleetSupervisor", "WorkerHandle"]

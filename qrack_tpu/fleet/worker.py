@@ -1,0 +1,256 @@
+"""Fleet worker: one QrackService behind a unix-socket RPC front.
+
+``python -m qrack_tpu.fleet.worker --socket S --store DIR ...`` runs
+one supervised serving process:
+
+* the service is built ``hold_lease=False`` (the shared store's lease
+  is only taken around adoption, never parked — N workers share one
+  checkpoint dir) and ``checkpoint_every_job=True`` (every completed
+  circuit job lands a snapshot before its WAL entry settles, so a
+  kill -9 at ANY instant is recoverable with zero loss — the wal_high
+  high-water mark dedups the snapshot-then-settle window);
+* warm artifacts are fleet-wide: the store dir carries the shared XLA
+  cache and ProgramManifest, and ``QRACK_SERVE_PREWARM=1`` (set by the
+  supervisor) pre-traces recorded shapes at boot so a restarted
+  worker's time-to-first-result is the warm number.  The measured
+  ``ttfr_s`` rides in every heartbeat for the soak to assert on;
+* SIGTERM is the graceful half of the restart ladder
+  (resilience/probe.py reap_child): finish in-flight jobs, drain every
+  session to the store for a peer to adopt, final heartbeat, exit 0.
+
+The RPC loop is deliberately thread-per-connection over a stateless
+connection-per-request protocol (fleet/rpc.py): all device traffic
+already serializes through the service's dispatch owner, so connection
+concurrency costs nothing and a worker restart needs no client-side
+session re-handshake.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Optional
+
+from .rpc import (decode_circuit, encode_array, recv_frame, send_frame,
+                  FleetRPCError)
+from .heartbeat import DEFAULT_INTERVAL_S, HeartbeatWriter
+
+_T0 = time.perf_counter()
+
+
+class _WorkerState:
+    def __init__(self):
+        self.ready = False
+        self.ttfr_s: Optional[float] = None
+        self.boot_s: Optional[float] = None
+        self.draining = False
+        # every tag this incarnation journaled (memory-bounded only by
+        # process lifetime — a worker restart clears it, which is
+        # exactly when the supervisor's WAL-scan record takes over);
+        # answers the front door's "did my unacked submit land?"
+        self.seen_tags = set()
+
+
+def _handle(svc, state: _WorkerState, conn) -> bool:
+    """Serve one connection (one request).  Returns False when the
+    request was a shutdown."""
+    f = conn.makefile("rwb")
+    try:
+        req = recv_frame(f)
+    except FleetRPCError:
+        return True  # client connected and vanished; nothing owed
+    op = req.get("op")
+    try:
+        if op == "submit":
+            return _handle_submit(svc, state, f, req)
+        rep = _dispatch(svc, state, op, req)
+    except Exception as e:  # noqa: BLE001 — typed errors cross as frames
+        _send_err(f, e)
+        return True
+    send_frame(f, {"ok": True, **rep})
+    return op != "shutdown"
+
+
+def _handle_submit(svc, state: _WorkerState, f, req) -> bool:
+    sid = req["sid"]
+    circuit = decode_circuit(req["circuit"])
+    tag = req.get("tag")
+    t0 = time.perf_counter()
+    try:
+        handle = svc.submit(sid, circuit, tag=tag)
+    except Exception as e:  # noqa: BLE001
+        _send_err(f, e)
+        return True
+    if tag is not None:
+        state.seen_tags.add(tag)
+    # frame 1 the moment the WAL entry is durable: the client's
+    # exactly-once pivot (rpc.py) — after this frame, never resubmit
+    send_frame(f, {"ok": True, "journaled": True})
+    try:
+        handle.result(None)
+    except Exception as e:  # noqa: BLE001
+        _send_err(f, e)
+        return True
+    if state.ttfr_s is None:
+        # SERVICE latency of this incarnation's first result — the
+        # number that exposes a cold recompile (a prewarmed restart
+        # stays near steady-state apply latency)
+        state.ttfr_s = time.perf_counter() - t0
+    send_frame(f, {"ok": True})
+    return True
+
+
+def _dispatch(svc, state: _WorkerState, op: str, req: dict) -> dict:
+    if op == "ping":
+        return {"pid": os.getpid(), "ready": state.ready,
+                "draining": state.draining}
+    if op == "create":
+        if state.draining:
+            raise RuntimeError("worker is draining; closed to new sessions")
+        sid = svc.create_session(req["width"], layers=req.get("layers"),
+                                 seed=req.get("seed"), sid=req.get("sid"),
+                                 **(req.get("engine_kwargs") or {}))
+        return {"sid": sid}
+    if op == "destroy":
+        svc.destroy_session(req["sid"])
+        return {}
+    if op == "measure_all":
+        return {"value": int(svc.measure_all(req["sid"]))}
+    if op == "prob":
+        return {"value": float(svc.prob(req["sid"], req["qubit"]))}
+    if op == "sample":
+        shots = svc.sample(req["sid"], req["shots"],
+                           qubits=req.get("qubits"))
+        return {"value": [int(s) for s in shots]}
+    if op == "get_state":
+        return {"state": encode_array(svc.get_state(req["sid"]))}
+    if op == "drain":
+        return svc.drain(sids=req.get("sids"))
+    if op == "adopt":
+        t0 = time.perf_counter()
+        out = svc.recover(sids=req["sids"])
+        if state.ttfr_s is None and out.get("wal_replayed"):
+            state.ttfr_s = time.perf_counter() - t0
+        return out
+    if op == "tag_seen":
+        return {"seen": req.get("tag") in state.seen_tags}
+    if op == "stats":
+        return {"stats": json.loads(json.dumps(
+            svc.stats(), default=str))}
+    if op == "shutdown":
+        return {}
+    raise ValueError(f"unknown op {op!r}")
+
+
+def _send_err(f, e: BaseException) -> None:
+    try:
+        send_frame(f, {"ok": False, "etype": type(e).__name__,
+                       "error": str(e)})
+    except FleetRPCError:
+        pass  # client gone; the error had nowhere to land
+
+
+def _graceful_drain(svc, grace_s: float = 30.0) -> None:
+    """Drain everything to the store for a peer to adopt; in-flight
+    jobs get `grace_s` to settle before we give up on their sessions
+    (the WAL still covers them — adoption replays)."""
+    deadline = time.monotonic() + grace_s
+    while True:
+        out = svc.drain()
+        if not out["busy"] or time.monotonic() >= deadline:
+            return
+        time.sleep(0.05)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--heartbeat", required=True)
+    ap.add_argument("--name", default=f"worker-{os.getpid()}")
+    ap.add_argument("--layers", default="cpu",
+                    help="default engine stack (comma-separated for "
+                         "multi-layer; sessions may override per-create)")
+    ap.add_argument("--beat-s", type=float, default=DEFAULT_INTERVAL_S)
+    ap.add_argument("--engine-kwargs", default="{}",
+                    help="JSON dict of default engine kwargs")
+    args = ap.parse_args(argv)
+
+    state = _WorkerState()
+    from ..serve.service import QrackService
+
+    layers = args.layers.split(",") if "," in args.layers else args.layers
+    svc = QrackService(engine_layers=layers,
+                       checkpoint_dir=args.store,
+                       hold_lease=False, checkpoint_every_job=True,
+                       recover=False,
+                       **json.loads(args.engine_kwargs))
+
+    def info():
+        return {"name": args.name, "ready": state.ready,
+                "draining": state.draining,
+                "sessions": len(svc.sessions.ids()),
+                "ttfr_s": state.ttfr_s,
+                "boot_s": state.boot_s}
+
+    hb = HeartbeatWriter(args.heartbeat, interval_s=args.beat_s,
+                         info_fn=info).start()
+
+    try:
+        os.unlink(args.socket)
+    except OSError:
+        pass
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    server.bind(args.socket)
+    server.listen(16)
+    stop = threading.Event()
+
+    def on_sigterm(signum, frame):
+        state.draining = True
+        stop.set()
+        # break the accept loop; in-flight connection threads finish
+        try:
+            server.close()
+        except OSError:
+            pass
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    state.ready = True
+    state.boot_s = time.perf_counter() - _T0
+    hb.beat()
+
+    try:
+        while not stop.is_set():
+            try:
+                conn, _ = server.accept()
+            except OSError:
+                break  # closed by on_sigterm
+            def run(c=conn):
+                try:
+                    if not _handle(svc, state, c):
+                        on_sigterm(signal.SIGTERM, None)
+                finally:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+            threading.Thread(target=run, daemon=True).start()
+    finally:
+        _graceful_drain(svc)
+        svc.close()
+        hb.stop(final_beat=True)
+        try:
+            os.unlink(args.socket)
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

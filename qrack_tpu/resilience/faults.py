@@ -61,7 +61,7 @@ from .. import telemetry as _tele
 from .errors import (DeviceLost, DispatchFailure, InjectedFault, NaNPoisoned)
 
 KINDS = ("timeout", "hang", "raise", "nan-poison", "device-loss",
-         "flap", "torn-write", "amp-corrupt")
+         "flap", "torn-write", "amp-corrupt", "kill")
 
 # every call_guarded site in the tree (grep '"<name>"' call_guarded /
 # instrument_dispatch / guard_callable call sites when adding one) —
@@ -76,6 +76,12 @@ SITES = (
     "turboquant.dispatch", "turboquant_pager.exchange",
     "serve.dispatch", "serve.device_get",
     "checkpoint.save", "checkpoint.restore",
+    # process-plane sites (fleet/): checked by the supervisor's monitor
+    # tick and the worker's heartbeat writer, not by call_guarded —
+    # ``fleet.worker:kill:after_n`` makes the supervisor SIGKILL its own
+    # worker, ``fleet.heartbeat:hang:after_n`` makes a worker stop
+    # beating while it keeps serving (docs/FLEET.md)
+    "fleet.worker", "fleet.heartbeat",
 )
 # bare last-segment categories that match the site family on any engine
 CATEGORIES = ("discover", "compile", "dispatch", "device_get", "exchange",
@@ -266,10 +272,12 @@ def check(site: str) -> Optional[str]:
     Raises the matching :class:`DispatchFailure` subclass for the
     ``timeout``/``raise``/``nan-poison``/``device-loss`` kinds, returns
     a directive string for the kinds the SITE must act out itself —
-    ``"hang"`` (the dispatch wrapper swaps in a sleeping stub) and
-    ``"torn-write"`` (checkpoint.save truncates the payload mid-write,
-    proving load-side corruption detection rejects the file) — or
-    returns None (no fault).
+    ``"hang"`` (the dispatch wrapper swaps in a sleeping stub; the
+    fleet heartbeat writer stops beating), ``"torn-write"``
+    (checkpoint.save truncates the payload mid-write, proving
+    load-side corruption detection rejects the file), and ``"kill"``
+    (the fleet supervisor SIGKILLs its own worker) — or returns None
+    (no fault).
     """
     with _LOCK:
         if not _SPECS or _SUSPENDED:
@@ -285,7 +293,7 @@ def check(site: str) -> Optional[str]:
         return None
     if _tele._ENABLED:
         _tele.event(f"resilience.fault.{site}.{fired_kind}")
-    if fired_kind in ("hang", "torn-write"):
+    if fired_kind in ("hang", "torn-write", "kill"):
         return fired_kind
     if fired_kind == "timeout":
         from .errors import DispatchTimeout

@@ -99,6 +99,53 @@ def _tele():
         return None
 
 
+def reap_child(proc, term_grace_s: float = DEFAULT_TERM_GRACE_S,
+               kill_wait_s: float = _KILL_WAIT_S,
+               wait=None) -> "ReapResult":
+    """SIGTERM-first child reaping with a bounded SIGKILL escalation.
+
+    The one escalation ladder every parent in the tree uses (probe
+    watchdog here; the fleet supervisor for worker shutdown): SIGTERM →
+    wait ``term_grace_s`` → SIGKILL → wait ``kill_wait_s`` → abandon.
+    A child that ignores SIGTERM therefore cannot leak past its
+    watchdog, and an unkillable (D-state) child never blocks the
+    caller unboundedly.
+
+    `wait` overrides how each bounded wait happens — it is called as
+    ``wait(timeout_s)`` and must raise :class:`subprocess.TimeoutExpired`
+    on expiry (run_probe passes a ``communicate`` closure so pipe
+    output keeps draining during the grace windows); default is
+    ``proc.wait``.  Never raises."""
+    if wait is None:
+        wait = proc.wait
+    killed = abandoned = False
+    try:
+        proc.terminate()  # SIGTERM first: avoid server-side half-claims
+    except OSError:
+        pass  # already gone
+    try:
+        wait(term_grace_s)
+    except subprocess.TimeoutExpired:
+        killed = True
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        try:
+            wait(kill_wait_s)
+        except subprocess.TimeoutExpired:
+            abandoned = True  # unkillable child; abandon, stay bounded
+    return ReapResult(killed=killed, abandoned=abandoned,
+                      returncode=proc.returncode)
+
+
+@dataclass
+class ReapResult:
+    killed: bool                   # needed SIGKILL after the TERM grace
+    abandoned: bool                # survived even SIGKILL's bounded wait
+    returncode: Optional[int]
+
+
 def run_probe(timeout_s: float = DEFAULT_TIMEOUT_S,
               term_grace_s: float = DEFAULT_TERM_GRACE_S,
               python: Optional[str] = None,
@@ -120,16 +167,15 @@ def run_probe(timeout_s: float = DEFAULT_TIMEOUT_S,
         out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         timed_out = True
-        proc.terminate()  # SIGTERM first: avoid server-side half-claims
-        try:
-            out, _ = proc.communicate(timeout=term_grace_s)
-        except subprocess.TimeoutExpired:
-            killed = True
-            proc.kill()
-            try:
-                out, _ = proc.communicate(timeout=_KILL_WAIT_S)
-            except subprocess.TimeoutExpired:
-                out = ""  # unkillable child (D-state); abandon, stay bounded
+        collected = []
+
+        def drain(t):
+            collected[:] = [proc.communicate(timeout=t)[0]]
+
+        reaped = reap_child(proc, term_grace_s=term_grace_s,
+                            kill_wait_s=_KILL_WAIT_S, wait=drain)
+        killed = reaped.killed
+        out = "" if reaped.abandoned else (collected[0] if collected else "")
     duration = time.perf_counter() - t0
     ok = (not timed_out and proc.returncode == 0
           and PROBE_OK_SENTINEL in (out or ""))

@@ -38,7 +38,8 @@ from .session import SessionManager, planes_engine
 
 class Executor:
     def __init__(self, scheduler: Scheduler, sessions: SessionManager,
-                 tick_s: float = 0.25, sync: bool = True, canary=None):
+                 tick_s: float = 0.25, sync: bool = True, canary=None,
+                 checkpoint_every_job: bool = False):
         self.scheduler = scheduler
         self.sessions = sessions
         self.tick_s = tick_s
@@ -47,6 +48,10 @@ class Executor:
         # unless QRACK_SERVE_CANARY_RATE > 0 — the default costs one
         # attribute test per batch
         self.canary = canary
+        # QRACK_SERVE_CKPT_EVERY_JOB: settle order snapshot → WAL
+        # remove, so there is NO instant where a completed job is
+        # neither on disk nor in the journal (fleet zero-loss contract)
+        self.checkpoint_every_job = checkpoint_every_job
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -338,11 +343,36 @@ class Executor:
         if job.session is not None:
             job.session.end_job(ok)
             if ok and self.sessions.spill_store is not None:
-                # the session's live state has advanced past whatever is
-                # (or isn't) on disk; recovery keys off this flag to
-                # refuse WAL replay onto a wrong base (no-op when
-                # already dirty, so the steady-state cost is a probe)
-                self.sessions.spill_store.mark_dirty(job.session.sid)
+                if (self.checkpoint_every_job and job.kind == "circuit"
+                        and job.session.engine is not None):
+                    # snapshot BEFORE the WAL entry below is settled,
+                    # recording this job's journal seq as the snapshot's
+                    # wal_high: kill -9 before the save replays the
+                    # pending entry onto the clean pre-job snapshot;
+                    # kill -9 after it finds the entry deduped against
+                    # wal_high — the job lands exactly once either way.
+                    # A failed save leaves the dirty path below intact.
+                    wal_seq = None
+                    if job.wal_path is not None:
+                        import os as _os
+                        try:
+                            wal_seq = int(_os.path.basename(job.wal_path)
+                                          .partition("-")[0])
+                        except ValueError:
+                            pass
+                    try:
+                        self.sessions.spill_store.save(job.session.sid,
+                                                       job.session.engine,
+                                                       wal_seq=wal_seq)
+                    except Exception:  # noqa: BLE001 — fall back to dirty
+                        self.sessions.spill_store.mark_dirty(
+                            job.session.sid)
+                else:
+                    # the session's live state has advanced past whatever
+                    # is (or isn't) on disk; recovery keys off this flag
+                    # to refuse WAL replay onto a wrong base (no-op when
+                    # already dirty, so the steady-state cost is a probe)
+                    self.sessions.spill_store.mark_dirty(job.session.sid)
         wal_path = getattr(job, "wal_path", None)
         if wal_path is not None and self.sessions.spill_store is not None:
             # settled either way: a failed job must not replay at recovery

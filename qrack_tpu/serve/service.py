@@ -34,6 +34,18 @@ blocks on a JobHandle.  Env knobs (constructor args override):
                                    against the CPU oracle off the
                                    dispatch-owner thread (default 0 =
                                    off; docs/INTEGRITY.md)
+* ``QRACK_SERVE_HOLD_LEASE``       "0": never park the store's recovery
+                                   lease across serving — it is taken
+                                   around recover()/adoption only
+                                   (fleet workers; docs/FLEET.md)
+* ``QRACK_SERVE_CKPT_EVERY_JOB``   "1": snapshot a session's state
+                                   BEFORE settling each completed
+                                   circuit job's WAL entry, so a
+                                   kill -9 at ANY instant leaves either
+                                   a clean snapshot + pending entry
+                                   (replay exact) or a snapshot that
+                                   already contains the job — never a
+                                   stale base (docs/FLEET.md)
 
 See docs/SERVING.md for the architecture and the load-shedding
 semantics; serving is NOT imported by ``import qrack_tpu`` so the
@@ -76,6 +88,8 @@ class QrackService:
                  spill_max_mb: Optional[float] = None,
                  recover: Optional[bool] = None,
                  prewarm: Optional[bool] = None,
+                 hold_lease: Optional[bool] = None,
+                 checkpoint_every_job: Optional[bool] = None,
                  **engine_kwargs):
         if max_depth is None:
             max_depth = int(_env_float("QRACK_SERVE_MAX_DEPTH", 64))
@@ -94,6 +108,15 @@ class QrackService:
             recover = os.environ.get("QRACK_SERVE_RECOVER", "0") == "1"
         if prewarm is None:
             prewarm = os.environ.get("QRACK_SERVE_PREWARM", "0") == "1"
+        if hold_lease is None:
+            hold_lease = os.environ.get("QRACK_SERVE_HOLD_LEASE", "1") == "1"
+        if checkpoint_every_job is None:
+            checkpoint_every_job = os.environ.get(
+                "QRACK_SERVE_CKPT_EVERY_JOB", "0") == "1"
+        # fleet workers run hold_lease=False: the store lease is only
+        # taken around recover()/adoption, never parked across serving,
+        # so N workers sharing one store never block a peer's adoption
+        self._hold_lease = bool(hold_lease)
         self.default_layers = engine_layers
         self.default_engine_kwargs = engine_kwargs
         self.store = None
@@ -138,10 +161,13 @@ class QrackService:
             self.canary = CanaryVerifier(canary_rate)
         self.executor = Executor(self.scheduler, self.sessions,
                                  tick_s=tick_s, sync=sync,
-                                 canary=self.canary)
+                                 canary=self.canary,
+                                 checkpoint_every_job=(
+                                     checkpoint_every_job
+                                     and self.store is not None))
         self.executor.start()
         self._closed = False
-        if self.store is not None:
+        if self.store is not None and self._hold_lease:
             # best-effort: a second process sharing the store serves its
             # own sessions fine without the lease — only recover/adopt
             # (WAL replay exclusivity) requires holding it
@@ -161,14 +187,18 @@ class QrackService:
 
     def create_session(self, width: int, layers=None,
                        seed: Optional[int] = None, timeout: float = 60.0,
+                       sid: Optional[str] = None,
                        **engine_kwargs) -> str:
         """Build a tenant session (engine constructed on the dispatch
-        owner — construction is device traffic) and return its id."""
+        owner — construction is device traffic) and return its id.
+        `sid` pins an explicit id — the fleet front door passes one so
+        sids stay globally unique across N workers sharing a store."""
         layers = self.default_layers if layers is None else layers
         kwargs = {**self.default_engine_kwargs, **engine_kwargs}
         job = Job(None, "admin",
                   fn=lambda: self.sessions.create(width, layers=layers,
-                                                  seed=seed, **kwargs))
+                                                  seed=seed, sid=sid,
+                                                  **kwargs))
         self.scheduler.submit(job)
         return job.handle.result(timeout).sid
 
@@ -180,7 +210,8 @@ class QrackService:
 
     # -- job submission ------------------------------------------------
 
-    def submit(self, sid: str, circuit, priority: int = 0) -> JobHandle:
+    def submit(self, sid: str, circuit, priority: int = 0,
+               tag: Optional[str] = None) -> JobHandle:
         """Queue `circuit` against session `sid`; returns immediately
         with a JobHandle.  Raises typed admission errors (QueueFull /
         LoadShed / ServiceStopped / MisrouteError) synchronously.
@@ -213,7 +244,7 @@ class QrackService:
             # at completion, a refusal deletes it below — so entries
             # still on disk at startup are exactly the crash-interrupted
             # jobs recover() re-runs.
-            job.wal_path = self.store.wal_append(sid, circuit)
+            job.wal_path = self.store.wal_append(sid, circuit, tag=tag)
         sess.begin_job()
         try:
             return self.scheduler.submit(job)
@@ -304,11 +335,21 @@ class QrackService:
         self.scheduler.submit(job)
         return job.handle.result(timeout)
 
-    def recover(self, timeout: float = 600.0) -> dict:
+    def recover(self, timeout: float = 600.0,
+                sids: Optional[Sequence[str]] = None) -> dict:
         """Rebuild the previous process's sessions from the store's
         live-session manifest (under their original ids), load any
         persisted state, and re-run crash-interrupted WAL jobs in
         submit order.  Runs as one admin job on the dispatch owner.
+
+        With `sids`, adoption is SCOPED: only the named sessions are
+        rebuilt and only THEIR journal entries are replayed and cleared
+        — the fleet re-placement path, where N live workers share one
+        store and a peer adopts exactly the dead worker's sessions
+        without touching anyone else's manifest records or pending WAL
+        entries (docs/FLEET.md).  When the service was built with
+        ``hold_lease=False``, the lease is taken for the adoption and
+        released the moment it completes.
 
         WAL replay is only exact when the rebuilt base provably matches
         the state the job was submitted against: either the on-disk
@@ -343,11 +384,18 @@ class QrackService:
             # a draining peer may have handed sessions over since our
             # constructor snapshotted it
             self.store.reload()
-            recovered, stale, replayed, skipped = [], [], 0, 0
+            recovered, stale, replayed, skipped, deduped = [], [], 0, 0, 0
+            wal_high: dict = {}
             # snapshot the manifest first: re-creating a session below
-            # re-registers it, which resets its dirty flag
+            # re-registers it, which resets its dirty flag and wal_high
+            live = set(self.sessions.ids())
             for sid, rec in sorted(self.store.sessions().items()):
+                if sids is not None and sid not in sids:
+                    continue
+                if sid in live:
+                    continue  # already served here — nothing to adopt
                 dirty = bool(rec.get("dirty", False))
+                wal_high[sid] = int(rec.get("wal_high", -1))
                 kwargs = {**self.default_engine_kwargs,
                           **rec.get("engine_kwargs", {})}
                 sess = self.sessions.create(
@@ -364,7 +412,8 @@ class QrackService:
                     self.store.mark_dirty(sid)
                 recovered.append(sid)
             stale_set = set(stale)
-            for sid, _seq, circuit in self.store.wal_entries():
+            scope = None if sids is None else recovered
+            for sid, seq, circuit in self.store.wal_entries(sids=scope):
                 try:
                     sess = self.sessions.get(sid)
                 except SessionNotFound:
@@ -372,18 +421,30 @@ class QrackService:
                 if sid in stale_set:
                     skipped += 1  # base is wrong — replay would be too
                     continue
+                if seq <= wal_high.get(sid, -1):
+                    # the snapshot already contains this entry's effect
+                    # (crash landed between snapshot and WAL settle) —
+                    # replaying would double-apply
+                    deduped += 1
+                    continue
                 circuit.Run(sess.engine)
                 self.store.mark_dirty(sid)
                 replayed += 1
-            self.store.clear_wal()
+            self.store.clear_wal(sids=scope)
             return {"sessions": recovered, "wal_replayed": replayed,
-                    "wal_skipped": skipped, "recovered_stale": stale}
+                    "wal_skipped": skipped, "wal_deduped": deduped,
+                    "recovered_stale": stale}
 
         job = Job(None, "admin", fn=do)
-        self.scheduler.submit(job)
-        return job.handle.result(timeout)
+        try:
+            self.scheduler.submit(job)
+            return job.handle.result(timeout)
+        finally:
+            if not self._hold_lease:
+                self.release_lease()
 
-    def drain(self, timeout: float = 600.0) -> dict:
+    def drain(self, timeout: float = 600.0,
+              sids: Optional[Sequence[str]] = None) -> dict:
         """Hand every idle session over to the checkpoint plane: persist
         its state, keep its manifest record on disk, and release it from
         THIS process — a peer sharing the store adopts the set with
@@ -392,7 +453,8 @@ class QrackService:
         behind, the recovery lease is released so the adopter's
         ``recover()`` is admitted immediately.  Runs as ONE admin job so
         no tenant job interleaves: the handed-over set is a consistent
-        point-in-time cut."""
+        point-in-time cut.  With `sids`, only the named sessions are
+        drained — the fleet live-migration path (docs/FLEET.md)."""
         if self.store is None:
             raise RuntimeError("checkpointing is not enabled "
                                "(QRACK_SERVE_CHECKPOINT_DIR)")
@@ -400,6 +462,8 @@ class QrackService:
         def do():
             drained, busy = [], []
             for sid in self.sessions.ids():
+                if sids is not None and sid not in sids:
+                    continue
                 sess = self.sessions.get(sid)
                 if sess.inflight > 0:
                     busy.append(sid)
@@ -411,7 +475,7 @@ class QrackService:
                 self.store.disown(sid)
                 self.sessions.release(sid)
                 drained.append(sid)
-            if not busy and self.lease_held:
+            if not busy and self.lease_held and not self.sessions.ids():
                 self.store.release_lease(self._owner)
                 self.lease_held = False
             if _tele._ENABLED:
@@ -434,6 +498,16 @@ class QrackService:
         job = Job(None, "admin", fn=self.program_manifest.prewarm)
         self.scheduler.submit(job)
         return job.handle.result(timeout)
+
+    def release_lease(self) -> bool:
+        """Drop the store's recovery lease if this service holds it.
+        Fleet workers (``hold_lease=False``) call this after any
+        adoption so a peer's next recover() is admitted immediately."""
+        if self.store is None or not self.lease_held:
+            return False
+        released = self.store.release_lease(self._owner)
+        self.lease_held = False
+        return released
 
     # -- introspection / lifecycle -------------------------------------
 

@@ -1,0 +1,249 @@
+"""Fleet soak: rolling restarts + random kill -9 under multi-tenant
+load, vs per-session CPU oracles.
+
+Each trial stands up a real 4-worker fleet (FleetSupervisor spawning
+``python -m qrack_tpu.fleet.worker`` subprocesses over one shared
+checkpoint store) and drives 2-4 dense sessions of width N plus one
+w40 Clifford session (the nearly-free placement class) through the
+FleetFrontDoor with interleaved random-unitary circuit streams.  While
+the load runs:
+
+* every trial launches a ROLLING RESTART from a background thread at
+  ~40% progress — all four workers drain, hand their sessions to peers
+  through the store, and come back warm, while applies keep landing;
+* odd trials additionally arm the ``fleet.worker:kill`` chaos monkey
+  (resilience/faults.py), so the monitor SIGKILLs a healthy worker
+  mid-load and the dead worker's sessions ride the adoption plane.
+
+The verdict is zero loss, not speed: every dense session's final state
+must match a QEngineCPU oracle that applied the same stream in order
+(fidelity > 1-1e-6 — a dropped, doubled, or reordered circuit anywhere
+in crash/adopt/replay shows up here), and the GHZ Clifford session's
+entangled-qubit probability must be exactly 1/2.  Latency is recorded,
+not judged: the JSON line carries per-apply p50/p99/max (the "blip"
+bound), resubmit/adoption counts from the exactly-once path, worker
+restart counts, and cold vs post-restart TTFR from the heartbeats
+(warm-artifact shipping makes the restarted number the warm one).
+
+Usage:
+    python scripts/fleet_soak.py [trials] [seed]
+
+Defaults: 8 trials, seed 0 (trials cost ~20-40s each — each one boots
+and restarts a real 4-process fleet).  Exit 0 = all trials zero-loss.
+One JSON line per trial; the slow-marked
+tests/test_fleet.py::test_fleet_soak_smoke runs a 1-trial slice in CI.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import (N, fidelity, resilience_down,  # noqa: E402
+                          resilience_up, soak_main)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import QEngineCPU  # noqa: E402
+from qrack_tpu import resilience as res  # noqa: E402
+from qrack_tpu.fleet import FleetFrontDoor, FleetSupervisor  # noqa: E402
+from qrack_tpu.layers.qcircuit import QCircuit  # noqa: E402
+from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
+from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
+
+N_WORKERS = 4
+CLIFF_W = 40          # far past any dense budget; ~free as a tableau
+CIRCUITS_PER_SESSION = 8
+
+_X = np.array([[0, 1], [1, 0]], dtype=np.complex128)
+
+
+def _rand_u2(rng) -> np.ndarray:
+    """Haar-ish random 2x2 unitary (QR of a random complex matrix)."""
+    m = rng.standard_normal((2, 2)) + 1j * rng.standard_normal((2, 2))
+    q, r = np.linalg.qr(m)
+    return q * (np.diag(r) / np.abs(np.diag(r)))
+
+
+def _rand_circuit(rng, n: int) -> QCircuit:
+    c = QCircuit(n)
+    for _ in range(int(rng.integers(2, 6))):
+        c.append_1q(int(rng.integers(0, n)), _rand_u2(rng))
+        if n > 1 and rng.random() < 0.5:
+            a, b = rng.choice(n, size=2, replace=False)
+            c.append_ctrl([int(a)], int(b), _X, 1)
+    return c
+
+
+def _ghz_circuit(n: int, chain: int) -> QCircuit:
+    c = QCircuit(n)
+    h = np.array([[1, 1], [1, -1]], dtype=np.complex128) / np.sqrt(2)
+    c.append_1q(0, h)
+    for q in range(chain - 1):
+        c.append_ctrl([q], q + 1, _X, 1)
+    return c
+
+
+def run_trial(trial: int, seed: int) -> dict:
+    rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
+    n_dense = 2 + trial % 3
+    with_kill = bool(trial % 2)
+    info = {"trial": trial, "sessions": n_dense + 1, "kill": with_kill}
+
+    resilience_up()
+    root = tempfile.mkdtemp(prefix=f"fleet-soak-{trial}-")
+    sup = None
+    try:
+        # aggressive control-plane cadence so death detection, backoff,
+        # and restart all land inside a soak-sized trial; the restart
+        # budget is deliberately loose (the soak WANTS restarts to
+        # succeed — quarantine has its own unit tests)
+        sup = FleetSupervisor(
+            N_WORKERS, root, layers="cpu",
+            beat_s=0.25, deadline_beats=4, tick_s=0.05,
+            restart_threshold=6, restart_cooldown_s=1.0,
+            backoff_base_s=0.05, stable_s=0.5,
+            ready_timeout_s=120.0).start()
+        front = FleetFrontDoor(sup)
+
+        # dense tenants with per-session CPU oracles
+        oracles, sids, streams = [], [], []
+        for k in range(n_dense):
+            sess_seed = (trial << 4) + k
+            sids.append(front.create_session(
+                N, layers="cpu", seed=sess_seed, rand_global_phase=False))
+            oracles.append(QEngineCPU(N, rng=QrackRandom(sess_seed),
+                                      rand_global_phase=False))
+            stream = []
+            for _ in range(CIRCUITS_PER_SESSION):
+                if rng.random() < 0.25:
+                    stream.append(qft_qcircuit(N))
+                else:
+                    stream.append(_rand_circuit(rng, N))
+            streams.append(stream)
+        for oracle, stream in zip(oracles, streams):
+            for circ in stream:
+                circ.Run(oracle)
+        # plus one wide Clifford tenant: placement prices it ~free, and
+        # a GHZ chain gives an analytic oracle at a width no ket fits
+        cliff_sid = front.create_session(CLIFF_W, layers="stabilizer",
+                                         seed=trial)
+
+        if with_kill:
+            # the monitor polls this site once per tick: fire the
+            # SIGKILL a beat or two into the apply phase, mid-load
+            res.faults.inject("fleet.worker", "kill",
+                              after_n=int(rng.integers(10, 30)), times=1)
+
+        total = sum(len(s) for s in streams) + 1
+        restart_at = max(1, int(total * 0.4))
+        roller = threading.Thread(target=lambda: info.__setitem__(
+            "rolling", {n: len(v["migrated"]) for n, v in
+                        sup.rolling_restart().items()}), daemon=True)
+
+        cursors = [0] * n_dense
+        live = [k for k in range(n_dense) if streams[k]]
+        lat, results, done = [], [], 0
+        cliff_pending = True
+        while live or cliff_pending:
+            if cliff_pending and (not live or rng.random() < 0.2):
+                sid, circ = cliff_sid, _ghz_circuit(CLIFF_W, 7)
+                cliff_pending = False
+            else:
+                k = live[int(rng.integers(0, len(live)))]
+                sid, circ = sids[k], streams[k][cursors[k]]
+                cursors[k] += 1
+                if cursors[k] >= len(streams[k]):
+                    live.remove(k)
+            t0 = time.perf_counter()
+            results.append(front.apply(sid, circ))
+            lat.append(time.perf_counter() - t0)
+            done += 1
+            if done == restart_at:
+                # cold TTFR: the first incarnations' first-result service
+                # latency, snapshotted before any of them restarts
+                cold = [w["beat"].get("ttfr_s")
+                        for w in sup.stats()["workers"].values()
+                        if w["beat"] and w["beat"].get("ttfr_s") is not None]
+                if cold:
+                    info["ttfr_cold_s"] = round(max(cold), 3)
+                roller.start()
+        roller.join(timeout=300)
+        if roller.is_alive():
+            raise TimeoutError("rolling restart did not finish in 300s")
+
+        # settle: every worker back to healthy before the verdict reads
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            states = {w["state"] for w in
+                      sup.stats()["workers"].values()}
+            if states == {"healthy"}:
+                break
+            time.sleep(0.1)
+
+        # one probe circuit per dense session AFTER the restarts, so the
+        # new incarnations each serve a submit and their heartbeats
+        # carry the warm (prewarmed-artifact) TTFR
+        for sid, oracle in zip(sids, oracles):
+            probe = _rand_circuit(rng, N)
+            probe.Run(oracle)
+            t0 = time.perf_counter()
+            results.append(front.apply(sid, probe))
+            lat.append(time.perf_counter() - t0)
+
+        fids = []
+        for sid, oracle in zip(sids, oracles):
+            b = np.asarray(front.get_state(sid))
+            with res.faults.suspended():
+                a = np.asarray(oracle.GetQuantumState())
+            fids.append(fidelity(a, b))
+        p_ghz = front.prob(cliff_sid, 6)
+        for sid in sids + [cliff_sid]:
+            front.destroy_session(sid)
+
+        time.sleep(0.6)  # two beats: let ttfr reach the heartbeat files
+        stats = sup.stats()["workers"]
+        lat.sort()
+        info["n_jobs"] = len(results)
+        info["resubmits"] = sum(r["resubmits"] for r in results)
+        info["adopted"] = sum(r["adopted"] for r in results)
+        info["fired"] = sum(sp.fired for sp in res.faults.specs())
+        info["crashes"] = sum(w["crashes"] for w in stats.values())
+        info["restarts"] = sum(w["restarts"] for w in stats.values())
+        info["lat_p50_ms"] = round(lat[len(lat) // 2] * 1e3, 3)
+        info["lat_p99_ms"] = round(lat[min(len(lat) - 1,
+                                           int(len(lat) * 0.99))] * 1e3, 3)
+        info["lat_max_ms"] = round(lat[-1] * 1e3, 3)
+        ttfr = [w["beat"].get("ttfr_s") for w in stats.values()
+                if w["beat"] and w["beat"].get("ttfr_s") is not None]
+        boot = [w["beat"].get("boot_s") for w in stats.values()
+                if w["beat"] and w["beat"].get("boot_s") is not None]
+        if ttfr:
+            info["ttfr_warm_s"] = round(max(ttfr), 3)
+        if boot:
+            info["boot_max_s"] = round(max(boot), 3)
+        info["fidelity_min"] = min(fids)
+        info["p_ghz"] = p_ghz
+        info["ok"] = bool(min(fids) > 1 - 1e-6
+                          and abs(p_ghz - 0.5) < 1e-9)
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if sup is not None:
+            sup.stop()
+        resilience_down()
+        shutil.rmtree(root, ignore_errors=True)
+    return info
+
+
+def main(argv) -> int:
+    return soak_main(argv, run_trial, default_trials=8)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -108,6 +108,7 @@ def report(snap: dict, top: int) -> dict:
         "checkpoint": {},
         "elastic": {},
         "integrity": {},
+        "fleet": {},
         "gauges": snap.get("gauges", {}),
         "layer_events": {},
         "spans": snap.get("spans", {}),
@@ -134,6 +135,8 @@ def report(snap: dict, top: int) -> dict:
             out["elastic"][k] = v
         elif k.startswith("integrity."):
             out["integrity"][k] = v
+        elif k.startswith("fleet."):
+            out["fleet"][k] = v
         elif k.split(".")[0] in ("qunit", "qunitmulti", "stabilizer",
                                  "qbdt", "hybrid", "factory", "engine",
                                  "cluster", "resilience"):
@@ -235,6 +238,10 @@ def main(argv=None) -> int:
     if rep["integrity"]:
         print("== integrity ==")
         for name, v in sorted(rep["integrity"].items()):
+            print(f"  {name:<40s} {v:>12.0f}")
+    if rep["fleet"]:
+        print("== fleet ==")
+        for name, v in sorted(rep["fleet"].items()):
             print(f"  {name:<40s} {v:>12.0f}")
     if rep["gauges"]:
         print("== gauges ==")

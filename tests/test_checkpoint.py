@@ -639,3 +639,125 @@ def test_serve_kill_and_recover_soak(tmp_path):
         _serve_phase(["crash", ck], tmp_path)
         _serve_phase(["recover", ck, out], tmp_path)
         assert np.array_equal(np.load(out), _serve_oracle(6, 7))
+
+
+# ---------------------------------------------------------------------------
+# fleet-era store semantics: bounded flock, skew-proof lease takeover
+# with zero double-replay, and WAL tag scans (docs/FLEET.md)
+# ---------------------------------------------------------------------------
+
+def test_store_lock_timeout_bounded(tmp_path, monkeypatch):
+    """A peer wedged under the manifest flock must not wedge every
+    healthy worker forever: acquisition is bounded by
+    QRACK_CKPT_LOCK_TIMEOUT_S and fails typed."""
+    import fcntl
+
+    from qrack_tpu.checkpoint import StoreLockTimeout
+    from qrack_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "store"))
+    monkeypatch.setenv("QRACK_CKPT_LOCK_TIMEOUT_S", "0.2")
+    holder = open(os.path.join(store.root, ".store.lock"), "a+")
+    fcntl.flock(holder.fileno(), fcntl.LOCK_EX)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(StoreLockTimeout):
+            store.acquire_lease("me")
+        assert time.monotonic() - t0 < 5.0  # bounded, not forever
+    finally:
+        fcntl.flock(holder.fileno(), fcntl.LOCK_UN)
+        holder.close()
+    assert store.acquire_lease("me")  # heals the moment the flock frees
+
+
+def _skew_circuits():
+    from qrack_tpu import matrices as m
+    from qrack_tpu.layers.qcircuit import QCircuit
+
+    c1 = QCircuit(3)
+    c1.append_1q(0, m.H2)
+    c1.append_ctrl([0], 1, m.X2, 1)
+    c2 = QCircuit(3)
+    c2.append_1q(2, m.H2)
+    c2.append_ctrl([2], 0, m.X2, 1)
+    return c1, c2
+
+
+def test_cross_host_lease_takeover_clock_skew_zero_double_replay(tmp_path):
+    """Cross-host takeover under clock skew: a foreign holder whose
+    clock ran AHEAD of ours (acquired_at in our future) left a lease
+    whose TTL has nonetheless expired — the adopter claims it, and the
+    wal_high high-water mark guarantees the journal entry whose effect
+    the dead holder already snapshotted is deduped, never replayed a
+    second time."""
+    from qrack_tpu.checkpoint.store import CheckpointStore
+    from qrack_tpu.serve import QrackService
+
+    ck = str(tmp_path / "ck")
+    store = CheckpointStore(ck)
+    c1, c2 = _skew_circuits()
+    # the dead holder's story: journaled both circuits, executed and
+    # snapshotted c1 (wal_high records it), died before settling c1's
+    # WAL entry or touching c2
+    store.register("s1", 3, "cpu", 9,
+                   engine_kwargs={"rand_global_phase": False})
+    p1 = store.wal_append("s1", c1)
+    seq1 = int(os.path.basename(p1).partition("-")[0])
+    store.wal_append("s1", c2)
+    eng = QEngineCPU(3, rng=QrackRandom(9), rand_global_phase=False)
+    c1.Run(eng)
+    store.save("s1", eng, wal_seq=seq1)
+    assert store.sessions()["s1"]["wal_high"] == seq1
+
+    def plant_lease(expires_in_s):
+        path = os.path.join(store.root, "manifest.json")
+        with open(path) as f:
+            m = json.load(f)
+        m["lease"] = {"owner": "far", "host": "elsewhere", "pid": 1,
+                      "acquired_at": time.time() + 3600,  # skewed clock
+                      "expires_at": time.time() + expires_in_s}
+        with open(path, "w") as f:
+            json.dump(m, f)
+
+    svc = QrackService(engine_layers="cpu", checkpoint_dir=ck,
+                       hold_lease=False, recover=False)
+    try:
+        # while the foreign lease is live, adoption is refused outright
+        from qrack_tpu.checkpoint import StoreLeaseHeld
+
+        plant_lease(60)
+        with pytest.raises(StoreLeaseHeld):
+            svc.recover()
+        # TTL expired (skew on acquired_at is irrelevant): claimed over
+        plant_lease(-1)
+        out = svc.recover()
+        assert out["sessions"] == ["s1"], out
+        assert out["wal_deduped"] == 1, out   # c1: snapshot already has it
+        assert out["wal_replayed"] == 1, out  # c2: exactly once
+        assert out["recovered_stale"] == [], out
+        oracle = QEngineCPU(3, rng=QrackRandom(9), rand_global_phase=False)
+        c1.Run(oracle)
+        c2.Run(oracle)
+        got = svc.call("s1", lambda e: e.GetQuantumState()).result(60)
+        assert np.array_equal(np.asarray(got),
+                              np.asarray(oracle.GetQuantumState()))
+        assert store.wal_entries() == []  # journal fully consumed
+    finally:
+        svc.close()
+
+
+def test_wal_pending_tags_scoped(tmp_path):
+    """The supervisor's pre-adoption scan: which exactly-once submit
+    tags were pending in a dead worker's journal, scoped to its sids."""
+    from qrack_tpu.checkpoint.store import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path / "store"))
+    c1, c2 = _skew_circuits()
+    store.wal_append("s1", c1, tag="t-alpha")
+    store.wal_append("s2", c2, tag="t-beta")
+    store.wal_append("s2", c1)  # untagged (library-path submit)
+    assert store.wal_pending_tags() == {"t-alpha", "t-beta"}
+    assert store.wal_pending_tags(sids=["s2"]) == {"t-beta"}
+    assert store.wal_pending_tags(sids=["nope"]) == set()
+    store.clear_wal(sids=["s2"])
+    assert store.wal_pending_tags() == {"t-alpha"}

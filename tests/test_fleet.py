@@ -1,0 +1,355 @@
+"""Fleet control plane: placement cost model, heartbeat liveness, the
+ndjson RPC codec, the SIGTERM→SIGKILL reap ladder, and the supervised
+kill→adopt→restart flow end-to-end with real worker subprocesses.
+
+The integration tests stand up small real fleets (2 workers over one
+shared checkpoint store) with aggressive control-plane cadence so
+death detection, adoption, and restart land in test time; the full
+randomized battery is scripts/fleet_soak.py (slow-marked smoke at the
+bottom runs a 2-trial slice).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu import matrices as mat
+from qrack_tpu import telemetry as tele
+from qrack_tpu.fleet import (FleetFrontDoor, FleetSupervisor,
+                             NoHealthyWorkers, Placement, session_cost)
+from qrack_tpu.fleet import heartbeat as hb
+from qrack_tpu.fleet import rpc
+from qrack_tpu.layers.qcircuit import QCircuit
+from qrack_tpu.resilience import faults
+from qrack_tpu.resilience.probe import reap_child
+from qrack_tpu.utils.rng import QrackRandom
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet():
+    faults.clear()
+    yield
+    faults.clear()
+    tele.disable()
+    tele.reset()
+
+
+def _bell(n=2):
+    c = QCircuit(n)
+    c.append_1q(0, mat.H2)
+    c.append_ctrl([0], 1, mat.X2, 1)
+    return c
+
+
+def _fidelity(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return float(abs(np.vdot(a, b)) ** 2 / (np.vdot(a, a).real
+                                            * np.vdot(b, b).real))
+
+
+# ---------------------------------------------------------------------------
+# placement cost model + bin packing
+# ---------------------------------------------------------------------------
+
+def test_session_cost_stabilizer_nearly_free_dense_budgeted():
+    # a w100 Clifford costs ~nothing; dense doubles per qubit until it
+    # owns a whole worker at the budget width
+    assert session_cost("stabilizer", 100) == pytest.approx(0.01)
+    assert session_cost(["unit", "stabilizer_hybrid"], 60) == \
+        pytest.approx(0.01)
+    assert session_cost("cpu", 22) == 1.0
+    assert session_cost("cpu", 30) == 1.0          # clamped
+    assert session_cost("cpu", 21) == 0.5
+    assert session_cost("cpu", 12) == 2.0 ** -10
+    assert session_cost("tpu", 20, budget_w=20) == 1.0  # explicit budget
+
+
+def test_session_cost_env_budget(monkeypatch):
+    monkeypatch.setenv("QRACK_FLEET_DENSE_BUDGET_W", "10")
+    assert session_cost("cpu", 10) == 1.0
+    monkeypatch.setenv("QRACK_FLEET_DENSE_BUDGET_W", "bogus")
+    assert session_cost("cpu", 22) == 1.0  # falls back to the default
+
+
+def test_placement_least_loaded_then_overflow():
+    p = Placement()
+    p.add_worker("a")
+    p.add_worker("b")
+    assert p.place("s1", "cpu", 22) in ("a", "b")       # cost 1.0
+    first = p.owner_of("s1")
+    other = "b" if first == "a" else "a"
+    assert p.place("s2", "cpu", 22) == other            # least-loaded
+    # both full: the overflow still lands (admission guidance, not a
+    # hard refusal) on a least-loaded worker
+    assert p.place("s3", "cpu", 22) in ("a", "b")
+    assert p.load(p.owner_of("s3")) >= 1.0
+
+
+def test_placement_state_gating_and_exclude():
+    p = Placement()
+    for n in ("a", "b", "c"):
+        p.add_worker(n)
+    p.set_state("a", "draining")
+    p.set_state("b", "quarantined")
+    assert p.place("s1", "cpu", 4) == "c"
+    p.set_state("c", "dead")
+    with pytest.raises(NoHealthyWorkers):
+        p.place("s2", "cpu", 4)
+    p.set_state("c", "healthy")
+    with pytest.raises(NoHealthyWorkers):
+        p.place("s2", "cpu", 4, exclude=["c"])
+    with pytest.raises(ValueError):
+        p.set_state("c", "zombie")
+
+
+def test_placement_evict_and_first_fit_decreasing():
+    p = Placement()
+    for n in ("a", "b"):
+        p.add_worker(n)
+    p.assign("big", "a", 0.9)
+    p.assign("t1", "a", 0.01)
+    p.assign("t2", "a", 0.01)
+    p.assign("peer", "b", 0.5)
+    evicted = p.evict("a")
+    assert sorted(sid for sid, _ in evicted) == ["big", "t1", "t2"]
+    assert p.owner_of("big") is None and p.sessions_on("a") == []
+    p.set_state("a", "dead")
+    mapping = p.place_all(evicted, exclude=["a"])
+    # FFD: the big one placed first, everything lands on b
+    assert mapping == {"big": "b", "t1": "b", "t2": "b"}
+    assert p.load("b") == pytest.approx(0.5 + 0.9 + 0.02)
+    p.release("big")
+    assert p.owner_of("big") is None
+
+
+# ---------------------------------------------------------------------------
+# heartbeat liveness
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_atomic_write_read_age(tmp_path):
+    path = str(tmp_path / "w.hb")
+    assert hb.read_heartbeat(path) is None          # missing = no beat
+    hb.write_heartbeat(path, {"pid": os.getpid(), "t": time.time()})
+    rec = hb.read_heartbeat(path)
+    assert rec["pid"] == os.getpid()
+    assert hb.beat_age_s(path) < 5.0
+    with open(path, "w") as f:
+        f.write('{"pid": 1, "t"')                   # torn record
+    assert hb.read_heartbeat(path) is None
+    assert hb.beat_age_s(path) is None
+
+
+def test_heartbeat_writer_beats_and_hang_fault(tmp_path):
+    path = str(tmp_path / "w.hb")
+    w = hb.HeartbeatWriter(path, interval_s=60,
+                           info_fn=lambda: {"ready": True})
+    assert w.beat() is True
+    rec = hb.read_heartbeat(path)
+    assert rec["ready"] is True and rec["seq"] == 1
+    # the injected wedge: the site acts it out by NOT beating, while
+    # the process (here: us) keeps running
+    faults.inject("fleet.heartbeat", "hang")
+    assert w.beat() is False
+    assert hb.read_heartbeat(path)["seq"] == 1      # file untouched
+    faults.clear()
+    assert w.beat() is True
+    assert hb.read_heartbeat(path)["seq"] == 2
+
+
+def test_fleet_fault_sites_parse():
+    assert faults.parse_spec("fleet.worker:kill:0").kind == "kill"
+    assert faults.parse_spec("fleet.heartbeat:hang:3").site == \
+        "fleet.heartbeat"
+    with pytest.raises(ValueError):
+        faults.load_env("fleet.bogus:kill:0")
+
+
+def test_pid_alive():
+    assert hb.pid_alive(os.getpid())
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    assert not hb.pid_alive(p.pid)
+
+
+# ---------------------------------------------------------------------------
+# RPC codec + framing
+# ---------------------------------------------------------------------------
+
+def test_rpc_circuit_codec_round_trip():
+    a = QEngineCPU(2, rng=QrackRandom(3), rand_global_phase=False)
+    b = QEngineCPU(2, rng=QrackRandom(3), rand_global_phase=False)
+    circ = _bell()
+    circ.Run(a)
+    rpc.decode_circuit(rpc.encode_circuit(circ)).Run(b)
+    assert np.array_equal(np.asarray(a.GetQuantumState()),
+                          np.asarray(b.GetQuantumState()))
+
+
+def test_rpc_array_codec_round_trip():
+    x = (np.arange(8) - 4 + 1j * np.arange(8)).astype(np.complex128)
+    y = rpc.decode_array(rpc.encode_array(x))
+    assert y.dtype == x.dtype and np.array_equal(x, y)
+
+
+def test_rpc_frames_over_socketpair():
+    import socket as socketlib
+
+    a, b = socketlib.socketpair()
+    fa, fb = a.makefile("rwb"), b.makefile("rwb")
+    rpc.send_frame(fa, {"op": "ping", "n": 3})
+    assert rpc.recv_frame(fb) == {"op": "ping", "n": 3}
+    fa.close(); a.close()
+    with pytest.raises(rpc.FleetRPCError):
+        rpc.recv_frame(fb)  # peer vanished mid-protocol
+    fb.close(); b.close()
+
+
+# ---------------------------------------------------------------------------
+# reap ladder (resilience/probe.py)
+# ---------------------------------------------------------------------------
+
+def test_reap_child_sigterm_suffices():
+    p = subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(60)"])
+    r = reap_child(p, term_grace_s=10.0)
+    assert not r.killed and not r.abandoned
+    assert p.poll() is not None
+
+
+def test_reap_child_escalates_to_sigkill():
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         "import signal, sys, time\n"
+         "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+         "print('R', flush=True)\n"
+         "time.sleep(60)"], stdout=subprocess.PIPE)
+    assert p.stdout.read(1) == b"R"  # handler installed before reaping
+    r = reap_child(p, term_grace_s=0.3)
+    assert r.killed and not r.abandoned
+    assert p.returncode == -signal.SIGKILL
+
+
+# ---------------------------------------------------------------------------
+# supervised fleet end-to-end (real worker subprocesses)
+# ---------------------------------------------------------------------------
+
+def _mini_fleet(tmp_path, n=2, **kw):
+    kw.setdefault("beat_s", 0.2)
+    kw.setdefault("deadline_beats", 4)
+    kw.setdefault("tick_s", 0.05)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("restart_cooldown_s", 1.0)
+    kw.setdefault("stable_s", 0.3)
+    kw.setdefault("ready_timeout_s", 120.0)
+    return FleetSupervisor(n, str(tmp_path / "fleet"), layers="cpu", **kw)
+
+
+def _wait_states(sup, want, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        states = {w["state"] for w in sup.stats()["workers"].values()}
+        if states == want:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"fleet never reached {want}: {sup.stats()}")
+
+
+def test_fleet_kill9_adopt_restart_zero_loss(tmp_path):
+    """The acceptance flow: kill -9 the worker that owns a session
+    mid-stream — the next apply rides adoption onto a peer with the
+    exact state (fidelity 1 vs an uninterrupted CPU oracle), and the
+    dead worker restarts back to healthy on its breaker budget."""
+    with _mini_fleet(tmp_path) as sup:
+        sup.start()
+        front = FleetFrontDoor(sup)
+        sid = front.create_session(2, seed=11, rand_global_phase=False)
+        oracle = QEngineCPU(2, rng=QrackRandom(11), rand_global_phase=False)
+        front.apply(sid, _bell())
+        _bell().Run(oracle)
+
+        owner = sup.owner_of(sid)
+        os.kill(sup.stats()["workers"][owner]["pid"], signal.SIGKILL)
+        # the very next apply must land exactly once despite the death
+        front.apply(sid, _bell())
+        _bell().Run(oracle)
+        assert sup.owner_of(sid) != owner            # adopted by a peer
+        assert _fidelity(oracle.GetQuantumState(),
+                         front.get_state(sid)) > 1 - 1e-12
+        _wait_states(sup, {"healthy"})               # victim restarted
+        st = sup.stats()["workers"][owner]
+        assert st["crashes"] == 1 and st["restarts"] >= 1
+        front.destroy_session(sid)
+
+
+def test_fleet_rolling_restart_migrates_live_session(tmp_path):
+    with _mini_fleet(tmp_path) as sup:
+        sup.start()
+        front = FleetFrontDoor(sup)
+        sid = front.create_session(2, seed=5, rand_global_phase=False)
+        oracle = QEngineCPU(2, rng=QrackRandom(5), rand_global_phase=False)
+        front.apply(sid, _bell())
+        _bell().Run(oracle)
+        out = sup.rolling_restart()
+        assert set(out) == set(sup.worker_names())
+        assert sum(len(v["migrated"]) for v in out.values()) >= 1
+        # the session survived both restarts with exact state
+        front.apply(sid, _bell())
+        _bell().Run(oracle)
+        assert _fidelity(oracle.GetQuantumState(),
+                         front.get_state(sid)) > 1 - 1e-12
+        _wait_states(sup, {"healthy"})
+
+
+def test_fleet_flapping_worker_quarantined_then_probed(tmp_path):
+    """Restart budget: a worker SIGKILLed on every comeback trips its
+    breaker and is QUARANTINED (placement stops offering it); after
+    the cooldown the half-open breaker admits exactly one probe
+    restart, and a stable probe closes the budget again."""
+    # stable_s long enough that the breaker can't close (and reset its
+    # failure count) between the two kills
+    with _mini_fleet(tmp_path, restart_threshold=2,
+                     restart_cooldown_s=1.5, stable_s=30.0) as sup:
+        sup.start()
+        victim = sup.worker_names()[0]
+
+        seen_quarantine = False
+        kills = 0
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = sup.stats()["workers"][victim]
+            if st["state"] == "quarantined":
+                seen_quarantine = True
+                break
+            if st["state"] == "healthy" and kills < 2:
+                os.kill(st["pid"], signal.SIGKILL)
+                kills += 1
+                time.sleep(0.3)
+            time.sleep(0.05)
+        assert seen_quarantine, sup.stats()
+        # the probe restart brings it back without human intervention
+        _wait_states(sup, {"healthy"}, timeout_s=90)
+        assert sup.stats()["workers"][victim]["crashes"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# randomized soak (short slice; the full run is scripts/fleet_soak.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_soak_smoke():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_soak", os.path.join(os.path.dirname(__file__),
+                                   "..", "scripts", "fleet_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    results = [soak.run_trial(t, seed=123) for t in range(2)]
+    bad = [r for r in results if not r["ok"]]
+    assert not bad, bad
