@@ -24,7 +24,9 @@ behind it:
 * **retryable reads** — reads that lose their connection re-route and
   re-ask; a read that lands after an adoption executes against the
   restored snapshot (rng stream included), so retried measurements
-  stay deterministic.
+  stay deterministic.  A typed SessionNotFound from a routed worker
+  retries the same way: it means "not adopted HERE yet" (a migration
+  race), not "gone" — placement owns sid existence.
 
 The front door holds no engine, no jax, no store — it is pure
 routing, importable anywhere.
@@ -52,6 +54,13 @@ class SessionUnroutable(RuntimeError):
         self.sid = sid
 
 
+def _session_not_found(e: FleetRemoteError) -> bool:
+    """A worker-side typed refusal that means "not adopted HERE yet",
+    not "gone": the fleet owns sid existence (placement), so a routed
+    worker lacking the session is a migration race, retryable."""
+    return e.etype == "SessionNotFound"
+
+
 class FleetFrontDoor:
     def __init__(self, supervisor,
                  route_timeout_s: float = DEFAULT_ROUTE_TIMEOUT_S):
@@ -72,7 +81,16 @@ class FleetFrontDoor:
 
     def _retrying(self, sid: str, fn, timeout_s: Optional[float] = None):
         """Run `fn(client)` against the sid's live owner, re-routing on
-        transport death — the idempotent-call path (reads, destroys)."""
+        transport death — the idempotent-call path (reads, destroys).
+
+        A typed SessionNotFound retries too: routing can point at an
+        adopter whose scoped recovery has not landed yet (adoption
+        retry in flight, or a read racing evict→adopt during a rolling
+        restart).  The session exists fleet-wide — the worker just
+        does not hold it THIS instant — so the front door re-asks
+        until the deadline instead of leaking the remote error to the
+        tenant.  Unknown sids never reach here: routing has no owner
+        for them, so :meth:`_client` times out first."""
         deadline = time.monotonic() + (timeout_s or self.route_timeout_s)
         while True:
             client = self._client(sid, deadline)
@@ -81,10 +99,15 @@ class FleetFrontDoor:
             except FleetRPCError:
                 if _tele._ENABLED:
                     _tele.inc("fleet.frontdoor.reroute")
-                if time.monotonic() >= deadline:
-                    raise SessionUnroutable(sid, timeout_s
-                                            or self.route_timeout_s)
-                time.sleep(0.05)
+            except FleetRemoteError as e:
+                if not _session_not_found(e):
+                    raise
+                if _tele._ENABLED:
+                    _tele.inc("fleet.frontdoor.not_adopted_yet")
+            if time.monotonic() >= deadline:
+                raise SessionUnroutable(sid, timeout_s
+                                        or self.route_timeout_s)
+            time.sleep(0.05)
 
     # -- sessions ------------------------------------------------------
 
@@ -144,6 +167,18 @@ class FleetFrontDoor:
             try:
                 client.submit(sid, circuit, tag=tag)
                 return {"resubmits": resubmits, "adopted": False}
+            except FleetRemoteError as e:
+                if not _session_not_found(e):
+                    raise
+                # routed to an adopter that has not recovered the
+                # session yet; nothing journaled (the refusal precedes
+                # the WAL append) — wait for adoption, same tag
+                if _tele._ENABLED:
+                    _tele.inc("fleet.frontdoor.not_adopted_yet")
+                if time.monotonic() >= deadline:
+                    raise SessionUnroutable(sid, timeout_s
+                                            or self.route_timeout_s)
+                time.sleep(0.05)
             except FleetRPCError as e:
                 landed = self._submit_landed(
                     sid, tag, bool(getattr(e, "journaled", False)),

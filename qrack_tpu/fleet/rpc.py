@@ -128,9 +128,16 @@ class FleetClient:
     transport death — the front door's signal to consult placement —
     and :class:`FleetRemoteError` for typed worker-side refusals."""
 
-    def __init__(self, socket_path: str, timeout_s: float = 120.0):
+    def __init__(self, socket_path: str, timeout_s: float = 120.0,
+                 result_timeout_s: float = 3600.0):
         self.socket_path = socket_path
         self.timeout_s = timeout_s
+        # the wait for a submit's RESULT frame is bounded separately:
+        # after the journaled frame, the socket is waiting on job
+        # EXECUTION, not transport — a legitimate long-running job must
+        # not surface as FleetRPCError(journaled=True), which the front
+        # door would report "adopted" while the job is still in flight
+        self.result_timeout_s = result_timeout_s
 
     def _connect(self):
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -158,7 +165,9 @@ class FleetClient:
         """Two-frame submit.  Returns ``(journaled, result_frame)``;
         raises FleetRPCError with ``journaled`` recoverable from the
         exception's ``.journaled`` attribute when the connection dies
-        between the frames."""
+        between the frames.  The result frame waits under
+        ``result_timeout_s`` (execution time), not ``timeout_s``
+        (transport time) — see ``__init__``."""
         s = self._connect()
         journaled = False
         try:
@@ -167,6 +176,7 @@ class FleetClient:
                            "circuit": encode_circuit(circuit)})
             first = _unwrap(recv_frame(f))
             journaled = bool(first.get("journaled"))
+            s.settimeout(self.result_timeout_s)
             return journaled, _unwrap(recv_frame(f))
         except FleetRPCError as e:
             e.journaled = journaled

@@ -127,6 +127,11 @@ class FleetSupervisor:
         self._workers: Dict[str, WorkerHandle] = {}
         self._adopted_tags: set = set()
         self._migrating: set = set()               # sids between owners
+        # adoption batches whose adopter RPC failed: (adopter, sids,
+        # not_before) — retried from the monitor tick until the sids
+        # either adopt or move (their owner died and eviction re-placed
+        # them); the sids stay in _migrating meanwhile so routing waits
+        self._adopt_pending: List[Tuple[str, List[str], float]] = []
         self._session_meta: Dict[str, tuple] = {}  # sid -> (layers, width)
         self._kill_rr = 0
         self._stop = threading.Event()
@@ -267,6 +272,7 @@ class FleetSupervisor:
             self._maybe_restart(h)
         for h in probes:
             self._maybe_probe_restart(h)
+        self._retry_pending_adoptions()
 
     def _beat_age(self, h: WorkerHandle) -> Optional[float]:
         rec = read_heartbeat(h.hb_path)
@@ -302,22 +308,26 @@ class FleetSupervisor:
 
     # -- death / adoption / restart ------------------------------------
 
+    def _record_crash(self, h: WorkerHandle) -> None:
+        """Account one crash against `h`'s restart budget and arm the
+        exponential respawn backoff.  Caller holds the lock.  Quarantine
+        is decided at restart time by the breaker, not here."""
+        h.crashes += 1
+        h.consecutive_crashes += 1
+        h.breaker.record_failure(site=f"fleet.{h.name}")
+        delay = min(
+            self.backoff_base_s * (2 ** (h.consecutive_crashes - 1)),
+            DEFAULT_BACKOFF_CAP_S)
+        h.next_restart_at = time.monotonic() + delay
+
     def _on_death(self, h: WorkerHandle, reason: str) -> None:
         with self._lock:
             if self.placement.state(h.name) == "dead":
                 return  # already handled
-            h.crashes += 1
-            h.consecutive_crashes += 1
-            h.breaker.record_failure(site=f"fleet.{h.name}")
+            self._record_crash(h)
             self.placement.set_state(h.name, "dead")
             evicted = self.placement.evict(h.name)
             self._migrating |= {sid for sid, _ in evicted}
-            # exponential backoff before respawn; quarantine is decided
-            # at restart time by the breaker, not here
-            delay = min(
-                self.backoff_base_s * (2 ** (h.consecutive_crashes - 1)),
-                DEFAULT_BACKOFF_CAP_S)
-            h.next_restart_at = time.monotonic() + delay
         if _tele._ENABLED:
             _tele.event("fleet.worker.dead", worker=h.name, reason=reason,
                         crashes=h.crashes)
@@ -342,26 +352,64 @@ class FleetSupervisor:
         for sid, name in mapping.items():
             by_adopter.setdefault(name, []).append(sid)
         for name, batch in sorted(by_adopter.items()):
-            out = self._adopt_batch(self._workers[name], batch)
+            self._adopt_assigned(name, batch, source=dead.name)
+
+    def _adopt_assigned(self, name: str, batch: List[str],
+                        source: Optional[str] = None,
+                        timeout_s: float = 60.0) -> bool:
+        """Run the adoption RPC for a batch already assigned to `name`
+        in placement.  On success the sids leave the migrating set; on
+        failure they STAY in it (routing keeps answering "wait", never
+        a session-not-found to the tenant) and the batch is queued for
+        monitor-tick retry — if the adopter instead dies, eviction
+        re-places the sids and the stale retry entry drops itself."""
+        out = self._adopt_batch(self._workers[name], batch,
+                                timeout_s=timeout_s)
+        if out is None:
             with self._lock:
-                self._migrating -= set(batch)
-            if out is None:
-                # adopter is also failing: leave the batch assigned to
-                # it — when it dies, eviction re-places the sids again
-                # (self-healing); routing meanwhile returns typed
-                # remote errors the front door retries on
-                if _tele._ENABLED:
-                    _tele.event("fleet.adopt.failed", adopter=name,
-                                sids=batch)
-                continue
+                self._adopt_pending.append(
+                    (name, list(batch), time.monotonic() + 1.0))
             if _tele._ENABLED:
-                _tele.inc("fleet.adopt.sessions", len(batch))
-                _tele.event("fleet.adopt", adopter=name,
-                            source=dead.name,
-                            sessions=len(out.get("sessions", [])),
-                            wal_replayed=out.get("wal_replayed", 0),
-                            wal_deduped=out.get("wal_deduped", 0),
-                            wal_skipped=out.get("wal_skipped", 0))
+                _tele.event("fleet.adopt.failed", adopter=name,
+                            sids=batch)
+            return False
+        with self._lock:
+            self._migrating -= set(batch)
+        if _tele._ENABLED:
+            _tele.inc("fleet.adopt.sessions", len(batch))
+            _tele.event("fleet.adopt", adopter=name, source=source,
+                        sessions=len(out.get("sessions", [])),
+                        wal_replayed=out.get("wal_replayed", 0),
+                        wal_deduped=out.get("wal_deduped", 0),
+                        wal_skipped=out.get("wal_skipped", 0))
+        return True
+
+    def _retry_pending_adoptions(self) -> None:
+        """Monitor-tick half of :meth:`_adopt_assigned`'s failure path.
+        Short per-attempt timeout: this runs on the monitor thread, and
+        death detection must not stall behind a wedged adopter."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._adopt_pending:
+                return
+            due = [(n, b) for n, b, t in self._adopt_pending if t <= now]
+            self._adopt_pending = [e for e in self._adopt_pending
+                                   if e[2] > now]
+        for name, batch in due:
+            with self._lock:
+                # only sids still assigned to this adopter: anything
+                # re-placed by an eviction belongs to a newer adoption
+                # flow, which owns their migrating-set membership
+                live = [sid for sid in batch
+                        if self.placement.owner_of(sid) == name]
+                healthy = self.placement.state(name) == "healthy"
+            if not live:
+                continue
+            if not healthy:
+                with self._lock:
+                    self._adopt_pending.append((name, live, now + 1.0))
+                continue
+            self._adopt_assigned(name, live, timeout_s=5.0)
 
     def _adopt_batch(self, adopter: WorkerHandle, sids: List[str],
                      timeout_s: float = 60.0) -> Optional[dict]:
@@ -414,8 +462,19 @@ class FleetSupervisor:
             try:
                 self.wait_ready([h.name], timeout_s=self.ready_timeout_s)
             except (TimeoutError, RuntimeError):
-                h.next_restart_at = 0.0  # next tick: breaker decides
-                self._on_death(h, reason="boot-failure")
+                # placement is already "dead" here, so _on_death's
+                # already-handled guard would swallow this crash —
+                # record it against the breaker budget directly, or a
+                # worker that fails every boot respawns each tick
+                # forever and is never quarantined.  (No eviction or
+                # adoption needed: the sessions left at death time.)
+                if h.proc is not None and h.proc.poll() is None:
+                    reap_child(h.proc)  # wedged mid-boot: don't leak it
+                with self._lock:
+                    self._record_crash(h)
+                if _tele._ENABLED:
+                    _tele.event("fleet.worker.dead", worker=h.name,
+                                reason="boot-failure", crashes=h.crashes)
                 return
             with self._lock:
                 self.placement.set_state(h.name, "healthy")
@@ -462,9 +521,7 @@ class FleetSupervisor:
         for sid, adopter in migrated.items():
             by_adopter.setdefault(adopter, []).append(sid)
         for adopter, batch in sorted(by_adopter.items()):
-            self._adopt_batch(self._workers[adopter], batch)
-            with self._lock:
-                self._migrating -= set(batch)
+            self._adopt_assigned(adopter, batch, source=name)
         self._respawn(h)
         if _tele._ENABLED:
             _tele.event("fleet.rolling_restart.worker", worker=name,
@@ -526,6 +583,8 @@ class FleetSupervisor:
                     "beat": read_heartbeat(h.hb_path),
                 } for name, h in self._workers.items()},
                 "migrating": sorted(self._migrating),
+                "adopt_pending": sum(len(b) for _, b, _ in
+                                     self._adopt_pending),
                 "adopted_tags": len(self._adopted_tags),
             }
 

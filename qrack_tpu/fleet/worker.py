@@ -6,9 +6,11 @@ one supervised serving process:
 * the service is built ``hold_lease=False`` (the shared store's lease
   is only taken around adoption, never parked — N workers share one
   checkpoint dir) and ``checkpoint_every_job=True`` (every completed
-  circuit job lands a snapshot before its WAL entry settles, so a
-  kill -9 at ANY instant is recoverable with zero loss — the wal_high
-  high-water mark dedups the snapshot-then-settle window);
+  mutating job — circuit, or a collapsing/rng-consuming read like
+  measure_all — lands a snapshot at settle, circuits before their WAL
+  entry is removed, so a kill -9 at ANY instant is recoverable with
+  zero loss — the wal_high high-water mark dedups the
+  snapshot-then-settle window);
 * warm artifacts are fleet-wide: the store dir carries the shared XLA
   cache and ProgramManifest, and ``QRACK_SERVE_PREWARM=1`` (set by the
   supervisor) pre-traces recorded shapes at boot so a restarted

@@ -343,7 +343,13 @@ class Executor:
         if job.session is not None:
             job.session.end_job(ok)
             if ok and self.sessions.spill_store is not None:
-                if (self.checkpoint_every_job and job.kind == "circuit"
+                # circuits always advance the state; "call" jobs carry
+                # an explicit flag (MAll/sampling mutate — collapse or
+                # rng draw — Prob/GetQuantumState do not).  A pure read
+                # leaves the snapshot valid: neither dirty nor re-saved.
+                mutated = (job.kind == "circuit"
+                           or (job.kind == "call" and job.mutates))
+                if (self.checkpoint_every_job and mutated
                         and job.session.engine is not None):
                     # snapshot BEFORE the WAL entry below is settled,
                     # recording this job's journal seq as the snapshot's
@@ -351,7 +357,12 @@ class Executor:
                     # pending entry onto the clean pre-job snapshot;
                     # kill -9 after it finds the entry deduped against
                     # wal_high — the job lands exactly once either way.
-                    # A failed save leaves the dirty path below intact.
+                    # Mutating calls snapshot too (no WAL entry, so no
+                    # wal_high bump): skipping them would leave the
+                    # manifest dirty, flip recovery to the stale path,
+                    # and silently drop any journaled-but-unexecuted
+                    # circuit at adoption despite its acked journaled
+                    # frame.  A failed save leaves the dirty path intact.
                     wal_seq = None
                     if job.wal_path is not None:
                         import os as _os
@@ -367,7 +378,7 @@ class Executor:
                     except Exception:  # noqa: BLE001 — fall back to dirty
                         self.sessions.spill_store.mark_dirty(
                             job.session.sid)
-                else:
+                elif mutated:
                     # the session's live state has advanced past whatever
                     # is (or isn't) on disk; recovery keys off this flag
                     # to refuse WAL replay onto a wrong base (no-op when

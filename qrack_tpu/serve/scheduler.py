@@ -95,11 +95,12 @@ class JobHandle:
 
 class Job:
     __slots__ = ("session", "kind", "circuit", "fn", "shape_key",
-                 "priority", "seq", "handle", "wal_path")
+                 "priority", "seq", "handle", "wal_path", "mutates")
 
     def __init__(self, session: Optional[Session], kind: str, *,
                  circuit=None, fn: Optional[Callable] = None,
-                 shape_key=None, priority: int = 0):
+                 shape_key=None, priority: int = 0,
+                 mutates: bool = True):
         self.session = session
         self.kind = kind          # "circuit" | "call" | "admin"
         self.circuit = circuit
@@ -109,6 +110,12 @@ class Job:
         self.seq = 0              # assigned by the scheduler
         self.handle = JobHandle(session.sid if session else "-", kind)
         self.wal_path = None      # journal entry to settle (checkpointing)
+        # does settling this job advance the session past its on-disk
+        # snapshot?  Circuits always do; "call" jobs that collapse state
+        # or consume the rng stream (MAll, sampling) do too, while pure
+        # reads (Prob, GetQuantumState) leave the snapshot valid.
+        # Conservative default: unknown fns are assumed mutating.
+        self.mutates = mutates
 
     @property
     def batchable(self) -> bool:

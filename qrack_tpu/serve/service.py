@@ -38,14 +38,17 @@ blocks on a JobHandle.  Env knobs (constructor args override):
                                    lease across serving — it is taken
                                    around recover()/adoption only
                                    (fleet workers; docs/FLEET.md)
-* ``QRACK_SERVE_CKPT_EVERY_JOB``   "1": snapshot a session's state
-                                   BEFORE settling each completed
-                                   circuit job's WAL entry, so a
-                                   kill -9 at ANY instant leaves either
-                                   a clean snapshot + pending entry
-                                   (replay exact) or a snapshot that
-                                   already contains the job — never a
-                                   stale base (docs/FLEET.md)
+* ``QRACK_SERVE_CKPT_EVERY_JOB``   "1": snapshot a session's state at
+                                   each mutating job's settle — BEFORE
+                                   a circuit job's WAL entry is
+                                   removed, and after collapsing /
+                                   rng-consuming reads (measure_all,
+                                   sample) — so a kill -9 at ANY
+                                   instant leaves either a clean
+                                   snapshot + pending entry (replay
+                                   exact) or a snapshot that already
+                                   contains the job — never a stale
+                                   base (docs/FLEET.md)
 
 See docs/SERVING.md for the architecture and the load-shedding
 semantics; serving is NOT imported by ``import qrack_tpu`` so the
@@ -255,12 +258,22 @@ class QrackService:
                 job.wal_path = None
             raise
 
-    def call(self, sid: str, fn: Callable, priority: int = 0) -> JobHandle:
+    def call(self, sid: str, fn: Callable, priority: int = 0,
+             mutates: bool = True) -> JobHandle:
         """Queue an arbitrary engine call `fn(engine)` — the escape
         hatch every synchronous read routes through, so reads share the
-        dispatch owner with circuit traffic."""
+        dispatch owner with circuit traffic.
+
+        `mutates=False` declares `fn` a pure read (no collapse, no rng
+        draw): the session's on-disk snapshot stays valid across it, so
+        checkpointing neither dirties nor re-snapshots the session.  A
+        mutating call under ``checkpoint_every_job`` snapshots at settle
+        exactly like a circuit job — otherwise a measure that collapses
+        state after the last snapshot would silently flip the session
+        to the stale-recovery path and drop any journaled-but-pending
+        circuit at adoption (docs/FLEET.md).  Default: mutating."""
         sess = self.sessions.get(sid)
-        job = Job(sess, "call", fn=fn, priority=priority)
+        job = Job(sess, "call", fn=fn, priority=priority, mutates=mutates)
         sess.begin_job()
         try:
             return self.scheduler.submit(job)
@@ -275,11 +288,13 @@ class QrackService:
     # -- synchronous reads (all via the dispatch owner) ----------------
 
     def get_state(self, sid: str, timeout: Optional[float] = 120.0):
-        return self.call(sid, lambda eng: eng.GetQuantumState()
-                         ).result(timeout)
+        return self.call(sid, lambda eng: eng.GetQuantumState(),
+                         mutates=False).result(timeout)
 
     def measure_all(self, sid: str, timeout: Optional[float] = 120.0) -> int:
-        return self.call(sid, lambda eng: eng.MAll()).result(timeout)
+        # MAll collapses the state AND advances the rng stream
+        return self.call(sid, lambda eng: eng.MAll(),
+                         mutates=True).result(timeout)
 
     def sample(self, sid: str, shots: int, qubits=None,
                timeout: Optional[float] = 120.0):
@@ -287,11 +302,15 @@ class QrackService:
             qs = range(eng.qubit_count) if qubits is None else qubits
             return eng.MultiShotMeasureMask([1 << q for q in qs], shots)
 
-        return self.call(sid, do).result(timeout)
+        # non-collapsing, but the categorical draws consume the rng
+        # stream — a snapshot from before the sample would replay with
+        # a rewound stream, so it counts as mutating
+        return self.call(sid, do, mutates=True).result(timeout)
 
     def prob(self, sid: str, qubit: int,
              timeout: Optional[float] = 120.0) -> float:
-        return self.call(sid, lambda eng: eng.Prob(qubit)).result(timeout)
+        return self.call(sid, lambda eng: eng.Prob(qubit),
+                         mutates=False).result(timeout)
 
     # -- checkpoint / recovery -----------------------------------------
 

@@ -761,3 +761,56 @@ def test_wal_pending_tags_scoped(tmp_path):
     assert store.wal_pending_tags(sids=["nope"]) == set()
     store.clear_wal(sids=["s2"])
     assert store.wal_pending_tags() == {"t-alpha"}
+
+
+def test_ckpt_every_job_mutating_read_keeps_journal_replayable(tmp_path):
+    """An acked journaled submit must survive a crash even when a
+    state-collapsing read (measure_all) settled after the last circuit
+    snapshot.  The mutating call re-snapshots at settle — if it merely
+    marked the manifest dirty, recovery would take the stale path and
+    DROP the pending entry (wal_skipped) while the fleet front door
+    trusts frame-1 "journaled" as "effect will be applied"."""
+    from qrack_tpu.serve import QrackService
+
+    ck = str(tmp_path / "ck")
+    c1, c2 = _skew_circuits()
+    svc = QrackService(engine_layers="cpu", checkpoint_dir=ck,
+                       hold_lease=False, recover=False,
+                       checkpoint_every_job=True)
+    try:
+        sid = svc.create_session(3, seed=9, rand_global_phase=False)
+        svc.apply(sid, c1)
+        m = svc.measure_all(sid)
+        # serialize past the measure's settle (the handle resolves just
+        # before accounting; any later job's result orders after it),
+        # and confirm pure reads leave the snapshot valid too
+        svc.prob(sid, 0)
+        svc.get_state(sid)
+        assert svc.store.is_dirty(sid) is False
+        # the crash story: c2 journaled (the fleet's frame-1 ack fired
+        # client-side) but never executed — the worker dies here
+        svc.store.wal_append(sid, c2, tag="t-c2")
+        svc.scheduler.stop()
+        svc.executor.stop()
+
+        adopter = QrackService(engine_layers="cpu", checkpoint_dir=ck,
+                               hold_lease=False, recover=False)
+        try:
+            out = adopter.recover(sids=[sid])
+            assert out["sessions"] == [sid], out
+            assert out["wal_replayed"] == 1, out  # c2 lands exactly once
+            assert out["wal_skipped"] == 0, out   # never silently dropped
+            assert out["recovered_stale"] == [], out
+            oracle = QEngineCPU(3, rng=QrackRandom(9),
+                                rand_global_phase=False)
+            c1.Run(oracle)
+            assert oracle.MAll() == m  # same rng stream, same collapse
+            c2.Run(oracle)
+            got = adopter.call(sid, lambda e: e.GetQuantumState(),
+                               mutates=False).result(60)
+            assert np.array_equal(np.asarray(got),
+                                  np.asarray(oracle.GetQuantumState()))
+        finally:
+            adopter.close()
+    finally:
+        svc.close()

@@ -236,6 +236,164 @@ def test_reap_child_escalates_to_sigkill():
 
 
 # ---------------------------------------------------------------------------
+# control-plane units: boot-failure budget, adoption retry, routing retry
+# ---------------------------------------------------------------------------
+
+def test_boot_failure_counts_against_restart_budget(tmp_path, monkeypatch):
+    """A worker that crashes during every boot must consume its restart
+    budget (real backoff, eventual quarantine), not respawn every
+    monitor tick forever: placement already reads "dead" when _respawn
+    runs, so _on_death's already-handled guard would swallow the crash
+    — the boot-failure path has to record it directly."""
+    sup = _mini_fleet(tmp_path, n=1, restart_threshold=2)
+    h = sup._workers["w0"]
+    monkeypatch.setattr(sup, "_spawn", lambda h: None)
+
+    def never_ready(names=None, timeout_s=0.0):
+        raise RuntimeError("worker w0 exited rc=1 during boot")
+
+    monkeypatch.setattr(sup, "wait_ready", never_ready)
+    sup.placement.set_state("w0", "dead")   # how _respawn is reached
+    t0 = time.monotonic()
+    sup._respawn(h)
+    assert h.crashes == 1
+    assert h.next_restart_at > t0           # armed backoff, not 0.0
+    assert h.breaker.snapshot()["consecutive_failures"] == 1
+    sup._respawn(h)
+    assert h.crashes == 2
+    # budget exhausted: the next restart attempt quarantines instead
+    sup._maybe_restart(h)
+    assert sup.placement.state("w0") == "quarantined"
+
+
+def test_failed_adoption_keeps_sid_migrating_then_retries(
+        tmp_path, monkeypatch):
+    """An adoption RPC failure must not strip the sids from the
+    migrating set (routing would then hand tenants a SessionNotFound
+    from the not-yet-adopter): they stay migrating — route() answers
+    "wait" — and the monitor tick re-attempts until adoption lands."""
+    sup = _mini_fleet(tmp_path, n=2)
+    sup.placement.assign("s1", "w0", 0.5)
+    sup._migrating.add("s1")
+    attempts = {"n": 0}
+
+    def flaky(h, sids, timeout_s=60.0):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            return None
+        return {"sessions": list(sids), "wal_replayed": 0,
+                "wal_deduped": 0, "wal_skipped": 0}
+
+    monkeypatch.setattr(sup, "_adopt_batch", flaky)
+    assert sup._adopt_assigned("w0", ["s1"]) is False
+    assert "s1" in sup._migrating           # routing keeps waiting
+    assert sup.route("s1") is None
+    assert sup.stats()["adopt_pending"] == 1
+    # make the queued retry due now, then run the monitor-tick half
+    sup._adopt_pending = [(n, b, 0.0) for n, b, _ in sup._adopt_pending]
+    sup._retry_pending_adoptions()
+    assert attempts["n"] == 2
+    assert "s1" not in sup._migrating
+    assert sup.route("s1") is not None
+
+
+class _StubSup:
+    """Just enough supervisor for front-door routing-retry units."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def route(self, sid):
+        return self._client
+
+    def tag_adopted(self, tag):
+        return False
+
+
+def test_frontdoor_retries_session_not_found_until_adoption():
+    """Mid-migration race: routing points at an adopter whose scoped
+    recovery has not landed yet — its typed SessionNotFound means "not
+    adopted HERE yet" and must retry against routing, not leak to the
+    tenant (the no-visible-error migration contract, docs/FLEET.md)."""
+    calls = {"n": 0}
+
+    class _Adopting:
+        def prob(self, sid, qubit):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise rpc.FleetRemoteError("SessionNotFound", sid)
+            return 0.5
+
+    front = FleetFrontDoor(_StubSup(_Adopting()), route_timeout_s=10.0)
+    assert front.prob("s1", 0) == 0.5
+    assert calls["n"] == 3
+
+
+def test_frontdoor_apply_retries_session_not_found():
+    calls = {"n": 0}
+
+    class _Adopting:
+        def submit(self, sid, circuit, tag=None):
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise rpc.FleetRemoteError("SessionNotFound", sid)
+            return True, {"ok": True}
+
+    front = FleetFrontDoor(_StubSup(_Adopting()), route_timeout_s=10.0)
+    out = front.apply("s1", _bell())
+    assert out == {"resubmits": 0, "adopted": False}
+    assert calls["n"] == 2
+
+
+def test_frontdoor_other_remote_errors_still_raise():
+    """Only the session-not-found class retries; every other typed
+    worker refusal (bad qubit index, draining, ...) surfaces at once."""
+
+    class _Typed:
+        def prob(self, sid, qubit):
+            raise rpc.FleetRemoteError("ValueError", "qubit out of range")
+
+    front = FleetFrontDoor(_StubSup(_Typed()), route_timeout_s=2.0)
+    with pytest.raises(rpc.FleetRemoteError):
+        front.prob("s1", 0)
+
+
+def test_submit_result_frame_not_bounded_by_transport_timeout(tmp_path):
+    """A job legitimately outrunning the transport timeout must not
+    surface as FleetRPCError(journaled=True) — the front door would
+    report it adopted while it is still executing.  The result frame
+    waits under result_timeout_s instead."""
+    import socket as socketlib
+    import threading
+
+    path = str(tmp_path / "w.sock")
+    server = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    server.bind(path)
+    server.listen(1)
+
+    def serve():
+        conn, _ = server.accept()
+        f = conn.makefile("rwb")
+        rpc.recv_frame(f)
+        rpc.send_frame(f, {"ok": True, "journaled": True})
+        time.sleep(0.8)          # "execution" outlasting timeout_s
+        rpc.send_frame(f, {"ok": True, "value": 7})
+        f.close()
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        client = rpc.FleetClient(path, timeout_s=0.3,
+                                 result_timeout_s=30.0)
+        journaled, rep = client.submit("s1", _bell(), tag="t")
+        assert journaled and rep["value"] == 7
+    finally:
+        t.join(5)
+        server.close()
+
+
+# ---------------------------------------------------------------------------
 # supervised fleet end-to-end (real worker subprocesses)
 # ---------------------------------------------------------------------------
 
