@@ -158,8 +158,33 @@ class FleetFrontDoor:
               timeout_s: Optional[float] = None) -> dict:
         """Apply `circuit` to `sid` exactly once, riding out worker
         death mid-submit.  Returns ``{"resubmits": n, "adopted": bool}``
-        describing how the effect landed."""
+        describing how the effect landed.
+
+        The submit's fresh tag doubles as its distributed-trace id: it
+        is already minted per submit, already rides the WAL entry, and
+        rpc.py forwards it in every frame — so the front door's
+        ``frontdoor.apply`` span, the worker's journal/result spans and
+        the executor's ``serve.execute`` span all correlate on one id
+        in the merged fleet trace."""
         tag = uuid.uuid4().hex
+        if not _tele._ENABLED:
+            return self._apply_loop(sid, circuit, tag, timeout_s)
+        prev_trace = _tele.set_trace(tag)
+        t0 = time.perf_counter()
+        try:
+            with _tele.span("frontdoor.apply"):
+                out = self._apply_loop(sid, circuit, tag, timeout_s)
+            # the tenant-observed submit wall (routing + RPC + queue +
+            # execution + any mid-submit adoption) — the fleet-level
+            # SLO distribution, vs the worker-local serve.latency
+            _tele.observe("fleet.frontdoor.apply",
+                          time.perf_counter() - t0)
+            return out
+        finally:
+            _tele.set_trace(prev_trace)
+
+    def _apply_loop(self, sid: str, circuit, tag: str,
+                    timeout_s: Optional[float]) -> dict:
         deadline = time.monotonic() + (timeout_s or self.route_timeout_s)
         resubmits = 0
         while True:

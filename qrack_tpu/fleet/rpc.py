@@ -19,8 +19,17 @@ one whose connection dies BEFORE it consults the dead worker's
 pending-tag set (:meth:`CheckpointStore.wal_pending_tags`) through
 the supervisor before deciding (docs/FLEET.md).
 
+Every frame carries the caller thread's distributed-trace context
+(``"trace": <id>``) when telemetry is enabled and a trace is set: the
+front door mints one id per submit, the worker adopts it for the
+request's spans/events, and the merged fleet exporter
+(telemetry/export.py merged_chrome_trace) correlates them back onto
+one timeline.  The field costs nothing when telemetry is off (one
+module-bool read) and is ignored by workers that never look.
+
 Deliberately stdlib+numpy only at import: the client side must be
-importable from a front door that never builds an engine.
+importable from a front door that never builds an engine (telemetry
+is pure stdlib).
 """
 
 from __future__ import annotations
@@ -31,6 +40,8 @@ import socket
 from typing import Optional, Tuple
 
 import numpy as np
+
+from .. import telemetry as _tele
 
 # bound a single frame: a w26 complex128 state is ~1 GiB — anything
 # bigger than this is a protocol bug, not a payload
@@ -152,6 +163,10 @@ class FleetClient:
 
     def request(self, obj: dict) -> dict:
         """Single-frame exchange; unwraps the ok/error envelope."""
+        if _tele._ENABLED and "trace" not in obj:
+            tid = _tele.current_trace()
+            if tid is not None:
+                obj["trace"] = tid
         s = self._connect()
         try:
             f = s.makefile("rwb")
@@ -170,10 +185,15 @@ class FleetClient:
         (transport time) — see ``__init__``."""
         s = self._connect()
         journaled = False
+        req = {"op": "submit", "sid": sid, "tag": tag,
+               "circuit": encode_circuit(circuit)}
+        if _tele._ENABLED:
+            tid = _tele.current_trace()
+            if tid is not None:
+                req["trace"] = tid
         try:
             f = s.makefile("rwb")
-            send_frame(f, {"op": "submit", "sid": sid, "tag": tag,
-                           "circuit": encode_circuit(circuit)})
+            send_frame(f, req)
             first = _unwrap(recv_frame(f))
             journaled = bool(first.get("journaled"))
             s.settimeout(self.result_timeout_s)
@@ -223,6 +243,12 @@ class FleetClient:
 
     def stats(self) -> dict:
         return self.request({"op": "stats"})["stats"]
+
+    def info(self) -> dict:
+        """Live worker introspection: identity + a telemetry snapshot
+        (counters, gauges, histogram summaries) without waiting for a
+        heartbeat flush."""
+        return self.request({"op": "info"})["info"]
 
     def shutdown(self) -> None:
         self.request({"op": "shutdown"})

@@ -43,6 +43,8 @@ soak uses); ``fleet.heartbeat:hang`` is acted out worker-side.
 
 from __future__ import annotations
 
+import glob
+import json
 import os
 import subprocess
 import sys
@@ -134,6 +136,21 @@ class FleetSupervisor:
         self._adopt_pending: List[Tuple[str, List[str], float]] = []
         self._session_meta: Dict[str, tuple] = {}  # sid -> (layers, width)
         self._kill_rr = 0
+        # fleet observability plane: latest heartbeat-flushed telemetry
+        # snapshot per worker INCARNATION (name, pid) — cumulative
+        # snapshots keyed by incarnation merge correctly across
+        # restarts with no delta/sequence bookkeeping — plus the
+        # postmortem ring filled from dead workers' black boxes
+        self._worker_tele: Dict[Tuple[str, int], dict] = {}
+        self._postmortems: List[dict] = []
+        self._postmortem_cap = 32
+        self.blackbox_dir = os.path.join(self.store_dir, "blackbox")
+        self.telemetry_path = (os.environ.get("QRACK_FLEET_TELEMETRY_OUT")
+                               or os.path.join(self.root,
+                                               "fleet_telemetry.jsonl"))
+        self._tele_flush_s = float(
+            os.environ.get("QRACK_FLEET_TELEMETRY_FLUSH_S", "5.0"))
+        self._tele_last_flush = time.monotonic()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         # supervisor-side read-only store view (pending-tag scans);
@@ -159,6 +176,11 @@ class FleetSupervisor:
         # XLA cache + ProgramManifest, and every worker pre-traces at
         # boot — a restarted worker's TTFR is the warm number
         env.setdefault("QRACK_SERVE_PREWARM", "1")
+        # enabling telemetry in the supervisor process lights up the
+        # whole fleet plane: workers inherit the gate, flush snapshots
+        # through their heartbeats, and keep flight recorders
+        if _tele._ENABLED:
+            env.setdefault("QRACK_TPU_TELEMETRY", "1")
         env.update(self.extra_env)
         for p in (h.hb_path, h.socket_path):
             try:
@@ -273,6 +295,7 @@ class FleetSupervisor:
         for h in probes:
             self._maybe_probe_restart(h)
         self._retry_pending_adoptions()
+        self._maybe_flush_metrics()
 
     def _beat_age(self, h: WorkerHandle) -> Optional[float]:
         rec = read_heartbeat(h.hb_path)
@@ -281,6 +304,13 @@ class FleetSupervisor:
             # no beat from THIS incarnation yet: boot liveness is
             # covered by the pid check + wait_ready, not beat age
             return None
+        snap = rec.get("telemetry")
+        if snap is not None:
+            # the liveness read doubles as the metrics ingest: no extra
+            # RPC, no extra file — the beat we already parse carries
+            # the worker's cumulative snapshot
+            with self._lock:
+                self._worker_tele[(h.name, int(rec["pid"]))] = snap
         return time.time() - float(rec.get("t", 0.0))
 
     def _maybe_inject_kill(self) -> None:
@@ -333,6 +363,9 @@ class FleetSupervisor:
                         crashes=h.crashes)
         if evicted:
             self._adopt_from(h, evicted)
+        # autopsy AFTER adoption: tenant-visible migration latency owns
+        # the fast path; the black box is durable and can wait
+        self._collect_blackbox(h, reason)
 
     def _adopt_from(self, dead: WorkerHandle,
                     evicted: List[Tuple[str, float]]) -> None:
@@ -475,6 +508,7 @@ class FleetSupervisor:
                 if _tele._ENABLED:
                     _tele.event("fleet.worker.dead", worker=h.name,
                                 reason="boot-failure", crashes=h.crashes)
+                self._collect_blackbox(h, "boot-failure")
                 return
             with self._lock:
                 self.placement.set_state(h.name, "healthy")
@@ -571,6 +605,111 @@ class FleetSupervisor:
     def worker_names(self) -> List[str]:
         return sorted(self._workers)
 
+    # -- fleet observability plane -------------------------------------
+
+    def metrics(self, write: bool = False) -> dict:
+        """Fleet-wide telemetry: every worker incarnation's heartbeat-
+        flushed snapshot (cumulative, keyed (name, pid) so restarts sum
+        rather than double-count) merged with the supervisor process's
+        own — counters summed, histograms folded cell-wise, SLO gauges
+        (p50/p95/p99) recomputed from the MERGED distributions.  With
+        ``write=True`` the record is appended to the fleet JSONL
+        (``telemetry_path``) for ``telemetry_report.py --fleet``."""
+        with self._lock:
+            incarnations = {f"{name}:{pid}": snap for (name, pid), snap
+                            in self._worker_tele.items()}
+            postmortems = list(self._postmortems)
+        sources = list(incarnations.values())
+        if _tele.enabled():
+            sources.append(_tele.snapshot(include_events=False))
+        merged = _tele.merge_snapshots(sources)
+        per_worker = {}
+        for key, snap in incarnations.items():
+            c = snap.get("counters") or {}
+            lat = (snap.get("hists") or {}).get("serve.latency")
+            summ = {"jobs_completed": c.get("serve.jobs.completed", 0)}
+            if lat:
+                h = _tele.Histogram.from_dict(lat)
+                summ["serve.latency"] = {"count": h.count,
+                                         "p50": h.percentile(50),
+                                         "p99": h.percentile(99)}
+            per_worker[key] = summ
+        out = {"kind": "fleet", "t_wall": time.time(), **merged,
+               "workers": per_worker, "postmortems": postmortems}
+        if write:
+            self._append_fleet_jsonl(out)
+        return out
+
+    def _maybe_flush_metrics(self) -> None:
+        """Monitor-tick half of the fleet JSONL: one merged record per
+        flush interval, only while the plane is actually live."""
+        if not (_tele._ENABLED or self._worker_tele):
+            return
+        now = time.monotonic()
+        if now - self._tele_last_flush < self._tele_flush_s:
+            return
+        self._tele_last_flush = now
+        try:
+            self.metrics(write=True)
+        except Exception:  # noqa: BLE001 — metrics must not stop the monitor
+            if _tele._ENABLED:
+                _tele.inc("fleet.metrics.flush_error")
+
+    def _append_fleet_jsonl(self, record: dict) -> None:
+        try:
+            with open(self.telemetry_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except (OSError, TypeError, ValueError):
+            pass  # the journal is evidence, never a failure source
+
+    def _collect_blackbox(self, h: WorkerHandle, reason: str,
+                          last_n: int = 16) -> None:
+        """Autopsy a dead incarnation: recover its flight-recorder box
+        (at most one beat stale — the worker flushes per heartbeat) and
+        keep what it was doing when it died in the postmortem ring, the
+        stats surface, and the fleet journal."""
+        pid = h.pid
+        if pid is None:
+            return
+        box = _tele.read_blackbox(
+            os.path.join(self.blackbox_dir, f"{h.name}-{pid}.json"))
+        if box is None:
+            return  # telemetry off, or death before the first flush
+        post = {"kind": "postmortem", "worker": h.name, "pid": pid,
+                "reason": reason, "t_wall": time.time(),
+                "flush_seq": box.get("flush_seq"),
+                "epoch_unix_s": box.get("epoch_unix_s"),
+                "last_events": (box.get("events") or [])[-last_n:],
+                "last_spans": (box.get("spans") or [])[-last_n:]}
+        with self._lock:
+            self._postmortems.append(post)
+            del self._postmortems[:-self._postmortem_cap]
+        if _tele._ENABLED:
+            _tele.event("fleet.worker.blackbox", worker=h.name, pid=pid,
+                        reason=reason,
+                        events=len(box.get("events") or []))
+        self._append_fleet_jsonl(post)
+
+    def trace_sources(self) -> List[dict]:
+        """Merge sources for the fleet timeline: the supervisor/front-
+        door process's live rings plus every worker incarnation's black
+        box (live workers' boxes are at most one beat stale; dead ones
+        are their last moments)."""
+        sources = []
+        if _tele.enabled():
+            sources.append(_tele.local_trace_source("frontdoor"))
+        for p in sorted(glob.glob(
+                os.path.join(self.blackbox_dir, "*.json"))):
+            box = _tele.read_blackbox(p)
+            if box is not None:
+                sources.append(box)
+        return sources
+
+    def write_merged_trace(self, path: str) -> str:
+        """One Perfetto-loadable timeline for the whole fleet (one
+        track per worker incarnation; submit trace ids in span args)."""
+        return _tele.write_merged_chrome_trace(path, self.trace_sources())
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -586,6 +725,7 @@ class FleetSupervisor:
                 "adopt_pending": sum(len(b) for _, b, _ in
                                      self._adopt_pending),
                 "adopted_tags": len(self._adopted_tags),
+                "postmortems": list(self._postmortems),
             }
 
     # -- lifecycle -----------------------------------------------------
@@ -597,6 +737,11 @@ class FleetSupervisor:
         for h in self._workers.values():
             if h.proc is not None and h.proc.poll() is None:
                 reap_child(h.proc)
+        if _tele._ENABLED or self._worker_tele:
+            try:
+                self.metrics(write=True)  # final fleet journal record
+            except Exception:  # noqa: BLE001
+                pass
 
     def __enter__(self) -> "FleetSupervisor":
         return self

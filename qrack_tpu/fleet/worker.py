@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import telemetry as _tele
 from .rpc import (decode_circuit, encode_array, recv_frame, send_frame,
                   FleetRPCError)
 from .heartbeat import DEFAULT_INTERVAL_S, HeartbeatWriter
@@ -48,6 +49,7 @@ _T0 = time.perf_counter()
 
 class _WorkerState:
     def __init__(self):
+        self.name = None
         self.ready = False
         self.ttfr_s: Optional[float] = None
         self.boot_s: Optional[float] = None
@@ -68,15 +70,25 @@ def _handle(svc, state: _WorkerState, conn) -> bool:
     except FleetRPCError:
         return True  # client connected and vanished; nothing owed
     op = req.get("op")
+    # adopt the caller's distributed-trace context for this request:
+    # every span/event this connection thread records (and every job it
+    # submits — scheduler.Job captures the submitting thread's trace)
+    # correlates back to the front door's id
+    prev_trace = _tele.set_trace(req.get("trace")) if _tele._ENABLED \
+        else None
     try:
-        if op == "submit":
-            return _handle_submit(svc, state, f, req)
-        rep = _dispatch(svc, state, op, req)
-    except Exception as e:  # noqa: BLE001 — typed errors cross as frames
-        _send_err(f, e)
-        return True
-    send_frame(f, {"ok": True, **rep})
-    return op != "shutdown"
+        try:
+            if op == "submit":
+                return _handle_submit(svc, state, f, req)
+            rep = _dispatch(svc, state, op, req)
+        except Exception as e:  # noqa: BLE001 — typed errors cross as frames
+            _send_err(f, e)
+            return True
+        send_frame(f, {"ok": True, **rep})
+        return op != "shutdown"
+    finally:
+        if _tele._ENABLED:
+            _tele.set_trace(prev_trace)
 
 
 def _handle_submit(svc, state: _WorkerState, f, req) -> bool:
@@ -85,7 +97,10 @@ def _handle_submit(svc, state: _WorkerState, f, req) -> bool:
     tag = req.get("tag")
     t0 = time.perf_counter()
     try:
-        handle = svc.submit(sid, circuit, tag=tag)
+        # span 1 of the submit's worker-side trace: WAL append +
+        # admission (ends the instant the entry is durable)
+        with _tele.span("worker.submit.journal"):
+            handle = svc.submit(sid, circuit, tag=tag)
     except Exception as e:  # noqa: BLE001
         _send_err(f, e)
         return True
@@ -95,7 +110,10 @@ def _handle_submit(svc, state: _WorkerState, f, req) -> bool:
     # exactly-once pivot (rpc.py) — after this frame, never resubmit
     send_frame(f, {"ok": True, "journaled": True})
     try:
-        handle.result(None)
+        # span 2: queue wait + execution + honest devget (the executor
+        # nests its own serve.execute span inside this window)
+        with _tele.span("worker.submit.result"):
+            handle.result(None)
     except Exception as e:  # noqa: BLE001
         _send_err(f, e)
         return True
@@ -145,6 +163,13 @@ def _dispatch(svc, state: _WorkerState, op: str, req: dict) -> dict:
     if op == "stats":
         return {"stats": json.loads(json.dumps(
             svc.stats(), default=str))}
+    if op == "info":
+        return {"info": {
+            "name": state.name, "pid": os.getpid(),
+            "ready": state.ready, "draining": state.draining,
+            "sessions": len(svc.sessions.ids()),
+            "ttfr_s": state.ttfr_s, "boot_s": state.boot_s,
+            "telemetry": _tele.snapshot(include_events=False)}}
     if op == "shutdown":
         return {}
     raise ValueError(f"unknown op {op!r}")
@@ -182,9 +207,13 @@ def main(argv=None) -> int:
     ap.add_argument("--beat-s", type=float, default=DEFAULT_INTERVAL_S)
     ap.add_argument("--engine-kwargs", default="{}",
                     help="JSON dict of default engine kwargs")
+    ap.add_argument("--blackbox-dir", default=None,
+                    help="flight-recorder dir (default <store>/blackbox; "
+                         "written only while telemetry is enabled)")
     args = ap.parse_args(argv)
 
     state = _WorkerState()
+    state.name = args.name
     from ..serve.service import QrackService
 
     layers = args.layers.split(",") if "," in args.layers else args.layers
@@ -194,12 +223,34 @@ def main(argv=None) -> int:
                        recover=False,
                        **json.loads(args.engine_kwargs))
 
+    # flight recorder: one black box per worker INCARNATION (pid in the
+    # filename — a restart must not overwrite the corpse the supervisor
+    # autopsies); flushed on every heartbeat so it is at most one beat
+    # stale at kill -9
+    recorder = None
+    if _tele.enabled():
+        bb_dir = args.blackbox_dir or os.path.join(args.store, "blackbox")
+        recorder = _tele.FlightRecorder(
+            os.path.join(bb_dir, f"{args.name}-{os.getpid()}.json"),
+            name=args.name)
+
     def info():
-        return {"name": args.name, "ready": state.ready,
-                "draining": state.draining,
-                "sessions": len(svc.sessions.ids()),
-                "ttfr_s": state.ttfr_s,
-                "boot_s": state.boot_s}
+        rec = {"name": args.name, "ready": state.ready,
+               "draining": state.draining,
+               "sessions": len(svc.sessions.ids()),
+               "ttfr_s": state.ttfr_s,
+               "boot_s": state.boot_s}
+        if _tele._ENABLED:
+            # cumulative snapshot (not deltas): the supervisor keys the
+            # latest record per (worker, pid) incarnation, so merges
+            # stay correct across restarts without sequence bookkeeping
+            rec["telemetry"] = _tele.snapshot(include_events=False)
+            if recorder is not None:
+                try:
+                    recorder.flush()
+                except OSError:
+                    pass  # a full disk must not kill the beat thread
+        return rec
 
     hb = HeartbeatWriter(args.heartbeat, interval_s=args.beat_s,
                          info_fn=info).start()
@@ -225,6 +276,11 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, on_sigterm)
     state.ready = True
     state.boot_s = time.perf_counter() - _T0
+    if _tele._ENABLED:
+        # seed the flight recorder: even a worker killed before serving
+        # anything leaves a non-empty event tail for the postmortem
+        _tele.event("worker.ready", worker=args.name, pid=os.getpid(),
+                    boot_s=round(state.boot_s, 3))
     hb.beat()
 
     try:
@@ -247,6 +303,11 @@ def main(argv=None) -> int:
         _graceful_drain(svc)
         svc.close()
         hb.stop(final_beat=True)
+        if recorder is not None:
+            try:
+                recorder.flush()  # graceful exits leave a fresh box too
+            except OSError:
+                pass
         try:
             os.unlink(args.socket)
         except OSError:

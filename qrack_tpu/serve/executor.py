@@ -222,7 +222,11 @@ class Executor:
         # must roll the engines back or the failover replay would apply
         # the circuit twice (scripts/serve_soak.py caught exactly this)
         pre_planes = [eng.device_planes for eng in engines]
-        span = _tele.span("serve.execute") if _tele._ENABLED else None
+        # a batch spans tenants; the trace id of its HEAD job labels the
+        # span (co-batched jobs still correlate via their own latency
+        # observes and the worker-side submit spans)
+        span = (_tele.span("serve.execute", trace=jobs[0].trace)
+                if _tele._ENABLED else None)
         try:
             if span:
                 span.__enter__()
@@ -294,7 +298,7 @@ class Executor:
             return job.fn(sess.engine)
 
         try:
-            with _tele.span("serve.execute"):
+            with _tele.span("serve.execute", trace=job.trace):
                 result = body()
         except FAILOVER_ERRORS as e:
             # engine-internal guarded sites escalated: walk the session
@@ -395,4 +399,35 @@ class Executor:
             if h.queue_wait_s is not None:
                 _tele.observe("serve.queue_wait", h.queue_wait_s)
             if h.latency_s is not None:
-                _tele.observe("serve.latency", h.latency_s)
+                lat = h.latency_s
+                _tele.observe("serve.latency", lat)
+                # the same t_submit->t_done interval on the trace ring:
+                # one bar per job on the merged fleet timeline, and a
+                # raw-duration reference the bucketed serve.latency
+                # gauges can be checked against
+                _tele.record_span("serve.job", h.t_submit, lat,
+                                  trace=job.trace)
+                sess = job.session
+                if sess is not None:
+                    # per-tenant + per-routed-stack SLO labels; the hist
+                    # name space is capped (telemetry._HIST_CAP) so a
+                    # tenant churn storm cannot grow memory unboundedly
+                    _tele.observe(f"serve.latency.tenant.{sess.sid}", lat)
+                    _tele.observe(
+                        f"serve.latency.stack.{_stack_label(sess)}", lat)
+
+
+def _stack_label(sess) -> str:
+    """The session's routed stack for SLO labeling: the router's live
+    decision when the engine is routed, its configured layers spec
+    otherwise."""
+    cur = getattr(sess.engine, "current_stack", None)
+    if callable(cur):
+        try:
+            return cur() or "pending"
+        except Exception:  # noqa: BLE001 — labels must never fail a job
+            return "pending"
+    layers = getattr(sess, "layers", None)
+    if isinstance(layers, (list, tuple)):
+        return "+".join(str(l) for l in layers)
+    return str(layers)

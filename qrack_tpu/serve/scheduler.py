@@ -95,7 +95,8 @@ class JobHandle:
 
 class Job:
     __slots__ = ("session", "kind", "circuit", "fn", "shape_key",
-                 "priority", "seq", "handle", "wal_path", "mutates")
+                 "priority", "seq", "handle", "wal_path", "mutates",
+                 "trace")
 
     def __init__(self, session: Optional[Session], kind: str, *,
                  circuit=None, fn: Optional[Callable] = None,
@@ -116,6 +117,11 @@ class Job:
         # reads (Prob, GetQuantumState) leave the snapshot valid.
         # Conservative default: unknown fns are assumed mutating.
         self.mutates = mutates
+        # distributed-trace id, captured from the SUBMITTING thread
+        # (the worker RPC thread sets it from the frame's trace field);
+        # the executor pins it back onto serve.execute spans so a
+        # submit is one correlated trace across processes
+        self.trace = _tele.current_trace() if _tele._ENABLED else None
 
     @property
     def batchable(self) -> bool:

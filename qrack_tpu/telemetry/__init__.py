@@ -11,14 +11,24 @@ Three surfaces:
 
 * **counters** — :func:`inc` monotonic named counters (gate dispatches
   by kind/width/engine, compile-cache hits/misses/evictions, pager
-  exchange events + bytes, layer escalations).
+  exchange events + bytes, layer escalations).  :func:`observe` feeds a
+  named duration into both the span aggregate and a merge-able
+  log-bucket :class:`~qrack_tpu.telemetry.histogram.Histogram`, so
+  :func:`percentile` can answer p50/p95/p99 SLO questions per process
+  and — after the supervisor merges heartbeat-flushed snapshots —
+  fleet-wide (docs/OBSERVABILITY.md "Fleet observability plane").
 * **spans** — ``with telemetry.span("qft.w28", sync=planes):`` nestable
   wall-clock timers.  With ``sync=`` the exit is bracketed by a real
   1-amplitude ``jax.device_get`` read and the empty-queue round trip is
   subtracted — the utils/timing.py methodology, because
   ``block_until_ready`` over the axon relay acks dispatch, not
   completion (docs/TPU_EVIDENCE.md).  A span without ``sync=`` is
-  host-wall only and is marked ``synced: False`` in the trace.
+  host-wall only and is marked ``synced: False`` in the trace.  Spans
+  and events carry the thread's current distributed-trace id
+  (:func:`set_trace` / :func:`current_trace`) so per-process traces can
+  be correlated across a fleet; timestamps are relative to the import
+  epoch, whose wall-clock anchor (``epoch_unix_s``) rides in every
+  snapshot so exporters can merge processes onto one timeline.
 * **export** — :func:`snapshot` (plain dict), :func:`write_jsonl`
   (atexit-armed via ``QRACK_TPU_TELEMETRY_OUT=path``),
   :func:`chrome_trace` (Perfetto-loadable trace-event JSON), and
@@ -38,14 +48,20 @@ from __future__ import annotations
 import os
 import threading
 import time
-from collections import OrderedDict
-from typing import Dict, List, Optional
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional
+
+from .histogram import Histogram
 
 __all__ = [
-    "enabled", "enable", "disable", "inc", "event", "span", "observe",
-    "gauge", "snapshot", "reset", "write_jsonl", "chrome_trace",
-    "write_chrome_trace", "xplane_bracket", "instrument_jit",
-    "ProgramCache",
+    "enabled", "enable", "disable", "inc", "event", "span", "record_span",
+    "observe",
+    "gauge", "percentile", "set_trace", "current_trace", "snapshot",
+    "merge_snapshots",
+    "reset", "write_jsonl", "chrome_trace", "write_chrome_trace",
+    "merged_chrome_trace", "write_merged_chrome_trace",
+    "local_trace_source", "xplane_bracket", "instrument_jit",
+    "ProgramCache", "Histogram", "FlightRecorder", "read_blackbox",
 ]
 
 # single hot-path gate: instrumentation sites read this module attribute
@@ -54,17 +70,27 @@ __all__ = [
 _ENABLED: bool = os.environ.get("QRACK_TPU_TELEMETRY", "") not in ("", "0")
 
 _LOCK = threading.Lock()
-_EPOCH = time.perf_counter()  # trace timestamps are relative to import
+# trace timestamps are relative to import; the wall clock sampled at the
+# same instant anchors them to an absolute timeline (epoch_unix_s in
+# every snapshot / black box) so N processes' traces can be merged
+_EPOCH = time.perf_counter()
+_EPOCH_WALL = time.time()
+
+_TRACE_CAP = int(os.environ.get("QRACK_TPU_TELEMETRY_TRACE_CAP", "65536"))
+_EVENT_CAP = int(os.environ.get("QRACK_TPU_TELEMETRY_EVENT_CAP", "4096"))
+_HIST_CAP = int(os.environ.get("QRACK_TPU_TELEMETRY_HIST_CAP", "1024"))
 
 _COUNTERS: Dict[str, float] = {}
 _GAUGES: Dict[str, float] = {}        # name -> last observed value
 _SPANS: Dict[str, List[float]] = {}   # name -> [count, total_s, min_s, max_s]
-_TRACE: List[dict] = []               # chrome-trace "X" complete events
-_EVENTS: List[dict] = []              # discrete annotated events
-_TRACE_CAP = int(os.environ.get("QRACK_TPU_TELEMETRY_TRACE_CAP", "65536"))
-_EVENT_CAP = int(os.environ.get("QRACK_TPU_TELEMETRY_EVENT_CAP", "4096"))
+_HISTS: Dict[str, Histogram] = {}     # name -> log-bucket distribution
+# both rings drop OLDEST on overflow (drops counted): the tail is what a
+# postmortem needs — the black box must hold what the worker was doing
+# when it died, not what it did at boot
+_TRACE: Deque[dict] = deque(maxlen=_TRACE_CAP)  # chrome-trace "X" events
+_EVENTS: Deque[dict] = deque(maxlen=_EVENT_CAP)  # discrete annotated events
 
-_TLS = threading.local()  # per-thread span stack (nesting depth)
+_TLS = threading.local()  # per-thread span stack (nesting depth) + trace id
 
 
 def enabled() -> bool:
@@ -87,13 +113,17 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Drop all recorded data (counters, spans, traces, events)."""
+    """Drop all recorded data (counters, spans, hists, traces, events).
+    The rings are rebuilt from the CURRENT cap globals, so tests may
+    shrink ``_EVENT_CAP``/``_TRACE_CAP`` and reset to apply them."""
+    global _TRACE, _EVENTS
     with _LOCK:
         _COUNTERS.clear()
         _GAUGES.clear()
         _SPANS.clear()
-        _TRACE.clear()
-        _EVENTS.clear()
+        _HISTS.clear()
+        _TRACE = deque(maxlen=_TRACE_CAP)
+        _EVENTS = deque(maxlen=_EVENT_CAP)
 
 
 # ---------------------------------------------------------------------------
@@ -118,9 +148,14 @@ def gauge(name: str, value: float) -> None:
 
 
 def observe(name: str, seconds: float) -> None:
-    """Feed one measured duration into the named span aggregate without
-    a context manager — for durations measured externally (queue waits,
-    per-job latencies) where enter/exit bracketing does not fit."""
+    """Feed one measured duration into the named span aggregate AND the
+    named log-bucket histogram, without a context manager — for
+    durations measured externally (queue waits, per-job latencies)
+    where enter/exit bracketing does not fit.  The histogram is what
+    :func:`percentile` and the fleet SLO gauges read; the name space is
+    bounded (`QRACK_TPU_TELEMETRY_HIST_CAP`) against label cardinality
+    blowups — overflow names keep their span aggregate but drop the
+    distribution (counted in ``telemetry.hists.dropped``)."""
     if not _ENABLED:
         return
     with _LOCK:
@@ -132,21 +167,59 @@ def observe(name: str, seconds: float) -> None:
             agg[1] += seconds
             agg[2] = min(agg[2], seconds)
             agg[3] = max(agg[3], seconds)
+        h = _HISTS.get(name)
+        if h is None:
+            if len(_HISTS) >= _HIST_CAP:
+                _COUNTERS["telemetry.hists.dropped"] = \
+                    _COUNTERS.get("telemetry.hists.dropped", 0) + 1
+                return
+            h = _HISTS[name] = Histogram()
+        h.record(seconds)
+
+
+def percentile(name: str, q: float) -> Optional[float]:
+    """p`q` of the named observed distribution (None when unrecorded)."""
+    with _LOCK:
+        h = _HISTS.get(name)
+        return h.percentile(q) if h is not None else None
 
 
 def event(name: str, **fields) -> None:
-    """Record a discrete annotated event AND bump its counter.  Events
-    are capped at QRACK_TPU_TELEMETRY_EVENT_CAP; drops are counted."""
+    """Record a discrete annotated event AND bump its counter.  The
+    event ring holds the most recent QRACK_TPU_TELEMETRY_EVENT_CAP
+    events (drop-OLDEST; evictions are counted) — postmortems need the
+    tail, not the boot transcript.  The thread's current trace id, if
+    any, is attached."""
     if not _ENABLED:
         return
+    tid = getattr(_TLS, "trace", None)
+    if tid is not None and "trace" not in fields:
+        fields["trace"] = tid
     with _LOCK:
         _COUNTERS[name] = _COUNTERS.get(name, 0) + 1
-        if len(_EVENTS) < _EVENT_CAP:
-            _EVENTS.append({"name": name,
-                            "t_s": time.perf_counter() - _EPOCH, **fields})
-        else:
+        if len(_EVENTS) == _EVENTS.maxlen:
             _COUNTERS["telemetry.events.dropped"] = \
                 _COUNTERS.get("telemetry.events.dropped", 0) + 1
+        _EVENTS.append({"name": name,
+                        "t_s": time.perf_counter() - _EPOCH, **fields})
+
+
+# ---------------------------------------------------------------------------
+# distributed trace context
+# ---------------------------------------------------------------------------
+
+def set_trace(trace_id: Optional[str]) -> Optional[str]:
+    """Set (or clear, with None) the calling thread's distributed-trace
+    id; returns the previous value so callers can restore it.  Spans and
+    events recorded while set carry ``trace: <id>``, which is how one
+    submit's work is correlated across the front door and its worker."""
+    prev = getattr(_TLS, "trace", None)
+    _TLS.trace = trace_id
+    return prev
+
+
+def current_trace() -> Optional[str]:
+    return getattr(_TLS, "trace", None)
 
 
 # ---------------------------------------------------------------------------
@@ -169,11 +242,12 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "sync", "t0", "depth")
+    __slots__ = ("name", "sync", "t0", "depth", "trace")
 
-    def __init__(self, name: str, sync=None):
+    def __init__(self, name: str, sync=None, trace=None):
         self.name = name
         self.sync = sync
+        self.trace = trace
 
     def __enter__(self):
         stack = getattr(_TLS, "stack", None)
@@ -199,6 +273,18 @@ class _Span:
         else:
             wall = time.perf_counter() - self.t0
         _TLS.stack.pop()
+        trace = self.trace if self.trace is not None \
+            else getattr(_TLS, "trace", None)
+        entry = {
+            "name": self.name,
+            "ts_s": self.t0 - _EPOCH,
+            "dur_s": wall,
+            "tid": threading.get_ident(),
+            "depth": self.depth,
+            "synced": self.sync is not None,
+        }
+        if trace is not None:
+            entry["trace"] = trace
         with _LOCK:
             agg = _SPANS.get(self.name)
             if agg is None:
@@ -208,28 +294,62 @@ class _Span:
                 agg[1] += wall
                 agg[2] = min(agg[2], wall)
                 agg[3] = max(agg[3], wall)
-            if len(_TRACE) < _TRACE_CAP:
-                _TRACE.append({
-                    "name": self.name,
-                    "ts_s": self.t0 - _EPOCH,
-                    "dur_s": wall,
-                    "tid": threading.get_ident(),
-                    "depth": self.depth,
-                    "synced": self.sync is not None,
-                })
-            else:
+            if len(_TRACE) == _TRACE.maxlen:
+                # drop-OLDEST ring, same rationale as the event ring
                 _COUNTERS["telemetry.trace.dropped"] = \
                     _COUNTERS.get("telemetry.trace.dropped", 0) + 1
+            _TRACE.append(entry)
         return False
 
 
-def span(name: str, sync=None):
+def span(name: str, sync=None, trace=None):
     """Nestable wall-clock timer.  `sync` takes the device array (e.g.
     the (2, 2^n) planes) whose queue the span must drain before its
-    clock stops — without it the span is an untrusted host wall."""
+    clock stops — without it the span is an untrusted host wall.
+    `trace` pins a distributed-trace id on the recorded span (defaults
+    to the thread's :func:`current_trace` — pass it explicitly when the
+    span runs on a different thread than the one that minted the id,
+    e.g. the executor's dispatch owner)."""
     if not _ENABLED:
         return _NULL_SPAN
-    return _Span(name, sync)
+    return _Span(name, sync, trace)
+
+
+def record_span(name: str, start_s: float, dur_s: float,
+                trace=None) -> None:
+    """Append an already-measured interval to the trace ring and span
+    aggregates — for callers that own their own stopwatch (e.g. the
+    executor re-emitting a job's t_submit->t_done serve latency so the
+    merged fleet timeline carries one bar per job and the raw durations
+    can cross-check the bucketed histogram gauges).  `start_s` is a
+    ``time.perf_counter()`` reading from THIS process."""
+    if not _ENABLED:
+        return
+    if trace is None:
+        trace = getattr(_TLS, "trace", None)
+    entry = {
+        "name": name,
+        "ts_s": start_s - _EPOCH,
+        "dur_s": dur_s,
+        "tid": threading.get_ident(),
+        "depth": 0,
+        "synced": False,
+    }
+    if trace is not None:
+        entry["trace"] = trace
+    with _LOCK:
+        agg = _SPANS.get(name)
+        if agg is None:
+            _SPANS[name] = [1, dur_s, dur_s, dur_s]
+        else:
+            agg[0] += 1
+            agg[1] += dur_s
+            agg[2] = min(agg[2], dur_s)
+            agg[3] = max(agg[3], dur_s)
+        if len(_TRACE) == _TRACE.maxlen:
+            _COUNTERS["telemetry.trace.dropped"] = \
+                _COUNTERS.get("telemetry.trace.dropped", 0) + 1
+        _TRACE.append(entry)
 
 
 # ---------------------------------------------------------------------------
@@ -372,13 +492,28 @@ class ProgramCache:
 # ---------------------------------------------------------------------------
 
 def snapshot(include_events: bool = True) -> dict:
-    """Plain-dict view of everything recorded so far (JSON-safe)."""
+    """Plain-dict view of everything recorded so far (JSON-safe).
+
+    Besides the raw stores, the snapshot *publishes* SLO gauges: every
+    observed distribution contributes ``<name>.p50/.p95/.p99`` to the
+    returned ``gauges`` (computed at snapshot time, never stored — a
+    stale percentile gauge would outlive its histogram).  The
+    ``epoch_unix_s`` wall anchor converts this process's relative span
+    timestamps to absolute time for cross-process merging."""
     with _LOCK:
+        gauges = dict(_GAUGES)
+        hists = {name: h.to_dict() for name, h in _HISTS.items()}
+        for name, h in _HISTS.items():
+            for pname, v in h.percentiles().items():
+                if v is not None:
+                    gauges[f"{name}.{pname}"] = v
         out = {
             "enabled": _ENABLED,
             "pid": os.getpid(),
+            "epoch_unix_s": _EPOCH_WALL,
             "counters": dict(_COUNTERS),
-            "gauges": dict(_GAUGES),
+            "gauges": gauges,
+            "hists": hists,
             "spans": {
                 name: {"count": int(agg[0]), "total_s": agg[1],
                        "min_s": agg[2], "max_s": agg[3]}
@@ -390,10 +525,51 @@ def snapshot(include_events: bool = True) -> dict:
     return out
 
 
+def merge_snapshots(snaps) -> dict:
+    """Fold N snapshot dicts (one per process/incarnation) into one:
+    counters sum, span aggregates combine, histograms merge cell-wise,
+    gauges last-write-wins in input order — EXCEPT the SLO percentile
+    gauges, which are recomputed from the merged distributions (a
+    fleet p99 is not any worker's p99)."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    spans: Dict[str, dict] = {}
+    hists: Dict[str, Histogram] = {}
+    for s in snaps:
+        for k, v in (s.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        gauges.update(s.get("gauges") or {})
+        for k, d in (s.get("spans") or {}).items():
+            agg = spans.get(k)
+            if agg is None:
+                spans[k] = dict(d)
+            else:
+                agg["count"] += d["count"]
+                agg["total_s"] += d["total_s"]
+                agg["min_s"] = min(agg["min_s"], d["min_s"])
+                agg["max_s"] = max(agg["max_s"], d["max_s"])
+        for k, d in (s.get("hists") or {}).items():
+            h = hists.get(k)
+            if h is None:
+                hists[k] = Histogram.from_dict(d)
+            else:
+                h.merge(d)
+    for name, h in hists.items():
+        for pname, v in h.percentiles().items():
+            if v is not None:
+                gauges[f"{name}.{pname}"] = v
+    return {"counters": counters, "gauges": gauges,
+            "hists": {k: h.to_dict() for k, h in hists.items()},
+            "spans": spans}
+
+
 # exporters live in export.py; re-export the public surface
 from .export import (  # noqa: E402  (cycle-safe: export imports nothing above lazily)
-    chrome_trace, write_chrome_trace, write_jsonl, xplane_bracket,
+    chrome_trace, local_trace_source, merged_chrome_trace,
+    write_chrome_trace, write_jsonl, write_merged_chrome_trace,
+    xplane_bracket,
 )
+from .blackbox import FlightRecorder, read_blackbox  # noqa: E402
 
 # arm the atexit JSONL dump when the env gate + out path are both set
 if _ENABLED and os.environ.get("QRACK_TPU_TELEMETRY_OUT"):

@@ -12,6 +12,14 @@ Formats:
   become ``"ph": "i"`` instants, and every counter's final value is one
   ``"ph": "C"`` sample at the end of the trace.  Loads directly in
   Perfetto / chrome://tracing.
+* **Merged fleet trace** — :func:`merged_chrome_trace` folds N
+  processes' trace sources (live snapshots or flight-recorder black
+  boxes, each carrying its own ``epoch_unix_s`` wall anchor) into ONE
+  Perfetto-loadable timeline, one track per worker incarnation, with
+  every span's distributed-trace id in its args — so a single submit
+  can be followed from the front door's ``frontdoor.apply`` through the
+  worker's ``worker.submit.journal`` to the executor's
+  ``serve.execute`` devget on one screen.
 * **xplane** — :func:`xplane_bracket` wraps ``jax.profiler``
   start/stop_trace; the resulting ``*.xplane.pb`` dumps are what
   ``scripts/analyze_xplane.py`` parses for on-device op walls.
@@ -108,6 +116,77 @@ def chrome_trace() -> dict:
 def write_chrome_trace(path: str) -> str:
     with open(path, "w") as f:
         json.dump(chrome_trace(), f)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# merged fleet trace
+# ---------------------------------------------------------------------------
+
+def local_trace_source(name: Optional[str] = None) -> dict:
+    """This process's trace rings as a merge source for
+    :func:`merged_chrome_trace` (same shape as a flight-recorder black
+    box: name/pid/epoch_unix_s/spans/events)."""
+    from . import _EPOCH_WALL, _EVENTS, _LOCK, _TRACE
+
+    pid = os.getpid()
+    with _LOCK:
+        return {"name": name or f"pid{pid}", "pid": pid,
+                "epoch_unix_s": _EPOCH_WALL,
+                "spans": list(_TRACE), "events": list(_EVENTS)}
+
+
+def merged_chrome_trace(sources) -> dict:
+    """One Perfetto-loadable timeline from N processes' trace sources.
+
+    Each source dict carries ``name`` (track label), ``pid``,
+    ``epoch_unix_s`` (the wall clock at that process's telemetry import
+    — see telemetry/__init__.py), and ``spans``/``events`` ring dumps.
+    Relative timestamps are re-anchored as ``epoch_unix_s + ts_s`` and
+    normalized to the earliest instant across the fleet, so spans from
+    different processes land in true wall-clock order.  Every source
+    gets its OWN display pid (sequential) even when OS pids collide —
+    one track per worker incarnation; span trace ids ride in ``args``
+    so Perfetto's query/args panel correlates a submit across tracks.
+    """
+    evs = []
+    anchors = []
+    for src in sources:
+        epoch = float(src.get("epoch_unix_s") or 0.0)
+        for t in src.get("spans") or []:
+            anchors.append(epoch + t["ts_s"])
+        for e in src.get("events") or []:
+            anchors.append(epoch + e["t_s"])
+    t0 = min(anchors) if anchors else 0.0
+    for disp_pid, src in enumerate(sources, start=1):
+        epoch = float(src.get("epoch_unix_s") or 0.0)
+        label = src.get("name") or f"pid{src.get('pid')}"
+        evs.append({"name": "process_name", "ph": "M", "pid": disp_pid,
+                    "tid": 0,
+                    "args": {"name": f"{label} (pid {src.get('pid')})"}})
+        for t in src.get("spans") or []:
+            args = {"depth": t.get("depth"), "synced": t.get("synced")}
+            if t.get("trace") is not None:
+                args["trace"] = t["trace"]
+            evs.append({
+                "name": t["name"], "ph": "X", "cat": "span",
+                "ts": (epoch + t["ts_s"] - t0) * _US,
+                "dur": t["dur_s"] * _US,
+                "pid": disp_pid, "tid": t.get("tid", 0), "args": args,
+            })
+        for e in src.get("events") or []:
+            args = {k: v for k, v in e.items() if k not in ("name", "t_s")}
+            evs.append({
+                "name": e["name"], "ph": "i", "cat": "event", "s": "p",
+                "ts": (epoch + e["t_s"] - t0) * _US,
+                "pid": disp_pid, "tid": 0, "args": args,
+            })
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def write_merged_chrome_trace(path: str, sources) -> str:
+    with open(path, "w") as f:
+        json.dump(merged_chrome_trace(sources), f)
     return path
 
 

@@ -53,6 +53,7 @@ from qrack_tpu import resilience as res  # noqa: E402
 from qrack_tpu.fleet import FleetFrontDoor, FleetSupervisor  # noqa: E402
 from qrack_tpu.layers.qcircuit import QCircuit  # noqa: E402
 from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
+from qrack_tpu.telemetry import Histogram  # noqa: E402
 from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
 N_WORKERS = 4
@@ -207,17 +208,16 @@ def run_trial(trial: int, seed: int) -> dict:
 
         time.sleep(0.6)  # two beats: let ttfr reach the heartbeat files
         stats = sup.stats()["workers"]
-        lat.sort()
+        hist = Histogram.of(lat)
         info["n_jobs"] = len(results)
         info["resubmits"] = sum(r["resubmits"] for r in results)
         info["adopted"] = sum(r["adopted"] for r in results)
         info["fired"] = sum(sp.fired for sp in res.faults.specs())
         info["crashes"] = sum(w["crashes"] for w in stats.values())
         info["restarts"] = sum(w["restarts"] for w in stats.values())
-        info["lat_p50_ms"] = round(lat[len(lat) // 2] * 1e3, 3)
-        info["lat_p99_ms"] = round(lat[min(len(lat) - 1,
-                                           int(len(lat) * 0.99))] * 1e3, 3)
-        info["lat_max_ms"] = round(lat[-1] * 1e3, 3)
+        info["lat_p50_ms"] = round(hist.percentile(50) * 1e3, 3)
+        info["lat_p99_ms"] = round(hist.percentile(99) * 1e3, 3)
+        info["lat_max_ms"] = round(hist.max * 1e3, 3)
         ttfr = [w["beat"].get("ttfr_s") for w in stats.values()
                 if w["beat"] and w["beat"].get("ttfr_s") is not None]
         boot = [w["beat"].get("boot_s") for w in stats.values()

@@ -81,7 +81,7 @@ def _devget_read(engine) -> None:
 def _pctl(vals, q):
     if not vals:
         return None
-    return float(np.percentile(np.asarray(vals, dtype=np.float64), q))
+    return tele.Histogram.of(vals).percentile(q)
 
 
 def measure_library_cold(width, jobs, layers, **engine_kwargs):

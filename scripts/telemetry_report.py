@@ -35,54 +35,89 @@ sum; spans merge).  Sections:
   * layer events (qunit/stabilizer/qbdt/hybrid/factory escalations)
   * spans: count, total, mean
 
+Fleet mode (``--fleet``) reads the supervisor's fleet JSONL
+(FleetSupervisor.metrics / QRACK_FLEET_TELEMETRY_OUT) instead: the
+latest merged ``kind: fleet`` record (fleet-wide counters, histograms,
+SLO gauges, per-incarnation summaries) plus every ``kind: postmortem``
+black-box record — the postmortem section prints what each dead
+worker was doing when it died.
+
+The SLO section reads the log-bucket histograms behind observe()
+(telemetry/histogram.py): p50/p95/p99 per distribution, not min/max.
+
 A missing or empty input is a one-line message + exit 2, never a
 traceback (campaigns glob for files that may not exist yet).
 
 Usage: python scripts/telemetry_report.py tele.jsonl [--all] [--top N]
        python scripts/telemetry_report.py tele.jsonl --json
+       python scripts/telemetry_report.py fleet_telemetry.jsonl --fleet
 """
 
 import argparse
 import json
+import os
 import sys
-from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from qrack_tpu.telemetry import Histogram, merge_snapshots  # noqa: E402
 
 
-def load(path: str, aggregate: bool) -> dict:
-    snaps = []
+def _read_lines(path: str) -> list:
+    recs = []
     try:
         with open(path) as f:
             for line in f:
                 line = line.strip()
                 if line:
-                    snaps.append(json.loads(line))
+                    recs.append(json.loads(line))
     except OSError as e:
         print(f"telemetry_report: cannot read {path}: {e.strerror}",
               file=sys.stderr)
         raise SystemExit(2)
-    if not snaps:
+    if not recs:
         print(f"telemetry_report: no snapshot lines in {path}",
               file=sys.stderr)
         raise SystemExit(2)
+    return recs
+
+
+def load(path: str, aggregate: bool) -> dict:
+    snaps = _read_lines(path)
     if not aggregate:
         return snaps[-1]
-    merged = {"counters": defaultdict(float), "gauges": {}, "spans": {},
-              "lines": len(snaps)}
-    for s in snaps:
-        for k, v in s.get("counters", {}).items():
-            merged["counters"][k] += v
-        merged["gauges"].update(s.get("gauges", {}))  # last-write-wins
-        for name, agg in s.get("spans", {}).items():
-            cur = merged["spans"].get(name)
-            if cur is None:
-                merged["spans"][name] = dict(agg)
-            else:
-                cur["count"] += agg["count"]
-                cur["total_s"] += agg["total_s"]
-                cur["min_s"] = min(cur["min_s"], agg["min_s"])
-                cur["max_s"] = max(cur["max_s"], agg["max_s"])
-    merged["counters"] = dict(merged["counters"])
+    merged = merge_snapshots(snaps)
+    merged["lines"] = len(snaps)
+    # postmortems ride along when a fleet journal is fed through --all
+    posts = [p for s in snaps for p in (s.get("postmortems") or [])]
+    if posts:
+        merged["postmortems"] = posts
     return merged
+
+
+def load_fleet(path: str) -> dict:
+    """Latest merged fleet record + the union of every postmortem seen
+    anywhere in the journal (deduped per worker incarnation)."""
+    recs = _read_lines(path)
+    fleets = [r for r in recs if r.get("kind") == "fleet"]
+    snap = dict(fleets[-1]) if fleets else {}
+    posts = list(snap.get("postmortems") or [])
+    seen = {(p.get("worker"), p.get("pid")) for p in posts}
+    for r in recs:
+        cand = [r] if r.get("kind") == "postmortem" \
+            else (r.get("postmortems") or [])
+        for p in cand:
+            key = (p.get("worker"), p.get("pid"))
+            if key not in seen:
+                posts.append(p)
+                seen.add(key)
+    if not snap and not posts:
+        print(f"telemetry_report: no fleet records in {path}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    snap["postmortems"] = posts
+    return snap
 
 
 def _fmt_bytes(n: float) -> str:
@@ -112,7 +147,22 @@ def report(snap: dict, top: int) -> dict:
         "gauges": snap.get("gauges", {}),
         "layer_events": {},
         "spans": snap.get("spans", {}),
+        "slo": {},
+        "workers": snap.get("workers", {}),
+        "postmortems": snap.get("postmortems", []),
     }
+    # SLO section: percentiles from the observe() histograms — the
+    # quantiles the gauges publish, recomputed here so --all aggregation
+    # (which merges hists) reports merged percentiles too
+    for name, d in sorted((snap.get("hists") or {}).items()):
+        h = Histogram.from_dict(d)
+        if not h.count:
+            continue
+        out["slo"][name] = {
+            "count": h.count, "mean_s": h.mean, "min_s": h.min,
+            "p50_s": h.percentile(50), "p95_s": h.percentile(95),
+            "p99_s": h.percentile(99), "max_s": h.max,
+        }
     for k, v in counters.items():
         if k.startswith("compile."):
             # compile.<cache>.<hit|miss|eviction|call> — cache names may
@@ -188,13 +238,19 @@ def main(argv=None) -> int:
     ap.add_argument("path", help="snapshot JSONL (QRACK_TPU_TELEMETRY_OUT)")
     ap.add_argument("--all", action="store_true",
                     help="aggregate every line instead of taking the last")
+    ap.add_argument("--fleet", action="store_true",
+                    help="input is a supervisor fleet JSONL "
+                         "(FleetSupervisor.metrics): report the latest "
+                         "merged record + every postmortem")
     ap.add_argument("--top", type=int, default=10,
                     help="gate counters to show (default 10)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as one JSON object")
     args = ap.parse_args(argv)
 
-    rep = report(load(args.path, args.all), args.top)
+    snap = load_fleet(args.path) if args.fleet \
+        else load(args.path, args.all)
+    rep = report(snap, args.top)
     if args.json:
         print(json.dumps(rep, indent=1, sort_keys=True))
         return 0
@@ -256,6 +312,39 @@ def main(argv=None) -> int:
             mean = agg["total_s"] / max(agg["count"], 1)
             print(f"  {name:<32s} n={agg['count']:<6d} "
                   f"total={agg['total_s']:.6f}s mean={mean:.6f}s")
+    if rep["slo"]:
+        print("== SLO (histogram percentiles) ==")
+        for name, s in sorted(rep["slo"].items()):
+            print(f"  {name:<36s} n={s['count']:<7d} "
+                  f"p50={s['p50_s'] * 1e3:.3f}ms "
+                  f"p95={s['p95_s'] * 1e3:.3f}ms "
+                  f"p99={s['p99_s'] * 1e3:.3f}ms "
+                  f"max={s['max_s'] * 1e3:.3f}ms")
+    if rep["workers"]:
+        print("== fleet workers (per incarnation) ==")
+        for key, s in sorted(rep["workers"].items()):
+            lat = s.get("serve.latency") or {}
+            extra = ""
+            if lat:
+                extra = (f" lat_p50={lat['p50'] * 1e3:.3f}ms"
+                         f" lat_p99={lat['p99'] * 1e3:.3f}ms")
+            print(f"  {key:<24s} jobs={s.get('jobs_completed', 0):.0f}"
+                  f"{extra}")
+    if rep["postmortems"]:
+        print("== postmortems (what the worker was doing when it died) ==")
+        for post in rep["postmortems"]:
+            print(f"  -- {post.get('worker')} pid={post.get('pid')} "
+                  f"reason={post.get('reason')} --")
+            for e in post.get("last_events") or []:
+                extra = " ".join(
+                    f"{k}={v}" for k, v in sorted(e.items())
+                    if k not in ("name", "t_s"))
+                print(f"    [{e.get('t_s', 0):10.3f}s] "
+                      f"{e.get('name'):<28s} {extra}")
+            for s in (post.get("last_spans") or [])[-5:]:
+                print(f"    span {s.get('name'):<26s} "
+                      f"ts={s.get('ts_s', 0):.3f}s "
+                      f"dur={s.get('dur_s', 0) * 1e3:.3f}ms")
     return 0
 
 

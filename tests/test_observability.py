@@ -1,0 +1,471 @@
+"""Fleet observability plane, process-local half (docs/OBSERVABILITY.md):
+log-bucket latency histograms + SLO gauges, the drop-oldest event ring,
+distributed-trace context, the merged Perfetto exporter, the crash
+flight recorder, snapshot merging, and the telemetry-name docs lint.
+
+The cross-process half (heartbeat aggregation, postmortem collection,
+merged fleet traces from real subprocess workers) lives in
+tests/test_fleet.py."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from qrack_tpu import telemetry as tele
+from qrack_tpu.telemetry import Histogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    tele.disable()
+    tele.reset()
+    yield
+    tele.disable()
+    tele.reset()
+
+
+# ---------------------------------------------------------------------------
+# log-bucket histogram
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentiles_within_slo_bar():
+    """8 subbuckets/octave bounds midpoint error at 2^(1/16)-1 ~ 4.4%;
+    the acceptance bar is 10% vs exact percentiles."""
+    rng = np.random.default_rng(7)
+    vals = np.exp(rng.normal(-5.0, 1.5, size=2000))  # lognormal walls
+    h = Histogram.of(vals.tolist())
+    assert h.count == 2000
+    for q in (50, 90, 95, 99):
+        exact = float(np.percentile(vals, q))
+        got = h.percentile(q)
+        assert abs(got - exact) / exact < 0.10, (q, got, exact)
+
+
+def test_histogram_single_sample_is_exact():
+    h = Histogram.of([0.0123])
+    # clamped into [min, max]: a 1-sample histogram reports the sample,
+    # not a bucket midpoint
+    assert h.percentile(50) == pytest.approx(0.0123)
+    assert h.percentile(99) == pytest.approx(0.0123)
+    assert h.mean == pytest.approx(0.0123)
+
+
+def test_histogram_merge_equals_combined():
+    a = [0.001 * (i + 1) for i in range(100)]
+    b = [0.5] * 50
+    ha, hb, hall = Histogram.of(a), Histogram.of(b), Histogram.of(a + b)
+    ha.merge(hb.to_dict())
+    assert ha.count == hall.count
+    assert ha.sum == pytest.approx(hall.sum)
+    for q in (50, 95, 99):
+        assert ha.percentile(q) == pytest.approx(hall.percentile(q))
+
+
+def test_histogram_dict_round_trip_and_merge_all():
+    h = Histogram.of([0.01, 0.1, 1.0, 10.0])
+    d = json.loads(json.dumps(h.to_dict()))  # JSONL-safe
+    h2 = Histogram.from_dict(d)
+    assert h2.count == 4 and h2.min == h.min and h2.max == h.max
+    assert h2.percentile(50) == pytest.approx(h.percentile(50))
+    m = Histogram.merge_all([d, d])
+    assert m.count == 8
+    assert m.percentile(50) == pytest.approx(h.percentile(50))
+
+
+def test_histogram_degenerate_values_no_crash():
+    h = Histogram()
+    assert h.percentile(50) is None
+    h.record(0.0)       # clamps to the tiny-value floor bucket
+    h.record(-1.0)
+    h.record(1e30)      # clamps to the top (2^30) bucket
+    assert h.count == 3
+    # extremes land in the clamp buckets: ordering survives even though
+    # magnitudes beyond the +-2^30s index range lose accuracy by design
+    assert h.percentile(99) >= 2.0 ** 30
+    assert h.percentile(1) < 1e-8
+    assert h.max == 1e30 and h.min == -1.0
+
+
+# ---------------------------------------------------------------------------
+# event ring: drop-OLDEST (the satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_event_ring_drops_oldest_not_newest(monkeypatch):
+    """The old ring kept the FIRST cap events and dropped everything
+    after — a postmortem of a long-lived worker would show its boot
+    transcript.  The contract is the reverse: the newest events always
+    survive."""
+    monkeypatch.setattr(tele, "_EVENT_CAP", 8)
+    tele.reset()  # rebind the ring at the patched cap
+    tele.enable()
+    for i in range(11):
+        tele.event("ring.probe", i=i)
+    snap = tele.snapshot()
+    got = [e["i"] for e in snap["events"] if e["name"] == "ring.probe"]
+    assert got == list(range(3, 11))          # event N+cap present ...
+    assert 0 not in got                       # ... event 0 evicted
+    assert snap["counters"]["telemetry.events.dropped"] == 3
+    assert snap["counters"]["ring.probe"] == 11  # counter unaffected
+
+
+# ---------------------------------------------------------------------------
+# observe -> histogram + SLO gauges
+# ---------------------------------------------------------------------------
+
+def test_observe_feeds_histogram_and_publishes_slo_gauges():
+    tele.enable()
+    for v in [0.01] * 50 + [0.1] * 45 + [1.0] * 5:
+        tele.observe("serve.latency", v)
+    assert tele.percentile("serve.latency", 50) == pytest.approx(
+        0.01, rel=0.05)
+    snap = tele.snapshot()
+    assert snap["hists"]["serve.latency"]["count"] == 100
+    g = snap["gauges"]
+    assert g["serve.latency.p50"] == pytest.approx(0.01, rel=0.05)
+    assert g["serve.latency.p95"] == pytest.approx(0.1, rel=0.05)
+    assert g["serve.latency.p99"] == pytest.approx(1.0, rel=0.05)
+    # span-style aggregate still fed alongside
+    assert snap["spans"]["serve.latency"]["count"] == 100
+
+
+def test_histogram_name_cap_overflow_counted(monkeypatch):
+    monkeypatch.setattr(tele, "_HIST_CAP", 2)
+    tele.reset()
+    tele.enable()
+    tele.observe("cap.a", 0.1)
+    tele.observe("cap.b", 0.1)
+    tele.observe("cap.c", 0.1)  # beyond cap: span aggregate only
+    snap = tele.snapshot()
+    assert set(snap["hists"]) == {"cap.a", "cap.b"}
+    assert tele.percentile("cap.c", 50) is None
+    assert snap["spans"]["cap.c"]["count"] == 1
+    assert snap["counters"]["telemetry.hists.dropped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# distributed trace context
+# ---------------------------------------------------------------------------
+
+def test_trace_context_attaches_to_spans_and_events():
+    tele.enable()
+    assert tele.current_trace() is None
+    prev = tele.set_trace("tag-123")
+    assert prev is None and tele.current_trace() == "tag-123"
+    with tele.span("traced.work"):
+        pass
+    tele.event("traced.mark")
+    assert tele.set_trace(None) == "tag-123"
+    with tele.span("untraced.work"):
+        pass
+    src = tele.local_trace_source("me")
+    by_name = {s["name"]: s for s in src["spans"]}
+    assert by_name["traced.work"]["trace"] == "tag-123"
+    assert "trace" not in by_name["untraced.work"]
+    ev = [e for e in src["events"] if e["name"] == "traced.mark"]
+    assert ev and ev[0]["trace"] == "tag-123"
+    assert src["pid"] == os.getpid()
+    assert isinstance(src["epoch_unix_s"], float)
+
+
+def test_record_span_emits_exact_interval_with_trace():
+    """record_span() appends a caller-measured interval verbatim: the
+    executor uses it to put each job's t_submit->t_done window on the
+    trace ring, so the merged timeline carries raw serve latencies."""
+    import time as _time
+
+    tele.enable()
+    t0 = _time.perf_counter() - 0.5
+    tele.record_span("recorded.work", t0, 0.125, trace="job-9")
+    tele.record_span("recorded.work", t0, 0.25)  # no thread trace set
+    spans = [s for s in tele.local_trace_source()["spans"]
+             if s["name"] == "recorded.work"]
+    assert len(spans) == 2
+    assert spans[0]["dur_s"] == 0.125 and spans[0]["trace"] == "job-9"
+    assert spans[1]["dur_s"] == 0.25 and "trace" not in spans[1]
+    # aggregates fold in like any other span
+    agg = tele.snapshot()["spans"]["recorded.work"]
+    assert agg["count"] == 2 and agg["max_s"] == 0.25
+    tele.disable()
+    tele.record_span("recorded.off", t0, 1.0)  # disabled: no-op
+    tele.enable()
+    assert all(s["name"] != "recorded.off"
+               for s in tele.local_trace_source()["spans"])
+
+
+def test_explicit_span_trace_wins_over_thread_local():
+    """The executor runs jobs on its own thread: the span must carry
+    the JOB's trace (pinned explicitly), not the dispatch thread's."""
+    tele.enable()
+    tele.set_trace("thread-tag")
+    try:
+        with tele.span("pinned.work", trace="job-tag"):
+            pass
+    finally:
+        tele.set_trace(None)
+    spans = tele.local_trace_source()["spans"]
+    assert spans[-1]["trace"] == "job-tag"
+
+
+def test_trace_context_is_thread_local():
+    tele.enable()
+    tele.set_trace("main-tag")
+    seen = {}
+
+    def other():
+        seen["before"] = tele.current_trace()
+        tele.set_trace("other-tag")
+        tele.event("other.mark")
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    try:
+        assert seen["before"] is None          # not inherited
+        assert tele.current_trace() == "main-tag"  # not clobbered
+    finally:
+        tele.set_trace(None)
+
+
+# ---------------------------------------------------------------------------
+# multi-thread stress: no lost updates (satellite d)
+# ---------------------------------------------------------------------------
+
+def test_concurrent_inc_observe_span_no_lost_updates():
+    tele.enable()
+    n, n_threads = 2000, 8
+    barrier = threading.Barrier(n_threads)
+
+    def work(k):
+        barrier.wait()
+        for i in range(n):
+            tele.inc("stress.count")
+            tele.observe("stress.lat", 0.001 * ((i % 10) + 1))
+            with tele.span(f"stress.span.{k}"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    snap = tele.snapshot(include_events=False)
+    assert snap["counters"]["stress.count"] == n * n_threads
+    hist = snap["hists"]["stress.lat"]
+    assert hist["count"] == n * n_threads
+    assert sum(hist["buckets"].values()) == n * n_threads
+    assert snap["spans"]["stress.lat"]["count"] == n * n_threads
+    for k in range(n_threads):
+        assert snap["spans"][f"stress.span.{k}"]["count"] == n
+
+
+# ---------------------------------------------------------------------------
+# merged Perfetto exporter
+# ---------------------------------------------------------------------------
+
+def _src(name, pid, epoch, spans, events=()):
+    return {"name": name, "pid": pid, "epoch_unix_s": epoch,
+            "spans": list(spans), "events": list(events)}
+
+
+def test_merged_trace_one_track_per_incarnation_despite_pid_reuse():
+    sp = {"dur_s": 0.5, "tid": 1, "depth": 0, "synced": False,
+          "trace": "tag1"}
+    s1 = _src("frontdoor", 500, 1000.0,
+              [{"name": "frontdoor.apply", "ts_s": 1.0, **sp}],
+              [{"name": "fleet.worker.dead", "t_s": 1.2, "trace": "tag1"}])
+    # same OS pid (reuse after restart) but a separate incarnation:
+    s2 = _src("w0", 500, 1000.6,
+              [{"name": "serve.execute", "ts_s": 0.5, "dur_s": 0.2,
+                "tid": 9, "depth": 0, "synced": False, "trace": "tag1"}])
+    obj = tele.merged_chrome_trace([s1, s2])
+    evs = obj["traceEvents"]
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    # one display track per SOURCE, not per OS pid
+    assert xs["frontdoor.apply"]["pid"] != xs["serve.execute"]["pid"]
+    meta = [e for e in evs if e["ph"] == "M"
+            and e["name"] == "process_name"]
+    labels = {m["args"]["name"] for m in meta}
+    assert any("frontdoor" in x for x in labels)
+    assert any("w0" in x for x in labels)
+    # wall-clock re-anchor: fd span at 1000+1.0=1001.0 is the fleet t0;
+    # the worker span at 1000.6+0.5=1001.1 lands 100ms later
+    assert xs["frontdoor.apply"]["ts"] == pytest.approx(0.0, abs=1e-6)
+    assert xs["serve.execute"]["ts"] == pytest.approx(0.1e6, rel=1e-6)
+    # the trace id survives into args on spans AND instants
+    assert xs["frontdoor.apply"]["args"]["trace"] == "tag1"
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert inst and inst[0]["args"]["trace"] == "tag1"
+
+
+def test_write_merged_chrome_trace_is_loadable_json(tmp_path):
+    tele.enable()
+    with tele.span("merged.local"):
+        pass
+    path = tmp_path / "fleet_trace.json"
+    tele.write_merged_chrome_trace(
+        str(path), [tele.local_trace_source("fd")])
+    obj = json.loads(path.read_text())
+    assert any(e.get("name") == "merged.local"
+               for e in obj["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_round_trip_and_tail(tmp_path):
+    path = tmp_path / "blackbox" / "w0-123.json"
+    rec = tele.FlightRecorder(str(path), name="w0", last_n=4)
+    assert rec.flush() == {}        # disabled: no box, no I/O
+    assert not path.exists()
+    tele.enable()
+    for i in range(10):
+        tele.event("box.mark", i=i)
+    with tele.span("box.work"):
+        pass
+    rec.flush()
+    box = tele.read_blackbox(str(path))
+    assert box is not None and box["name"] == "w0"
+    assert box["pid"] == os.getpid()
+    assert isinstance(box["epoch_unix_s"], float)
+    # the TAIL survives, bounded by last_n
+    marks = [e["i"] for e in box["events"] if e["name"] == "box.mark"]
+    assert marks == [6, 7, 8, 9]    # newest last_n events, oldest gone
+    assert any(s["name"] == "box.work" for s in box["spans"])
+    assert box["counters"]["box.mark"] == 10
+
+
+def test_flight_recorder_flush_overwrites_atomically(tmp_path):
+    path = tmp_path / "bb.json"
+    tele.enable()
+    rec = tele.FlightRecorder(str(path), name="w1")
+    tele.event("first.flush")
+    rec.flush()
+    tele.event("second.flush")
+    rec.flush()
+    box = tele.read_blackbox(str(path))
+    names = {e["name"] for e in box["events"]}
+    assert {"first.flush", "second.flush"} <= names
+    assert box["flush_seq"] == 2
+    # unreadable/missing boxes answer None, never raise
+    assert tele.read_blackbox(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert tele.read_blackbox(str(bad)) is None
+
+
+# ---------------------------------------------------------------------------
+# snapshot merging (the supervisor's aggregation primitive)
+# ---------------------------------------------------------------------------
+
+def test_merge_snapshots_recomputes_fleet_percentiles():
+    """A fleet p99 is recomputed from the merged distribution — NOT
+    last-write-wins over per-worker p99 gauges."""
+    fast = Histogram.of([0.01] * 100)
+    slow = Histogram.of([1.0] * 100)
+    snaps = []
+    for h, jobs in ((fast, 100), (slow, 100)):
+        g = {f"serve.latency.{k}": v
+             for k, v in h.percentiles().items()}
+        snaps.append({"counters": {"serve.jobs.completed": jobs},
+                      "gauges": g,
+                      "hists": {"serve.latency": h.to_dict()},
+                      "spans": {"serve.latency":
+                                {"count": h.count, "total_s": h.sum,
+                                 "min_s": h.min, "max_s": h.max}}})
+    m = tele.merge_snapshots(snaps)
+    assert m["counters"]["serve.jobs.completed"] == 200
+    assert m["hists"]["serve.latency"]["count"] == 200
+    # combined: ranks 101..200 are 1.0 -> p99 is the slow worker's 1.0,
+    # p50 sits at the fast/slow boundary (rank 100 -> 0.01)
+    assert m["gauges"]["serve.latency.p99"] == pytest.approx(1.0,
+                                                             rel=0.05)
+    assert m["gauges"]["serve.latency.p50"] == pytest.approx(0.01,
+                                                             rel=0.05)
+    sp = m["spans"]["serve.latency"]
+    assert sp["count"] == 200 and sp["max_s"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# serving-plane wiring: latency histogram + tenant/stack facets
+# ---------------------------------------------------------------------------
+
+def test_serve_latency_histogram_with_tenant_and_stack_facets():
+    from qrack_tpu.models.qft import qft_qcircuit
+    from qrack_tpu.serve import QrackService
+
+    tele.enable()
+    with QrackService(engine_layers="cpu", batch_window_ms=5.0,
+                      tick_s=0.02) as svc:
+        sid = svc.create_session(3, seed=1, rand_global_phase=False)
+        for _ in range(3):
+            svc.apply(sid, qft_qcircuit(3), timeout=60)
+    snap = tele.snapshot(include_events=False)
+    hists = snap["hists"]
+    assert hists["serve.latency"]["count"] == 3
+    assert hists[f"serve.latency.tenant.{sid}"]["count"] == 3
+    stacks = [k for k in hists
+              if k.startswith("serve.latency.stack.")]
+    assert stacks and sum(hists[k]["count"] for k in stacks) == 3
+    assert snap["gauges"]["serve.latency.p50"] > 0
+    assert hists["serve.queue_wait"]["count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report.py --fleet + the docs lint (tier-1 satellites)
+# ---------------------------------------------------------------------------
+
+def _load_report_module():
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        os.path.join(REPO, "scripts", "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_telemetry_report_fleet_mode(tmp_path, capsys):
+    fleet = {"kind": "fleet", "t_wall": 1.0,
+             "counters": {"serve.jobs.completed": 7},
+             "gauges": {"serve.latency.p50": 0.01,
+                        "serve.latency.p99": 0.5},
+             "hists": {"serve.latency":
+                       Histogram.of([0.01] * 9 + [0.5]).to_dict()},
+             "spans": {},
+             "workers": {"w0:123": {"jobs_completed": 7,
+                                    "serve.latency": {"count": 10,
+                                                      "p50": 0.01,
+                                                      "p99": 0.5}}},
+             "postmortems": []}
+    post = {"kind": "postmortem", "worker": "w1", "pid": 9, "t_wall": 2.0,
+            "reason": "kill", "flush_seq": 3, "epoch_unix_s": 0.0,
+            "last_events": [{"name": "worker.ready", "t_s": 0.1}],
+            "last_spans": []}
+    path = tmp_path / "fleet_telemetry.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(fleet) + "\n")
+        f.write(json.dumps(post) + "\n")
+    mod = _load_report_module()
+    rc = mod.main([str(path), "--fleet"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SLO" in out and "w0:123" in out
+    assert "postmortem" in out and "worker.ready" in out
+
+
+def test_telemetry_docs_lint_is_clean():
+    """Satellite: every telemetry name in qrack_tpu/ is documented and
+    no documented pattern is dead — enforced in tier 1."""
+    script = os.path.join(REPO, "scripts", "check_telemetry_docs.py")
+    out = subprocess.run([sys.executable, script],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
