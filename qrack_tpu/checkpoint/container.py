@@ -137,6 +137,34 @@ def save_container(path: str, arrays: Dict[str, np.ndarray],
     return nbytes
 
 
+def peek_meta(path: str) -> Tuple[Optional[str], dict]:
+    """Read ONLY a container's ``(kind, meta)`` — the manifest member is
+    decompressed but no array payload is touched or checksummed.  The
+    recovery path uses this to read snapshot bookkeeping (e.g. the
+    ``wal_high`` a state container carries) without paying a full state
+    load for sessions it may not even adopt."""
+    path = str(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if MANIFEST_KEY not in z.files:
+                raise CheckpointCorrupt(
+                    f"{path}: no {MANIFEST_KEY} member — not a checkpoint "
+                    "container")
+            try:
+                manifest = json.loads(bytes(z[MANIFEST_KEY].tobytes()))
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise CheckpointCorrupt(f"{path}: garbled manifest: {e}")
+    except (zipfile.BadZipFile, zlib.error, EOFError) as e:
+        raise CheckpointCorrupt(f"{path}: damaged archive: {e}") from None
+    except ValueError as e:
+        raise CheckpointCorrupt(f"{path}: damaged archive member: {e}"
+                                ) from None
+    if manifest.get("format") != FORMAT:
+        raise CheckpointCorrupt(
+            f"{path}: wrong format tag {manifest.get('format')!r}")
+    return manifest.get("kind"), manifest.get("meta", {})
+
+
 def load_container(path: str, expect_kind: Optional[str] = None,
                    legacy_ok: bool = False
                    ) -> Tuple[Optional[str], dict, Dict[str, np.ndarray]]:

@@ -243,13 +243,19 @@ def _unflatten(node: dict, arrays: Dict[str, np.ndarray]) -> dict:
 # -- public file API ---------------------------------------------------
 
 
-def save_state(obj, path: str) -> int:
+def save_state(obj, path: str, extra_meta: Optional[dict] = None) -> int:
     """Snapshot `obj` (any supported engine/layer stack, resilience
-    proxy included) into one container file; returns bytes written."""
+    proxy included) into one container file; returns bytes written.
+    `extra_meta` rides in the container manifest itself, so bookkeeping
+    like the store's ``wal_high`` commits in the SAME atomic replace as
+    the state it describes (no torn crash window between them)."""
     snap = capture(obj)
     flat: Dict[str, np.ndarray] = {}
     tree = _flatten(snap, "", flat)
-    return save_container(path, flat, meta={"tree": tree},
+    meta = {"tree": tree}
+    if extra_meta:
+        meta.update(extra_meta)
+    return save_container(path, flat, meta=meta,
                           kind=STATE_KIND_PREFIX + snap["kind"])
 
 

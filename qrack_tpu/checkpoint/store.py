@@ -57,13 +57,14 @@ import numpy as np
 
 from .. import telemetry as _tele
 from .container import (CheckpointCorrupt, CheckpointError, load_container,
-                        save_container)
+                        peek_meta, save_container)
 from .registry import load_state, save_state
 
 MANIFEST_VERSION = 1
 CIRCUIT_KIND = "qrack-circuit"
 DEFAULT_LEASE_TTL_S = 300.0
 DEFAULT_LOCK_TIMEOUT_S = 30.0
+ACKS_MAX_BYTES = 1 << 20  # settled-tag log rotates past this
 
 
 class StoreLeaseHeld(CheckpointError):
@@ -157,6 +158,7 @@ class CheckpointStore:
         os.makedirs(self._wal_dir, exist_ok=True)
         self._manifest_path = os.path.join(self.root, "manifest.json")
         self._lock_path = os.path.join(self.root, ".store.lock")
+        self._acks_path = os.path.join(self.root, "acks.log")
         # cross-process manifest ownership: only sids in _owned are
         # overlaid from memory onto disk at write time; only sids in
         # _dropped are deleted.  Everything else on disk belongs to
@@ -403,9 +405,17 @@ class CheckpointStore:
         snapshot already CONTAINS (manifest ``wal_high``): recovery
         skips entries at or below it, so the
         snapshot-then-settle order of QRACK_SERVE_CKPT_EVERY_JOB can
-        never double-replay the job a crash interrupted mid-settle."""
+        never double-replay the job a crash interrupted mid-settle.
+        The value also rides INSIDE the state container (same atomic
+        replace as the state itself): a kill -9 in the window between
+        the state write and the manifest rewrite used to leave a
+        snapshot that already contained the job next to a manifest
+        that said it didn't — recovery replayed the surviving WAL entry
+        onto it and the job applied twice (:meth:`state_wal_high` is
+        the recovery-side reader)."""
         path = self._state_path(sid)
-        save_state(engine, path)
+        extra = None if wal_seq is None else {"wal_high": int(wal_seq)}
+        save_state(engine, path, extra_meta=extra)
         rec = self._manifest["sessions"].get(sid)
         if rec is not None:
             changed = rec.get("dirty", True)
@@ -426,6 +436,23 @@ class CheckpointStore:
         if not os.path.exists(path):
             raise CheckpointError(f"no spilled state for session {sid}")
         return load_state(path, into=into)
+
+    def state_wal_high(self, sid: str) -> int:
+        """The ``wal_high`` recorded inside `sid`'s state container, or
+        -1 (no snapshot / no record / unreadable).  Authoritative over
+        the manifest copy during recovery: the container's value commits
+        atomically with the state, the manifest's lags by one write."""
+        path = self._state_path(sid)
+        if not os.path.exists(path):
+            return -1
+        try:
+            _, meta = peek_meta(path)
+        except (CheckpointCorrupt, CheckpointError):
+            return -1
+        try:
+            return int(meta.get("wal_high", -1))
+        except (TypeError, ValueError):
+            return -1
 
     def drop_state(self, sid: str) -> None:
         self._unlink(self._state_path(sid))
@@ -525,6 +552,68 @@ class CheckpointStore:
     def wal_remove(self, path: str) -> None:
         self._unlink(path)
         self._update_gauge()
+
+    # -- settled-tag acks (fleet exactly-once) -------------------------
+
+    def ack_tag(self, tag: str) -> None:
+        """Durably record that the submit carrying `tag` SETTLED —
+        appended by the executor after the job's effect is snapshotted
+        (or journaled past) but BEFORE its WAL entry is removed.  The
+        fleet front door's resubmit decision consults
+        :meth:`tag_acked`: without this record, a worker killed in the
+        instant between settling a job and writing its result frame
+        looks identical to one killed before executing it, and the
+        front door's only safe-looking move — resubmit — applies the
+        job twice.  Cross-process safe: appends hold the store flock
+        and stay under the pipe-atomicity size."""
+        line = (str(tag).replace("\n", " ") + "\n").encode()
+        with self._file_lock():
+            try:
+                if (os.path.exists(self._acks_path)
+                        and os.path.getsize(self._acks_path)
+                        > ACKS_MAX_BYTES):
+                    self._rotate_acks()
+            except OSError:
+                pass
+            with open(self._acks_path, "ab") as f:
+                f.write(line)
+                f.flush()
+
+    def _rotate_acks(self) -> None:
+        """Keep the newest half of the ack log (caller holds the store
+        flock).  Resubmit decisions happen within seconds of a worker
+        death, so dropping months-old tags can't reopen the window."""
+        try:
+            with open(self._acks_path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        keep = data[len(data) // 2:]
+        nl = keep.find(b"\n")
+        if nl >= 0:
+            keep = keep[nl + 1:]
+        fd, tmp = tempfile.mkstemp(prefix=".acks-", suffix=".tmp",
+                                   dir=self.root)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(keep)
+            os.replace(tmp, self._acks_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def tag_acked(self, tag: str) -> bool:
+        """True when `tag`'s submit settled on SOME worker sharing this
+        store (exact-line match against the ack log)."""
+        try:
+            with open(self._acks_path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        return str(tag).encode() in data.split(b"\n")
 
     def wal_entries(self, sids: Optional[Iterable[str]] = None
                     ) -> List[Tuple[str, int, object]]:

@@ -74,7 +74,11 @@ class ProgramManifest:
 
     def record(self, circuit, n: int, batch: int) -> None:
         """Idempotent: a known (shape, batch) is a no-op, so the hot
-        batcher path costs one dict probe."""
+        batcher path costs one dict probe.  Best-effort: the record is
+        advisory warm-start metadata, so a store that has vanished out
+        from under the manifest (dir removed after its service closed —
+        the batcher module global outlives any one service) must never
+        fail the dispatch it rides on."""
         shape = circuit.shape_key(n)
         key = self._key(shape, batch)
         if key in self._index:
@@ -83,11 +87,14 @@ class ProgramManifest:
         # same circuit served at several widths/batches is stored once
         digest = shape[2]
         path = os.path.join(self.root, f"{digest}.qckpt")
-        if not os.path.exists(path):
-            save_circuit(path, circuit)
-        self._index[key] = {"width": int(n), "batch": int(batch),
-                            "circuit": os.path.basename(path)}
-        self._write_index()
+        try:
+            if not os.path.exists(path):
+                save_circuit(path, circuit)
+            self._index[key] = {"width": int(n), "batch": int(batch),
+                                "circuit": os.path.basename(path)}
+            self._write_index()
+        except OSError:
+            return
         if _tele._ENABLED:
             _tele.inc("checkpoint.warmstart.recorded")
 
@@ -134,12 +141,11 @@ class ProgramManifest:
             n, batch = int(rec["width"]), int(rec["batch"])
             fn = _batcher.batch_program(circ, n, batch)
             # jax.jit is lazy — building the wrapper traces nothing.
-            # Run it once on a dummy |0..0> plane stack (same shape and
-            # dtype run_batch dispatches) so trace + compile happen
+            # Run it once on dummy |0..0> plane lanes (same pytree shape
+            # and dtype run_batch dispatches) so trace + compile happen
             # HERE, not under the first tenant's job.
-            planes = (jnp.zeros((batch, 2, 1 << n), dtype=dtype)
-                      .at[:, 0, 0].set(1.0))
-            _batcher.sync_scalar(fn(planes))
+            plane = jnp.zeros((2, 1 << n), dtype=dtype).at[0, 0].set(1.0)
+            _batcher.sync_scalar(fn([plane] * batch))
             warmed += 1
         for key in dead:
             self._index.pop(key, None)

@@ -15,12 +15,13 @@ behind it:
   adoption replays it (or the wal_high dedup proves the snapshot
   already holds it).  A transport death BEFORE the frame consults, in
   order: the supervisor's adopted-tag record (the dead worker's
-  pending journal, scanned before adoption) and the current owner's
-  in-memory ``tag_seen`` set (the live-worker case) — only a tag
-  NEITHER has seen is resubmitted.  The one residual double-apply
-  window is a worker that journals, executes, settles AND snapshots a
-  submit in the microseconds before writing the first frame — see
-  docs/FLEET.md for why that is accepted.
+  pending journal, scanned before adoption), the store's durable
+  settled-tag ack log (a worker that journaled, executed AND settled
+  the submit in the microseconds before writing its first frame — the
+  entry is gone from the journal, but the executor acked the tag
+  before removing it), and the current owner's in-memory ``tag_seen``
+  set (the live-worker case) — only a tag NONE of them has seen is
+  resubmitted.
 * **retryable reads** — reads that lose their connection re-route and
   re-ask; a read that lands after an adoption executes against the
   restored snapshot (rng stream included), so retried measurements
@@ -233,6 +234,12 @@ class FleetFrontDoor:
         if self.sup.tag_adopted(tag):
             # the dead worker's pending journal held our tag at scan
             # time; the adopter replays it
+            return True
+        if self.sup.tag_settled(tag):
+            # the worker journaled, executed AND settled the submit,
+            # then died before writing the first frame: the entry is
+            # gone from the journal (the adoption scan can't see it)
+            # but the settle-time durable ack proves it landed
             return True
         try:
             rep = client.request({"op": "tag_seen", "tag": tag})

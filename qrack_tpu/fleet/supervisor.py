@@ -599,6 +599,17 @@ class FleetSupervisor:
         with self._lock:
             return tag in self._adopted_tags
 
+    def tag_settled(self, tag: str) -> bool:
+        """True when some worker durably ACKED `tag` as settled (the
+        store's ack log, written before the WAL entry is removed) — the
+        effect already applied; never resubmit.  This is the record
+        that closes the journal-settle-die-before-frame window the
+        adoption scan cannot see (the entry is already gone)."""
+        try:
+            return self._store_view().tag_acked(tag)
+        except Exception:  # noqa: BLE001 — advisory, like the tag scan
+            return False
+
     def client(self, name: str) -> FleetClient:
         return self._workers[name].client
 

@@ -168,6 +168,9 @@ def _dispatch(svc, state: _WorkerState, op: str, req: dict) -> dict:
             "name": state.name, "pid": os.getpid(),
             "ready": state.ready, "draining": state.draining,
             "sessions": len(svc.sessions.ids()),
+            "queue_depth": svc.scheduler.depth(),
+            "inflight": svc.executor.inflight_jobs,
+            "staged": svc.executor.staged_jobs,
             "ttfr_s": state.ttfr_s, "boot_s": state.boot_s,
             "telemetry": _tele.snapshot(include_events=False)}}
     if op == "shutdown":
@@ -238,6 +241,12 @@ def main(argv=None) -> int:
         rec = {"name": args.name, "ready": state.ready,
                "draining": state.draining,
                "sessions": len(svc.sessions.ids()),
+               # pipeline depth in every beat: the supervisor's stats
+               # (and a capacity-aware placement later) can see how
+               # loaded each worker is without an extra RPC
+               "queue_depth": svc.scheduler.depth(),
+               "inflight": svc.executor.inflight_jobs,
+               "staged": svc.executor.staged_jobs,
                "ttfr_s": state.ttfr_s,
                "boot_s": state.boot_s}
         if _tele._ENABLED:

@@ -72,6 +72,10 @@ class QCircuit:
     def __init__(self, qubit_count: int = 0):
         self.qubit_count = qubit_count
         self.gates: List[QCircuitGate] = []
+        # memoized structure_digest — the serving plane hashes a
+        # circuit once per submit AND once per dispatch, and sha1 over
+        # every payload's bytes is milliseconds on ~100-gate circuits
+        self._digest_cache: Optional[str] = None
 
     # ------------------------------------------------------------------
 
@@ -80,6 +84,7 @@ class QCircuit:
         algebraic combining of same-target/controls neighbors and
         commuting past disjoint gates)."""
         self.qubit_count = max(self.qubit_count, max(gate.qubits()) + 1)
+        self._digest_cache = None
         # walk back past gates on disjoint qubits to find a merge partner
         i = len(self.gates) - 1
         gset = set(gate.qubits())
@@ -263,7 +268,15 @@ class QCircuit:
         AND payload values.  Two circuits share a digest iff they trace
         to the same jaxpr with the same baked-in gate constants
         (compile_fn embeds matrices as literals), which is the batch
-        identity the serving layer keys on."""
+        identity the serving layer keys on.
+
+        Memoized per instance (invalidated by AppendGate): the serving
+        plane hashes every submit on its caller thread and every
+        dispatch in batch_program, and recomputing sha1 over ~100
+        payload buffers each time was a measurable per-batch host cost
+        competing with the dispatch owner for the core."""
+        if self._digest_cache is not None:
+            return self._digest_cache
         import hashlib
 
         h = hashlib.sha1()
@@ -272,7 +285,8 @@ class QCircuit:
             for perm in sorted(g.payloads):
                 h.update(f"p{perm}:".encode())
                 h.update(np.ascontiguousarray(g.payloads[perm]).tobytes())
-        return h.hexdigest()
+        self._digest_cache = h.hexdigest()
+        return self._digest_cache
 
     def shape_key(self, n: int) -> Tuple[int, int, str]:
         """Batch-bucket key at engine width `n`: (width, gate-count

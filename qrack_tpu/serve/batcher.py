@@ -4,8 +4,10 @@ N tenants running the same circuit should pay ONE dispatch round-trip,
 not N (the mpiQulacs / TensorCircuit-NG batching result the ISSUE
 cites).  QCircuit.compile_fn already traces a whole circuit into one
 XLA program over (2, 2^n) planes; here that body is vmapped over a
-leading batch axis, so B sessions' kets stack into a (B, 2, 2^n)
-operand and the whole batch runs as one compiled program.
+leading batch axis and wrapped so the lane stack, the padding, and the
+per-lane output split all happen INSIDE the compiled program: the host
+hands over a list of B plane references and gets a tuple of B outputs
+back for the cost of a single jit dispatch.
 
 Batch identity is QCircuit.shape_key(n) — width + gate-count bucket +
 a content digest covering payload values, because compile_fn bakes
@@ -14,10 +16,21 @@ circuits share a program.  Compiled batch programs live in a PR-1
 ProgramCache (`compile.serve_batch.*` counters) keyed by
 (shape_key, B), so the second session with a known shape is a cache
 hit, never a recompile.
+
+Batch sizes are BUCKETED to the next power of two before compilation
+(``QRACK_SERVE_BATCH_PAD=0`` restores exact sizes): arrival-limited
+traffic produces every occupancy in 1..max_batch, and with exact-size
+keys each occupancy is its own 1-2s jit compile — a compile storm the
+loadgen bench measured at ~30x steady-state throughput loss.  Padding
+lanes replicate the batch's first ket (a real normalized state, so no
+zero-norm lane can NaN under normalizing ops); only the real lanes
+are written back.  The padded FLOPs are bounded at 2x and the compile
+count drops from O(max_batch) to O(log max_batch) per shape.
 """
 
 from __future__ import annotations
 
+import os
 from typing import List
 
 import numpy as np
@@ -40,16 +53,30 @@ def set_manifest(manifest) -> None:
 
 
 def batch_program(circuit, n: int, batch: int):
-    """The jitted (B, 2, 2^n) -> (B, 2, 2^n) program applying `circuit`
-    to every stacked ket.  The stack is always a fresh array (the
-    sessions' resident planes are never donated), so a failed dispatch
-    leaves every session's state intact for failover replay."""
+    """The jitted program applying `circuit` to `batch` independent
+    kets: takes a LIST of `batch` (2, 2^n) plane arrays, returns a
+    TUPLE of `batch` (2, 2^n) outputs.  Stacking the lanes, the
+    vmapped circuit body, and the per-lane split are all INSIDE the
+    one compiled program: dispatching a batch costs one jit call
+    instead of ~2B host-side jax ops (the B-input stack, the padding
+    concat, and B output slices each paid ~1-2 ms of per-op dispatch
+    overhead — more than the window the pipeline hides).  The stack is
+    a fresh buffer inside the program (resident planes are never
+    donated), so a failed dispatch leaves every session's state intact
+    for failover replay."""
     key = (circuit.shape_key(n), batch)
 
     def build():
         import jax
+        import jax.numpy as jnp
 
-        return jax.jit(circuit.compile_batched_fn(n), donate_argnums=(0,))
+        body = circuit.compile_batched_fn(n)
+
+        def run(planes):
+            out = body(jnp.stack(planes))
+            return tuple(out[i] for i in range(batch))
+
+        return jax.jit(run)
 
     fn = _PROGRAMS.get_or_build(key, build)
     if _MANIFEST is not None:
@@ -57,24 +84,37 @@ def batch_program(circuit, n: int, batch: int):
     return fn
 
 
-def run_batch(jobs: List, engines: List):
-    """Dispatch one same-shape batch: stack the sessions' planes, run
-    the vmapped program, write each output slice back, and return the
-    batched output (the executor's honest-sync target).  Raises
-    whatever the dispatch raises — the executor owns guarding and
-    failover."""
-    import jax.numpy as jnp
+def _bucket(b: int) -> int:
+    """Next power of two >= b — the compiled batch sizes traffic of any
+    occupancy maps onto."""
+    return 1 << max(b - 1, 0).bit_length()
 
+
+def run_batch(jobs: List, engines: List):
+    """Dispatch one same-shape batch: hand the sessions' resident
+    planes to the batch program as a list (padding lanes up to the
+    power-of-two bucket are duplicate references to lane 0 — free on
+    the host), run it as ONE jit call, bind each real output lane back
+    to its engine, and return the output tuple (the executor's
+    honest-sync target).  Raises whatever the dispatch raises — the
+    executor owns guarding and failover."""
     from .. import resilience as _res
 
     job0 = jobs[0]
     n = job0.session.width
-    fn = batch_program(job0.circuit, n, len(jobs))
-    stacked = jnp.stack([eng.device_planes for eng in engines])
+    padded = (len(jobs)
+              if os.environ.get("QRACK_SERVE_BATCH_PAD", "1") == "0"
+              else _bucket(len(jobs)))
+    fn = batch_program(job0.circuit, n, padded)
+    planes = [eng.device_planes for eng in engines]
+    if padded > len(jobs):
+        planes.extend(planes[:1] * (padded - len(jobs)))
+        if _tele._ENABLED:
+            _tele.inc("serve.batch.pad_lanes", padded - len(jobs))
     if _res._ACTIVE:
-        out = _res.call_guarded("serve.dispatch", fn, (stacked,))
+        out = _res.call_guarded("serve.dispatch", fn, (planes,))
     else:
-        out = fn(stacked)
+        out = fn(planes)
     for i, eng in enumerate(engines):
         eng.device_planes = out[i]
     if _tele._ENABLED:
@@ -84,12 +124,16 @@ def run_batch(jobs: List, engines: List):
 
 
 def sync_scalar(arr) -> None:
-    """Honest completion for a batched output: one real device->host
-    read of a single element (the utils/timing.py devget discipline —
+    """Honest completion for a batch output: one real device->host read
+    of a single element (the utils/timing.py devget discipline —
     block_until_ready over the relay acks dispatch, not completion).
-    Reading ANY element forces the producing program to finish."""
+    Reading ANY element of ANY output forces the whole producing
+    program to finish, so for the tuple a batch program returns it
+    suffices to read lane 0."""
     import jax
 
+    if isinstance(arr, (tuple, list)):
+        arr = arr[0]
     np.asarray(jax.device_get(arr[(slice(0, 1),) * arr.ndim]))
 
 

@@ -7,6 +7,24 @@ codifies the one-jax-client rule in code: concurrent jax clients have
 coincided with fresh tunnel wedges (CLAUDE.md), so serialization is a
 correctness discipline here, not a simplification.
 
+Two dispatch modes, both on this one thread (QRACK_SERVE_PIPELINE):
+
+* **serial** (=0): pull a batch, run it to devget-honest completion,
+  then look at the queue again — the original loop, preserved
+  byte-for-byte for A/B honesty.
+* **pipelined** (default): dispatch is split into submit-then-sync.
+  The jitted batch call returns a future-like device value, so after
+  submitting batch N the owner thread goes straight back to the
+  scheduler and *stages* batch N+1 (batch assembly + the co-batch
+  window, pre-dispatch shed, spill fault-in, routing apply_plan) while
+  batch N executes on device; only then does it pay batch N's honest
+  devget.  Same-shape jobs that arrive while batch N is syncing join
+  the staged batch (scheduler.take_joiners) instead of waiting a full
+  cycle.  The overlap never moves jax work off this thread — staging
+  only ever runs between the previous submit and its sync, so the
+  one-client discipline is untouched; what overlaps is the host-side
+  scheduling wait with device execution.
+
 Every batched dispatch is wrapped in resilience.call_guarded at site
 "serve.dispatch" and its completing read at "serve.device_get" (when
 the resilience layer is active), so the watchdog / retry / breaker
@@ -15,7 +33,13 @@ When a dispatch escalates past retry (FAILOVER_ERRORS), every job in
 the batch fails over INDIVIDUALLY: the session's pre-batch ket is
 still intact (the batch stack is a copy, never a donation of resident
 planes), so fail_over_engine snapshots it onto the next engine in the
-pager→tpu→cpu chain and the job replays gate-at-a-time there.
+pager→tpu→cpu chain and the job replays gate-at-a-time there.  In
+pipelined mode the exactly-once window widens to one in-flight + one
+staged batch, but the staged batch is never dispatched before the
+in-flight one fully settles (including any failover replay), and its
+engines are re-resolved at its own dispatch — so a failed-over session
+in the staged batch simply takes the gate-at-a-time path and no job
+ever applies twice.
 
 Job completion is devget-honest: a handle only completes after a real
 one-element device->host read of the batched output, because
@@ -36,14 +60,33 @@ from .scheduler import Job, Scheduler
 from .session import SessionManager, planes_engine
 
 
+class _InFlight:
+    """One submitted-but-unsynced batch: everything the deferred sync
+    needs to settle it (or roll it back and fail it over)."""
+
+    __slots__ = ("jobs", "engines", "pre_planes", "out", "span", "t0")
+
+    def __init__(self, jobs, engines, pre_planes, out, span, t0):
+        self.jobs = jobs
+        self.engines = engines
+        self.pre_planes = pre_planes
+        self.out = out
+        self.span = span          # open serve.execute span (submit->sync)
+        self.t0 = t0
+
+
 class Executor:
     def __init__(self, scheduler: Scheduler, sessions: SessionManager,
                  tick_s: float = 0.25, sync: bool = True, canary=None,
-                 checkpoint_every_job: bool = False):
+                 checkpoint_every_job: bool = False,
+                 pipeline: bool = True):
         self.scheduler = scheduler
         self.sessions = sessions
         self.tick_s = tick_s
         self.sync = sync  # devget-honest completion (QRACK_SERVE_SYNC)
+        # QRACK_SERVE_PIPELINE: submit-then-sync double buffering (the
+        # serial loop is preserved exactly under =0)
+        self.pipeline = pipeline
         # sampled oracle-replay verification (serve/canary.py); None
         # unless QRACK_SERVE_CANARY_RATE > 0 — the default costs one
         # attribute test per batch
@@ -52,6 +95,11 @@ class Executor:
         # remove, so there is NO instant where a completed job is
         # neither on disk nor in the journal (fleet zero-loss contract)
         self.checkpoint_every_job = checkpoint_every_job
+        # heartbeat-visible pipeline depth (plain ints, owner-thread
+        # writes, racy cross-thread reads are fine for beats)
+        self.inflight_jobs = 0
+        self.staged_jobs = 0
+        self._last_evict = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -77,20 +125,106 @@ class Executor:
     # -- main loop -----------------------------------------------------
 
     def _loop(self) -> None:
+        if self.pipeline:
+            self._loop_pipelined()
+        else:
+            self._loop_serial()
+
+    def _loop_serial(self) -> None:
         while not self._stop.is_set():
             batch = self.scheduler.next_batch(timeout=self.tick_s)
             if batch is None:
                 self.sessions.evict_idle()
+                self._last_evict = time.monotonic()
                 continue
             try:
                 self._run(batch)
             except BaseException as e:  # noqa: BLE001 — never strand handles
-                for job in batch:
-                    if not job.handle.done():
-                        job.handle._fail(e)
-                        self._account(job, ok=False)
+                self._fail_batch(batch, e)
+            # sustained load must not disable idle eviction: spill
+            # checks run every tick_s-ish even when the queue never
+            # drains (they used to run only on idle timeouts)
+            self._maybe_evict()
 
-    def _run(self, batch: List[Job]) -> None:
+    def _loop_pipelined(self) -> None:
+        inflight: Optional[_InFlight] = None
+        try:
+            while not self._stop.is_set():
+                # with a dispatch in flight, poll the queue instead of
+                # blocking: the co-batch window inside next_batch is
+                # the wait worth overlapping with device execution;
+                # with nothing in flight, block a full tick as before
+                timeout = 0.0 if inflight is not None else self.tick_s
+                batch = self.scheduler.next_batch(timeout=timeout)
+                if batch is None:
+                    if inflight is not None:
+                        inflight = self._settle(inflight)
+                    else:
+                        self.sessions.evict_idle()
+                        self._last_evict = time.monotonic()
+                    continue
+                if inflight is not None:
+                    # batch N+1 is staged; batch N's honest sync ran
+                    # concurrently with the assembly above
+                    if _tele._ENABLED:
+                        _tele.inc("serve.overlap.staged")
+                        _tele.gauge("serve.pipeline.staged", len(batch))
+                    self.staged_jobs = len(batch)
+                    inflight = self._settle(inflight)
+                    # in-flight joining: same-shape arrivals that
+                    # landed during the sync join the staged batch
+                    batch = self._join_staged(batch)
+                self.staged_jobs = 0
+                if _tele._ENABLED:
+                    _tele.gauge("serve.pipeline.staged", 0)
+                try:
+                    inflight = self._run_pipelined(batch)
+                except BaseException as e:  # noqa: BLE001
+                    self._fail_batch(batch, e)
+                self._maybe_evict()
+        finally:
+            if inflight is not None:
+                try:
+                    self._settle(inflight)
+                except BaseException:  # noqa: BLE001 — exiting anyway
+                    pass
+
+    def _maybe_evict(self) -> None:
+        now = time.monotonic()
+        if now - self._last_evict >= self.tick_s:
+            self._last_evict = now
+            self.sessions.evict_idle()
+
+    def _fail_batch(self, batch: List[Job], e: BaseException) -> None:
+        for job in batch:
+            if not job.handle.done():
+                job.handle._fail(e)
+                self._account(job, ok=False)
+
+    def _join_staged(self, batch: List[Job]) -> List[Job]:
+        head = batch[0]
+        if not head.batchable:
+            return batch
+        room = self.scheduler.max_batch - len(batch)
+        if room <= 0:
+            return batch
+        sids = {j.session.sid for j in batch if j.session is not None}
+        extra = self.scheduler.take_joiners(head.shape_key, sids, room)
+        if extra:
+            if _tele._ENABLED:
+                _tele.inc("serve.overlap.join.jobs", len(extra))
+            batch = batch + extra
+        return batch
+
+    # -- per-batch pre-dispatch work (both modes) ----------------------
+
+    def _prepare(self, batch: List[Job]) -> List[Job]:
+        """Shed over-budget jobs, then run every pre-dispatch stage:
+        start stamps, spill fault-in, routing plan realization, elastic
+        re-expansion probes, canary pre-capture.  Returns the live
+        jobs.  In pipelined mode this runs only after the previous
+        batch fully settled, so everything here sees settled engines —
+        identical ordering to the serial path."""
         # pre-dispatch shed: the admission-side expiry only sees jobs
         # still in the heap — a job whose budget ran out while its batch
         # was being assembled (the batch window holds the door open)
@@ -110,7 +244,7 @@ class Executor:
                 else:
                     live.append(job)
             if not live:
-                return
+                return live
             batch = live
         for job in batch:
             job.handle._start()
@@ -149,6 +283,22 @@ class Executor:
                 if (job.kind == "circuit" and job.session is not None
                         and self.canary.should_sample()):
                     self.canary.capture_pre(job)
+        return batch
+
+    def _misroute_checks(self, batch: List[Job]) -> None:
+        # job-boundary mis-route probe: a stabilizer forced off-tableau
+        # or a QBdt past its node budget escalates (once) right here,
+        # before the next job lands on the wrong representation
+        for job in batch:
+            sess = job.session
+            if (job.kind == "circuit" and sess is not None
+                    and getattr(sess.engine, "_is_routed", False)):
+                sess.engine.misroute_check()
+
+    def _run(self, batch: List[Job]) -> None:
+        batch = self._prepare(batch)
+        if not batch:
+            return
         # remap-planner horizon: a session executing several queued
         # circuits plans placement across the WHOLE batch, not just the
         # window in hand (ops/fusion.py plan_remaps lookahead)
@@ -161,14 +311,37 @@ class Executor:
         finally:
             for fuser in primed:
                 fuser.clear_lookahead()
-        # job-boundary mis-route probe: a stabilizer forced off-tableau
-        # or a QBdt past its node budget escalates (once) right here,
-        # before the next job lands on the wrong representation
-        for job in batch:
-            sess = job.session
-            if (job.kind == "circuit" and sess is not None
-                    and getattr(sess.engine, "_is_routed", False)):
-                sess.engine.misroute_check()
+        self._misroute_checks(batch)
+
+    def _run_pipelined(self, batch: List[Job]) -> Optional[_InFlight]:
+        """Prepare + dispatch one batch; batchable dispatches return an
+        _InFlight (sync deferred until the NEXT batch is staged),
+        everything else runs to completion as in serial mode."""
+        t0 = time.perf_counter()
+        batch = self._prepare(batch)
+        if not batch:
+            return None
+        primed = self._prime_lookahead(batch)
+        try:
+            if batch[0].batchable:
+                inflight = self._dispatch_async(batch)
+            else:
+                self._run_single(batch[0])
+                inflight = None
+        finally:
+            for fuser in primed:
+                fuser.clear_lookahead()
+        if inflight is None:
+            # stale/singleton/failed-at-dispatch paths settled in place
+            self._misroute_checks(batch)
+            return None
+        if _tele._ENABLED:
+            _tele.record_span("serve.stage.dispatch", t0,
+                              time.perf_counter() - t0,
+                              trace=inflight.jobs[0].trace)
+            _tele.gauge("serve.pipeline.inflight", len(inflight.jobs))
+        self.inflight_jobs = len(inflight.jobs)
+        return inflight
 
     def _prime_lookahead(self, batch: List[Job]) -> List[object]:
         """Install a batch-wide lookahead on each session fuser that is
@@ -197,9 +370,12 @@ class Executor:
 
     # -- batched circuit path ------------------------------------------
 
-    def _run_batched(self, jobs: List[Job]) -> None:
-        from .. import resilience as _res
-
+    def _dispatch_async(self, jobs: List[Job]) -> Optional[_InFlight]:
+        """The submit half of a batched dispatch: stale-split, pin the
+        pre-batch planes, run_batch (the jitted call returns a
+        future-like device value).  Returns the in-flight record, or
+        None when everything already settled (all-stale batch, or a
+        dispatch-side escalation that failed over in place)."""
         engines = [planes_engine(j.session.engine) for j in jobs]
         # a session may have failed over (to a non-plane engine) after
         # this job was queued as batchable — run those gate-at-a-time
@@ -216,7 +392,7 @@ class Executor:
             jobs = [j for j, e in zip(jobs, engines) if e is not None]
             engines = [e for e in engines if e is not None]
             if not jobs:
-                return
+                return None
         # pin the pre-batch planes: run_batch writes its output back to
         # the engines BEFORE the honest sync, so a sync-side escalation
         # must roll the engines back or the failover replay would apply
@@ -227,36 +403,84 @@ class Executor:
         # observes and the worker-side submit spans)
         span = (_tele.span("serve.execute", trace=jobs[0].trace)
                 if _tele._ENABLED else None)
+        t0 = time.perf_counter()
+        if span:
+            span.__enter__()
         try:
-            if span:
-                span.__enter__()
-            try:
-                out = _batcher.run_batch(jobs, engines)
-                if self.sync:
-                    if _res._ACTIVE:
-                        _res.call_guarded("serve.device_get",
-                                          _batcher.sync_scalar, (out,))
-                    else:
-                        _batcher.sync_scalar(out)
-            finally:
-                if span:
-                    span.__exit__(None, None, None)
+            out = _batcher.run_batch(jobs, engines)
         except FAILOVER_ERRORS as e:
+            if span:
+                span.__exit__(None, None, None)
             for eng, planes in zip(engines, pre_planes):
                 eng.device_planes = planes
             self._fail_over_jobs(jobs, e)
+            return None
+        except BaseException:
+            if span:
+                span.__exit__(None, None, None)
+            raise
+        return _InFlight(jobs, engines, pre_planes, out, span, t0)
+
+    def _sync_settle(self, inf: _InFlight) -> None:
+        """The sync half: devget-honest completion for a submitted
+        batch, with the same rollback + per-job failover the serial
+        path has when the read escalates."""
+        from .. import resilience as _res
+
+        t_sync = time.perf_counter()
+        try:
+            if self.sync:
+                if _res._ACTIVE:
+                    _res.call_guarded("serve.device_get",
+                                      _batcher.sync_scalar, (inf.out,))
+                else:
+                    _batcher.sync_scalar(inf.out)
+        except FAILOVER_ERRORS as e:
+            if inf.span:
+                inf.span.__exit__(None, None, None)
+            for eng, planes in zip(inf.engines, inf.pre_planes):
+                eng.device_planes = planes
+            self._fail_over_jobs(inf.jobs, e)
             return
-        for job in jobs:
+        except BaseException as e:  # noqa: BLE001 — never strand handles
+            if inf.span:
+                inf.span.__exit__(None, None, None)
+            self._fail_batch(inf.jobs, e)
+            return
+        if inf.span:
+            inf.span.__exit__(None, None, None)
+        if _tele._ENABLED:
+            now = time.perf_counter()
+            _tele.observe("serve.overlap.sync_wait", now - t_sync)
+            _tele.record_span("serve.stage.sync", t_sync, now - t_sync,
+                              trace=inf.jobs[0].trace)
+        for job in inf.jobs:
             self._complete(job, None)
+
+    def _settle(self, inf: _InFlight) -> None:
+        """Settle an in-flight batch completely (sync + completion +
+        job-boundary probes) and clear the depth gauges.  Returns None
+        so callers can assign the cleared in-flight slot."""
+        self._sync_settle(inf)
+        self._misroute_checks(inf.jobs)
+        self.inflight_jobs = 0
+        if _tele._ENABLED:
+            _tele.gauge("serve.pipeline.inflight", 0)
+        return None
+
+    def _run_batched(self, jobs: List[Job]) -> None:
+        inf = self._dispatch_async(jobs)
+        if inf is not None:
+            self._sync_settle(inf)
 
     def _fail_over_jobs(self, jobs: List[Job], cause) -> None:
         """Per-job engine failover + gate-at-a-time replay.  Session
         planes were never donated into the failed batch (the stack is a
-        copy) and _run_batched restored them if the batch had already
-        written back, so each snapshot equals the pre-batch state and
-        the replay is exact.  replay_with_failover walks the whole
-        elastic chain (pager shrink → … → tpu → cpu) when the fault
-        persists across replays."""
+        copy) and the dispatch/sync paths restored them if the batch had
+        already written back, so each snapshot equals the pre-batch
+        state and the replay is exact.  replay_with_failover walks the
+        whole elastic chain (pager shrink → … → tpu → cpu) when the
+        fault persists across replays."""
         from ..resilience.failover import replay_with_failover
 
         if _tele._ENABLED:
@@ -390,6 +614,13 @@ class Executor:
                     self.sessions.spill_store.mark_dirty(job.session.sid)
         wal_path = getattr(job, "wal_path", None)
         if wal_path is not None and self.sessions.spill_store is not None:
+            if ok and job.tag is not None:
+                # durable settled-tag ack BEFORE the entry disappears:
+                # the front door's resubmit decision can then prove "this
+                # tag landed" even when the worker died in the
+                # microseconds between settling and writing its first
+                # frame (the PR 11 residual double-apply window)
+                self.sessions.spill_store.ack_tag(job.tag)
             # settled either way: a failed job must not replay at recovery
             self.sessions.spill_store.wal_remove(wal_path)
             job.wal_path = None

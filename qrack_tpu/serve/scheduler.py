@@ -10,16 +10,27 @@ Admission (all checks at submit(), synchronous, typed — errors.py):
 * queue-time budget — a job queued past QRACK_SERVE_QUEUE_BUDGET_MS
   is expired with QueueBudgetExceeded instead of executing stale.
 
-Dispatch order is (-priority, submit sequence): higher priority first,
-FIFO within a priority — so two jobs from one session at equal
-priority always execute in submit order (the batcher additionally
-never co-batches one session twice).
+Dispatch order is fair aged priority, not a bare (-priority, seq)
+heap.  Each queued job's *effective band* is
+``priority + waited_s / aging_s`` (QRACK_SERVE_AGING_S, 0 = strict
+priority): sustained high-priority load can no longer starve a
+priority-0 tenant forever, because every second waited promotes it one
+band.  Within a band, selection is weighted round-robin across
+sessions — each dispatched job charges its session ``1/weight`` of
+virtual service time and the least-served session goes first — so one
+chatty tenant can't monopolize the lane.  Ties break on submit
+sequence, which keeps two jobs from one session at equal priority in
+submit order (the batcher additionally never co-batches one session
+twice).
 
-next_batch() is the executor's only entry point: it pops the best
+next_batch() is the executor's main entry point: it pops the best
 runnable job and, when the job is batchable, holds the door open up to
 QRACK_SERVE_BATCH_WINDOW_MS for same-shape jobs from OTHER sessions,
 up to QRACK_SERVE_MAX_BATCH.  The window closes early once the batch
-is full, so a saturated queue pays no added latency.
+is full, so a saturated queue pays no added latency.  take_joiners()
+is the pipelined executor's second entry point: same-shape arrivals
+that landed while the previous batch's sync was in flight join the
+staged (not yet dispatched) batch instead of waiting a full cycle.
 """
 
 from __future__ import annotations
@@ -96,7 +107,7 @@ class JobHandle:
 class Job:
     __slots__ = ("session", "kind", "circuit", "fn", "shape_key",
                  "priority", "seq", "handle", "wal_path", "mutates",
-                 "trace")
+                 "tag", "trace")
 
     def __init__(self, session: Optional[Session], kind: str, *,
                  circuit=None, fn: Optional[Callable] = None,
@@ -111,6 +122,7 @@ class Job:
         self.seq = 0              # assigned by the scheduler
         self.handle = JobHandle(session.sid if session else "-", kind)
         self.wal_path = None      # journal entry to settle (checkpointing)
+        self.tag = None           # fleet dedup tag (durable ack at settle)
         # does settling this job advance the session past its on-disk
         # snapshot?  Circuits always do; "call" jobs that collapse state
         # or consume the rng stream (MAll, sampling) do too, while pure
@@ -130,12 +142,20 @@ class Job:
 
 class Scheduler:
     def __init__(self, max_depth: int, queue_budget_s: float,
-                 batch_window_s: float, max_batch: int):
+                 batch_window_s: float, max_batch: int,
+                 aging_s: float = 1.0):
         self.max_depth = max(1, max_depth)
         self.queue_budget_s = queue_budget_s
         self.batch_window_s = max(0.0, batch_window_s)
         self.max_batch = max(1, max_batch)
+        # waited-time aging: one priority band gained per aging_s
+        # queued (0 = strict priority, the pre-fairness behavior)
+        self.aging_s = max(0.0, aging_s)
         self._heap: List[tuple] = []   # (-priority, seq, Job)
+        # weighted round-robin state: virtual service time per sid —
+        # each dispatched job charges its session 1/weight, and the
+        # least-served session in the top band dispatches first
+        self._served: dict = {}
         self._cond = threading.Condition()
         self._seq = 0
         self._stopped = False
@@ -212,6 +232,40 @@ class Scheduler:
         if _tele._ENABLED:
             _tele.gauge("serve.queue.depth", len(self._heap))
 
+    def _charge_locked(self, job: Job) -> None:
+        """Accrue virtual service time against the dispatched job's
+        session (1/weight per job).  Caller holds the lock."""
+        sess = job.session
+        sid = sess.sid if sess is not None else "-"
+        weight = getattr(sess, "weight", 1.0) if sess is not None else 1.0
+        if len(self._served) > 4096:
+            # bound tenant-churn growth; resetting everyone to zero is
+            # fair-neutral (relative order restarts from scratch)
+            self._served.clear()
+        self._served[sid] = (self._served.get(sid, 0.0)
+                             + 1.0 / max(weight, 1e-6))
+
+    def _pop_best_locked(self) -> Job:
+        """Remove and return the next job to dispatch: highest aged
+        priority band first, then least virtual service time (weighted
+        round-robin across sids), then submit order.  Caller holds the
+        lock; the heap is non-empty."""
+        now = time.perf_counter()
+        best_i, best_key = 0, None
+        for i, entry in enumerate(self._heap):
+            job = entry[2]
+            band = job.priority
+            if self.aging_s > 0:
+                band += int((now - job.handle.t_submit) / self.aging_s)
+            sid = job.session.sid if job.session is not None else "-"
+            key = (-band, self._served.get(sid, 0.0), job.seq)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        job = self._heap.pop(best_i)[2]
+        heapq.heapify(self._heap)
+        self._charge_locked(job)
+        return job
+
     def _take_matching_locked(self, key, exclude_sids: set,
                               limit: int) -> List[Job]:
         """Remove up to `limit` queued batchable jobs with shape `key`,
@@ -236,11 +290,27 @@ class Scheduler:
                     and job.seq == first_seq.get(job.session.sid)):
                 taken.append(job)
                 exclude_sids.add(job.session.sid)
+                self._charge_locked(job)
             else:
                 keep.append(entry)
         if taken:
             self._heap = keep
             heapq.heapify(self._heap)
+        return taken
+
+    def take_joiners(self, key, exclude_sids: set,
+                     limit: int) -> List[Job]:
+        """Pipelined executor's late-join grab: pull same-shape-key
+        jobs that arrived while the previous batch's sync was in flight
+        into the staged (not yet dispatched) batch — same per-session
+        ordering rules as the batch window, no extra wait."""
+        if limit <= 0:
+            return []
+        with self._cond:
+            self._expire_locked(time.perf_counter())
+            taken = self._take_matching_locked(key, exclude_sids, limit)
+            if taken and _tele._ENABLED:
+                _tele.gauge("serve.queue.depth", len(self._heap))
         return taken
 
     def next_batch(self, timeout: float = 0.25) -> Optional[List[Job]]:
@@ -256,7 +326,7 @@ class Scheduler:
                 if self._stopped or remaining <= 0:
                     return None
                 self._cond.wait(remaining)
-            _, _, job = heapq.heappop(self._heap)
+            job = self._pop_best_locked()
             batch = [job]
             if job.batchable and self.max_batch > 1:
                 sids = {job.session.sid}

@@ -16,6 +16,25 @@ blocks on a JobHandle.  Env knobs (constructor args override):
 * ``QRACK_SERVE_QUEUE_BUDGET_MS``  max queued age before a job expires
                                    (default 2000; 0 disables)
 * ``QRACK_SERVE_IDLE_EVICT_S``     idle-session eviction (default 0=off)
+* ``QRACK_SERVE_PIPELINE``         "0": serial dispatch (pull a batch,
+                                   run it to devget-honest completion,
+                                   repeat).  Default "1": two-stage
+                                   pipeline — batch N+1 is assembled
+                                   and staged while batch N executes
+                                   on device, and same-shape arrivals
+                                   join the staged batch
+                                   (docs/SERVING.md)
+* ``QRACK_SERVE_AGING_S``          waited-time priority aging: a queued
+                                   job gains one priority band per this
+                                   many seconds (default 1.0; 0 =
+                                   strict priority, which can starve)
+* ``QRACK_SERVE_BATCH_PAD``        "0": compile batch programs at exact
+                                   batch sizes.  Default: pad each
+                                   batch to the next power of two
+                                   (replicated lanes, real slices
+                                   written back) so compile variety is
+                                   O(log max_batch), not one 1-2s jit
+                                   per occupancy (serve/batcher.py)
 * ``QRACK_SERVE_SYNC``             "devget" (default, honest completion)
                                    or "none"
 * ``QRACK_SERVE_CHECKPOINT_DIR``   enable the checkpoint subsystem
@@ -93,6 +112,8 @@ class QrackService:
                  prewarm: Optional[bool] = None,
                  hold_lease: Optional[bool] = None,
                  checkpoint_every_job: Optional[bool] = None,
+                 pipeline: Optional[bool] = None,
+                 aging_s: Optional[float] = None,
                  **engine_kwargs):
         if max_depth is None:
             max_depth = int(_env_float("QRACK_SERVE_MAX_DEPTH", 64))
@@ -116,6 +137,10 @@ class QrackService:
         if checkpoint_every_job is None:
             checkpoint_every_job = os.environ.get(
                 "QRACK_SERVE_CKPT_EVERY_JOB", "0") == "1"
+        if pipeline is None:
+            pipeline = os.environ.get("QRACK_SERVE_PIPELINE", "1") != "0"
+        if aging_s is None:
+            aging_s = _env_float("QRACK_SERVE_AGING_S", 1.0)
         # fleet workers run hold_lease=False: the store lease is only
         # taken around recover()/adoption, never parked across serving,
         # so N workers sharing one store never block a peer's adoption
@@ -151,7 +176,8 @@ class QrackService:
         self.scheduler = Scheduler(max_depth=max_depth,
                                    queue_budget_s=queue_budget_ms / 1e3,
                                    batch_window_s=batch_window_ms / 1e3,
-                                   max_batch=max_batch)
+                                   max_batch=max_batch,
+                                   aging_s=aging_s)
         sync = os.environ.get("QRACK_SERVE_SYNC", "devget") != "none"
         self.canary = None
         canary_rate = _env_float("QRACK_SERVE_CANARY_RATE", 0.0)
@@ -167,7 +193,8 @@ class QrackService:
                                  canary=self.canary,
                                  checkpoint_every_job=(
                                      checkpoint_every_job
-                                     and self.store is not None))
+                                     and self.store is not None),
+                                 pipeline=pipeline)
         self.executor.start()
         self._closed = False
         if self.store is not None and self._hold_lease:
@@ -190,17 +217,20 @@ class QrackService:
 
     def create_session(self, width: int, layers=None,
                        seed: Optional[int] = None, timeout: float = 60.0,
-                       sid: Optional[str] = None,
+                       sid: Optional[str] = None, weight: float = 1.0,
                        **engine_kwargs) -> str:
         """Build a tenant session (engine constructed on the dispatch
         owner — construction is device traffic) and return its id.
         `sid` pins an explicit id — the fleet front door passes one so
-        sids stay globally unique across N workers sharing a store."""
+        sids stay globally unique across N workers sharing a store.
+        `weight` is the tenant's weighted-round-robin share (scheduler
+        fairness: a weight-2 tenant gets twice the lane of weight-1)."""
         layers = self.default_layers if layers is None else layers
         kwargs = {**self.default_engine_kwargs, **engine_kwargs}
         job = Job(None, "admin",
                   fn=lambda: self.sessions.create(width, layers=layers,
                                                   seed=seed, sid=sid,
+                                                  weight=weight,
                                                   **kwargs))
         self.scheduler.submit(job)
         return job.handle.result(timeout).sid
@@ -241,6 +271,7 @@ class QrackService:
                 shape_key = circuit.shape_key(sess.width)
         job = Job(sess, "circuit", circuit=circuit, shape_key=shape_key,
                   priority=priority)
+        job.tag = tag
         if self.store is not None:
             # journal BEFORE admission (the executor may settle the job
             # the instant it is queued); the executor deletes the entry
@@ -414,7 +445,13 @@ class QrackService:
                 if sid in live:
                     continue  # already served here — nothing to adopt
                 dirty = bool(rec.get("dirty", False))
-                wal_high[sid] = int(rec.get("wal_high", -1))
+                # the state container's own wal_high is authoritative:
+                # it commits in the same atomic replace as the state,
+                # while the manifest copy lags one write behind (a kill
+                # between the two used to replay an already-contained
+                # WAL entry — the double-apply the kill9 test caught)
+                wal_high[sid] = max(int(rec.get("wal_high", -1)),
+                                    self.store.state_wal_high(sid))
                 kwargs = {**self.default_engine_kwargs,
                           **rec.get("engine_kwargs", {})}
                 sess = self.sessions.create(

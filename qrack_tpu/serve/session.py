@@ -96,13 +96,18 @@ class Session:
     """One tenant's simulator plus scheduling bookkeeping."""
 
     def __init__(self, sid: str, width: int, layers, engine,
-                 seed: Optional[int], engine_kwargs: Optional[dict] = None):
+                 seed: Optional[int], engine_kwargs: Optional[dict] = None,
+                 weight: float = 1.0):
         self.sid = sid
         self.width = width
         self.layers = layers
         self.engine = engine
         self.seed = seed
         self.engine_kwargs = dict(engine_kwargs or {})  # restore recipe
+        # weighted-round-robin share: each dispatched job charges the
+        # session 1/weight of virtual service time (scheduler.py), so a
+        # weight-2 tenant gets twice the lane of a weight-1 one
+        self.weight = max(float(weight), 1e-6)
         self.spilled = False       # engine persisted to disk, not resident
         now = time.perf_counter()
         self.created_s = now
@@ -182,7 +187,8 @@ class SessionManager:
             return [s.sid for s in self._sessions.values() if s.spilled]
 
     def create(self, width: int, layers="tpu", seed: Optional[int] = None,
-               sid: Optional[str] = None, **engine_kwargs) -> Session:
+               sid: Optional[str] = None, weight: float = 1.0,
+               **engine_kwargs) -> Session:
         """Build a session's engine (EXECUTOR THREAD ONLY — see module
         doc) and register it.  Each session gets its own QrackRandom so
         tenant measurement streams are independent and, when seeded,
@@ -203,7 +209,7 @@ class SessionManager:
                 except ValueError:
                     pass
             sess = Session(sid, width, layers, engine, seed,
-                           engine_kwargs=engine_kwargs)
+                           engine_kwargs=engine_kwargs, weight=weight)
             self._sessions[sid] = sess
         if self.spill_store is not None:
             self.spill_store.register(sid, width, layers, seed,

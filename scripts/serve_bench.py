@@ -21,6 +21,23 @@ does NOT beat that number — same FLOPs, bigger cache footprint — the
 serving win is compile + dispatch-round-trip amortization across
 tenants, not per-gate arithmetic.  docs/SERVING.md records both.
 
+LOADGEN mode (--loadgen, docs/SERVING.md): an open/closed-loop load
+generator over O(1000) synthetic tenants (mixed circuit shapes, fixed
+seed) for the continuous-batching pipeline's A/B.  Closed loop
+(default) keeps --lg-concurrency requests in flight — each completion
+immediately triggers that client's next submit — which is the
+arrival-limited regime where batches stay PARTIAL and the serial
+executor pays the full batch window per batch while the pipelined
+executor hides it behind device execution.  Open loop (--lg-mode
+open) submits at a fixed-seed Poisson --lg-rate instead and measures
+the latency distribution at that offered load.  Every run spawns an
+automatic QRACK_SERVE_PIPELINE=0 child with identical parameters and
+seed; the headline is pipelined-vs-serial steady-state throughput
+(acceptance: >= 1.5x with p99 latency no worse).  Percentiles come
+from the shared telemetry Histogram helpers; a warmup pass of the
+same traffic precedes the timed pass so batch-size compiles land
+outside the measurement in both modes.
+
 MIXED-TRAFFIC mode (--mixed, docs/ROUTING.md): one routed service
 (engine_layers="route") hosts three tenant classes at once — Clifford-
 heavy GHZ tenants, dense quantum-volume tenants, and shallow-QAOA
@@ -39,16 +56,23 @@ Usage:
                                   [--layers tpu] [--window-ms 50] [--json]
     python scripts/serve_bench.py --mixed [--clifford-width 20]
                                   [--qaoa-width 12] [--wide-width 100]
+    python scripts/serve_bench.py --loadgen [--tenants 1000]
+                                  [--lg-requests 2000] [--lg-mode closed]
+                                  [--lg-concurrency 40] [--lg-rate 400]
 
 Exit 0 when the acceptance bar holds (default: cold AND steady-state
 serve rounds < 0.6x the sequential library wall; --mixed: routed
-Clifford class >= 10x faster than dense-forced), 1 otherwise.
+Clifford class >= 10x faster than dense-forced; --loadgen: pipelined
+throughput >= 1.5x the serial A/B child with p99 no worse), 1
+otherwise.
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -192,6 +216,242 @@ def _measure_mixed_phase(args, mode):
     return walls
 
 
+def _lg_mix():
+    """The loadgen's tenant classes: (label, width, circuit factory).
+    Four distinct shape buckets (structure digests differ) with batched
+    execution walls (19-37 ms at bucket 16 on this box) at least as
+    large as the batch window, so an in-flight batch's compute is long
+    enough to hide the next batch's staging window behind — the overlap
+    the A/B resolves.  Smaller circuits finish before the window does
+    and both modes pay window + compute sequentially.  Factories are
+    deterministic — every submission of a class carries identical
+    content, so the digest-keyed ProgramCache batches them."""
+    from qrack_tpu.models.algorithms import (qaoa_qcircuit,
+                                             quantum_volume_qcircuit)
+    from qrack_tpu.utils.rng import QrackRandom
+
+    return [
+        ("qft13", 13, lambda: qft_qcircuit(13)),
+        ("qft14", 14, lambda: qft_qcircuit(14)),
+        ("qaoa13", 13, lambda: qaoa_qcircuit(13, p=2)),
+        ("qv12", 12, lambda: quantum_volume_qcircuit(
+            12, rng=QrackRandom(17))),
+    ]
+
+
+def _lg_precompile(mix, max_batch: int) -> None:
+    """Compile every (class, batch-size bucket) program before traffic
+    starts — the prewarm discipline (checkpoint/warmstart.py), inlined:
+    the steady-state A/B must measure dispatch overlap, not whichever
+    mode happened to hit more cold 1-2s jit compiles.  Runs on the
+    caller thread while the executor is idle (jax is in-process on the
+    CPU backend here; nothing else is dispatching)."""
+    import jax.numpy as jnp
+
+    from qrack_tpu.config import get_config
+    from qrack_tpu.serve import batcher as _batcher
+
+    dtype = get_config().device_real_dtype()
+    pad_on = os.environ.get("QRACK_SERVE_BATCH_PAD", "1") != "0"
+    if pad_on:  # occupancies 1..max_batch land on pow2 buckets
+        sizes, b = [], 1
+        while b < _batcher._bucket(max_batch):
+            sizes.append(b)
+            b <<= 1
+        sizes.append(b)
+    else:
+        sizes = list(range(1, max_batch + 1))
+    for _, w, make in mix:
+        circ = make()
+        for bsz in sizes:
+            fn = _batcher.batch_program(circ, w, bsz)
+            plane = (jnp.zeros((2, 1 << w), dtype=dtype)
+                     .at[0, 0].set(1.0))
+            _batcher.sync_scalar(fn([plane] * bsz))
+
+
+def measure_loadgen(args, pipeline: bool) -> dict:
+    """One loadgen run in THIS process: warmup pass + timed pass of the
+    same fixed-seed traffic against a service built with the given
+    dispatch mode.  Returns the raw per-run metrics dict."""
+    tele.enable()
+    tele.reset()
+    # Dozens of generator threads waking at each batch settle starve
+    # the dispatch-owner thread under the default 5 ms GIL slice: each
+    # release point in the dispatch stage hands the core away for up to
+    # 5 ms x waiters, stretching ~8 ms of host work past the batch's
+    # whole device execution and leaving the pipeline nothing to
+    # overlap.  A sub-ms slice keeps the owner hot in BOTH A/B modes
+    # (set identically here and in the serial child).
+    sys.setswitchinterval(5e-4)
+    mix = _lg_mix()
+    rng = np.random.default_rng(args.lg_seed)
+    total = args.lg_warmup + args.lg_requests
+    tenant_class = rng.integers(0, len(mix), size=args.tenants)
+    order = rng.integers(0, args.tenants, size=total)
+    svc = QrackService(engine_layers=args.layers,
+                       max_depth=total + args.tenants + 64,
+                       batch_window_ms=args.lg_window_ms,
+                       max_batch=args.lg_batch,
+                       queue_budget_ms=600_000.0, tick_s=0.05,
+                       pipeline=pipeline)
+    failed = [0]
+    fail_lock = threading.Lock()
+    try:
+        sids = [svc.create_session(mix[tenant_class[i]][1], seed=10_000 + i)
+                for i in range(args.tenants)]
+        # fresh circuit OBJECT per request (tenants build their own),
+        # constructed before the timed loop so generator threads do no
+        # build work while the executor shares this one core
+        circs = [mix[tenant_class[t]][2]() for t in order]
+        _lg_precompile(mix, args.lg_batch)
+
+        def _one(i, handles, base):
+            try:
+                h = svc.submit(sids[order[i]], circs[i])
+                handles[i - base] = h
+                h.result(600)
+            except Exception:  # noqa: BLE001 — count, keep generating
+                with fail_lock:
+                    failed[0] += 1
+
+        def phase(lo, hi):
+            handles = [None] * (hi - lo)
+            if args.lg_mode == "closed":
+                it = iter(range(lo, hi))
+                lock = threading.Lock()
+
+                def worker():
+                    while True:
+                        with lock:
+                            i = next(it, None)
+                        if i is None:
+                            return
+                        _one(i, handles, lo)
+
+                ts = [threading.Thread(target=worker, daemon=True)
+                      for _ in range(args.lg_concurrency)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+            else:  # open loop: fixed-seed Poisson arrivals
+                gaps = rng.exponential(1.0 / args.lg_rate, size=hi - lo)
+                t0 = time.perf_counter()
+                due = t0
+                for k, i in enumerate(range(lo, hi)):
+                    due += gaps[k]
+                    now = time.perf_counter()
+                    if due > now:
+                        time.sleep(due - now)
+                    try:
+                        handles[i - lo] = svc.submit(sids[order[i]],
+                                                     circs[i])
+                    except Exception:  # noqa: BLE001
+                        failed[0] += 1
+                for h in handles:
+                    if h is not None:
+                        try:
+                            h.result(600)
+                        except Exception:  # noqa: BLE001
+                            failed[0] += 1
+            return handles, time.perf_counter() - t0
+
+        phase(0, args.lg_warmup)   # warms batch-size compiles, both modes
+        failed[0] = 0
+        tele.reset()
+        handles, wall = phase(args.lg_warmup, total)
+    finally:
+        svc.close()
+    lats = [h.latency_s for h in handles
+            if h is not None and h.latency_s is not None]
+    q_waits = [h.queue_wait_s for h in handles
+               if h is not None and h.queue_wait_s is not None]
+    snap = tele.snapshot()
+    cnt = snap["counters"]
+    dispatches = cnt.get("serve.batch.dispatches", 0)
+    batched = cnt.get("serve.batch.jobs", 0)
+    completed = len(lats)
+    return {
+        "pipeline": bool(pipeline),
+        "wall_s": round(wall, 6),
+        "completed": completed, "failed": failed[0],
+        "throughput_jobs_per_s": round(completed / wall, 2) if wall else 0,
+        "latency_p50_s": _pctl(lats, 50), "latency_p99_s": _pctl(lats, 99),
+        "queue_wait_p50_s": _pctl(q_waits, 50),
+        "queue_wait_p99_s": _pctl(q_waits, 99),
+        "dispatches": dispatches, "batch_jobs": batched,
+        "batch_occupancy": round(batched / dispatches, 2) if dispatches
+        else 0,
+        "overlap_staged": cnt.get("serve.overlap.staged", 0),
+        "join_jobs": cnt.get("serve.overlap.join.jobs", 0),
+        "overlap_ratio": round(cnt.get("serve.overlap.staged", 0)
+                               / dispatches, 3) if dispatches else 0,
+        "join_rate": round(cnt.get("serve.overlap.join.jobs", 0)
+                           / batched, 3) if batched else 0,
+        "compile_misses_steady": cnt.get("compile.serve_batch.miss", 0),
+    }
+
+
+def _lg_child_args(args) -> list:
+    """Re-invoke THIS script as the serial A/B child: same parameters,
+    same seed, pipeline forced off."""
+    return [sys.executable, os.path.abspath(__file__), "--loadgen",
+            "--ab-child", "--json", "--lg-pipeline", "0",
+            "--layers", args.layers,
+            "--tenants", str(args.tenants),
+            "--lg-requests", str(args.lg_requests),
+            "--lg-warmup", str(args.lg_warmup),
+            "--lg-mode", args.lg_mode,
+            "--lg-concurrency", str(args.lg_concurrency),
+            "--lg-rate", str(args.lg_rate),
+            "--lg-window-ms", str(args.lg_window_ms),
+            "--lg-batch", str(args.lg_batch),
+            "--lg-seed", str(args.lg_seed)]
+
+
+def run_loadgen(args) -> dict:
+    """Pipelined run in-process, then the automatic serial A/B child
+    (fresh process: its own jit caches, its own executor) with the
+    identical fixed-seed traffic.  The comparison is steady-state
+    throughput and tail latency of the SAME offered load."""
+    res_pipe = measure_loadgen(args, pipeline=args.lg_pipeline != 0)
+    env = dict(os.environ, QRACK_SERVE_PIPELINE="0")
+    proc = subprocess.run(_lg_child_args(args), capture_output=True,
+                          text=True, env=env, timeout=3600)
+    if proc.returncode != 0:
+        raise RuntimeError("serial A/B child failed:\n" + proc.stderr[-2000:])
+    out = proc.stdout
+    res_serial = json.loads(out[out.index("{"):])
+    speedup = (res_pipe["throughput_jobs_per_s"]
+               / max(res_serial["throughput_jobs_per_s"], 1e-9))
+    # "no worse" with a 5% noise floor: on this shared 1-core VM two
+    # runs of the same config jitter by a few percent
+    p99_ok = (res_pipe["latency_p99_s"] is not None
+              and res_serial["latency_p99_s"] is not None
+              and res_pipe["latency_p99_s"]
+              <= res_serial["latency_p99_s"] * 1.05)
+    res = {
+        "mode": "loadgen", "lg_mode": args.lg_mode,
+        "tenants": args.tenants, "requests": args.lg_requests,
+        "warmup": args.lg_warmup, "concurrency": args.lg_concurrency,
+        "rate": args.lg_rate, "window_ms": args.lg_window_ms,
+        "max_batch": args.lg_batch, "seed": args.lg_seed,
+        "classes": [c[0] for c in _lg_mix()],
+        "pipelined": res_pipe, "serial": res_serial,
+        "speedup_throughput": round(speedup, 3),
+        "p99_no_worse": bool(p99_ok),
+        "pass_1p5x": bool(speedup >= 1.5 and p99_ok),
+    }
+    tele.gauge("serve.bench.loadgen_speedup", res["speedup_throughput"])
+    tele.gauge("serve.bench.loadgen_jobs_per_s",
+               res_pipe["throughput_jobs_per_s"])
+    if res_pipe["latency_p99_s"] is not None:
+        tele.gauge("serve.bench.loadgen_p99_s", res_pipe["latency_p99_s"])
+    return res
+
+
 def run_mixed(args) -> dict:
     tele.enable()
     tele.reset()
@@ -310,7 +570,72 @@ def main(argv=None) -> int:
     ap.add_argument("--wide-width", type=int, default=100,
                     help="extra routed-only Clifford tenant width (no "
                          "forced baseline possible; 0 disables)")
+    ap.add_argument("--loadgen", action="store_true",
+                    help="open/closed-loop load generator over O(1000) "
+                         "tenants with an automatic QRACK_SERVE_"
+                         "PIPELINE=0 A/B child (docs/SERVING.md)")
+    ap.add_argument("--ab-child", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one run, JSON out
+    ap.add_argument("--lg-pipeline", type=int, default=1,
+                    help=argparse.SUPPRESS)  # internal: child forces 0
+    ap.add_argument("--tenants", type=int, default=1000)
+    ap.add_argument("--lg-requests", type=int, default=2000,
+                    help="timed-pass requests (default 2000)")
+    ap.add_argument("--lg-warmup", type=int, default=400,
+                    help="warmup-pass requests, untimed (default 400)")
+    ap.add_argument("--lg-mode", choices=("closed", "open"),
+                    default="closed",
+                    help="closed: --lg-concurrency clients resubmit on "
+                         "completion; open: Poisson --lg-rate arrivals")
+    ap.add_argument("--lg-concurrency", type=int, default=40,
+                    help="closed-loop in-flight clients; default keeps "
+                         "per-class demand (~concurrency/4) in the "
+                         "16-lane bucket, where batch compute is "
+                         "comparable to the window and partial batches "
+                         "leave the serial mode paying it in full")
+    ap.add_argument("--lg-rate", type=float, default=400.0,
+                    help="open-loop offered arrivals/s")
+    ap.add_argument("--lg-window-ms", type=float, default=30.0,
+                    help="batch window for the loadgen service — sized "
+                         "near the batched execution wall so overlap "
+                         "is what the A/B resolves")
+    ap.add_argument("--lg-batch", type=int, default=32,
+                    help="service max_batch — sized ABOVE per-class "
+                         "concurrent demand so batches stay partial "
+                         "and the serial mode pays the full window")
+    ap.add_argument("--lg-seed", type=int, default=42)
     args = ap.parse_args(argv)
+
+    if args.ab_child:
+        res = measure_loadgen(args, pipeline=args.lg_pipeline != 0)
+        print(json.dumps(res, sort_keys=True))
+        return 0
+    if args.loadgen:
+        res = run_loadgen(args)
+        if args.json:
+            print(json.dumps(res, indent=1, sort_keys=True))
+        else:
+            p, s = res["pipelined"], res["serial"]
+            print(f"loadgen {res['lg_mode']} loop: {res['tenants']} tenants"
+                  f" x {res['requests']} requests, classes "
+                  f"{'/'.join(res['classes'])}, window "
+                  f"{res['window_ms']}ms, max_batch {res['max_batch']}"
+                  + (f", concurrency {res['concurrency']}"
+                     if res["lg_mode"] == "closed"
+                     else f", rate {res['rate']}/s"))
+            for label, r in (("pipelined", p), ("serial   ", s)):
+                print(f"  {label}: {r['throughput_jobs_per_s']:8.1f} jobs/s"
+                      f" | p50 {r['latency_p50_s'] * 1e3:7.1f} ms"
+                      f" p99 {r['latency_p99_s'] * 1e3:7.1f} ms"
+                      f" | occupancy {r['batch_occupancy']:5.2f}"
+                      f" | overlap {r['overlap_ratio']:.2f}"
+                      f" join {r['join_rate']:.2f}"
+                      f" | failed {r['failed']}")
+            print(f"  speedup {res['speedup_throughput']:.2f}x, p99 "
+                  f"{'no worse' if res['p99_no_worse'] else 'WORSE'}")
+            print(f"  acceptance (>=1.5x, p99 no worse): "
+                  f"{'PASS' if res['pass_1p5x'] else 'FAIL'}")
+        return 0 if res["pass_1p5x"] else 1
 
     if args.mixed:
         res = run_mixed(args)

@@ -19,7 +19,9 @@ sum; spans merge).  Sections:
   * remap: placement-planner traffic — windows planned, swap pairs
     issued by kind, windows that needed no remap (docs/PERFORMANCE.md)
   * serving: jobs admitted/shed/expired/completed, batch occupancy
-    (batched jobs per dispatch), queue-depth / latency gauges
+    (batched jobs per dispatch), queue-depth / latency gauges, and
+    pipeline health — overlap_ratio (staged batches per dispatch) and
+    join_rate (in-flight joins per batched job) — docs/SERVING.md
   * routing: decisions and executed jobs per stack with per-stack hit
     rates, mis-routes and escalations, live residency gauges
     (route.residency.<stack>) — docs/ROUTING.md
@@ -222,6 +224,15 @@ def report(snap: dict, top: int) -> dict:
     if dispatches:
         out["serve"]["batch_occupancy"] = round(
             out["serve"].get("serve.batch.jobs", 0) / dispatches, 3)
+        # pipeline health: fraction of dispatch cycles that had the next
+        # batch staged under the in-flight one, and fraction of batched
+        # jobs that joined a staged batch instead of waiting a cycle
+        out["serve"]["overlap_ratio"] = round(
+            out["serve"].get("serve.overlap.staged", 0) / dispatches, 4)
+    batch_jobs = out["serve"].get("serve.batch.jobs", 0)
+    if batch_jobs:
+        out["serve"]["join_rate"] = round(
+            out["serve"].get("serve.overlap.join.jobs", 0) / batch_jobs, 4)
     # per-stack hit rates: fraction of routed jobs each stack executed
     routed_jobs = sum(v for k, v in out["route"].items()
                       if k.startswith("route.jobs."))
