@@ -267,6 +267,7 @@ def _measure_pager(width: int, samples: int):
     st["sync"] = "devget"
     st["n_pages"] = n_pages
     st["remap_mode"] = os.environ.get("QRACK_TPU_REMAP", "auto")
+    st["collective_mode"] = os.environ.get("QRACK_TPU_COLLECTIVE", "auto")
     st["exchange"] = {k: round(v, 1) for k, v in sorted(per_run.items())}
     gates = width + width * (width - 1) // 2  # H ladder + cphases
     st["exchange_bytes_per_gate"] = round(
@@ -687,7 +688,17 @@ def main() -> None:
             for tag, env in (
                     ("_multichip_remap_auto", {"QRACK_BENCH_PAGER": "1"}),
                     ("_multichip_remap_off", {"QRACK_BENCH_PAGER": "1",
-                                              "QRACK_TPU_REMAP": "off"})):
+                                              "QRACK_TPU_REMAP": "off"}),
+                    # batched-exchange A/B: same remap planner, lowering
+                    # one batched collective vs PR 10 pair-at-a-time —
+                    # BOTH knobs pinned so neither inherits a campaign
+                    # stage's environment
+                    ("_multichip_collective_on",
+                     {"QRACK_BENCH_PAGER": "1", "QRACK_TPU_REMAP": "auto",
+                      "QRACK_TPU_COLLECTIVE": "auto"}),
+                    ("_multichip_collective_off",
+                     {"QRACK_BENCH_PAGER": "1", "QRACK_TPU_REMAP": "auto",
+                      "QRACK_TPU_COLLECTIVE": "off"})):
                 st = _run_child(pg_width, min(SAMPLES, 3),
                                 min(150.0, _remaining() - 20),
                                 platform="cpu", extra_env=env)

@@ -353,56 +353,154 @@ def remap_mode() -> str:
     return v if v in ("auto", "on", "off") else "auto"
 
 
+def collective_mode() -> str:
+    """``QRACK_TPU_COLLECTIVE``: auto (default — lower each remap
+    prologue as ONE batched exchange collective, (1-2^-k)x bytes), on
+    (alias of auto), off (PR 10 pair-at-a-time lowering and planner,
+    kept for A/B measurement)."""
+    v = os.environ.get("QRACK_TPU_COLLECTIVE", "auto").strip().lower()
+    return v if v in ("auto", "on", "off") else "auto"
+
+
 #: exchange cost of one paged-target 2x2, in units of state nbytes
 #: (half a page out + half back, summed over pages)
 GEN_GLOBAL_COST = 1.0
-#: exchange cost of one remap transposition touching a page bit: one
-#: half-buffer (mixed) or half-the-pages whole-buffer (page-page)
-#: ppermute — half the traffic of a pair-exchange gate
+#: exchange cost of one remap transposition touching a page bit when it
+#: ships alone: one half-buffer (mixed) or half-the-pages whole-buffer
+#: (page-page) ppermute — half the traffic of a pair-exchange gate.
+#: Also the deferral ceiling in the batched planner: a hit that can wait
+#: for a later prologue is never worth more than this.
 REMAP_PAIR_COST = 0.5
 
 
+def batched_exchange_cost(gbits, weights=None) -> float:
+    """Cost of one k-pair batched mixed exchange over page bits
+    ``gbits``, in state-nbytes units: sum over the 2^k - 1 non-zero
+    XOR offsets of 2^-k, each priced at the most expensive page-bit
+    axis it crosses (uniform weights give 1 - 2^-k)."""
+    k = len(gbits)
+    if not k:
+        return 0.0
+    tot = 0.0
+    for d in range(1, 1 << k):
+        w = 1.0
+        if weights:
+            w = max(weights[gbits[j]] for j in range(k) if (d >> j) & 1)
+        tot += w
+    return tot / (1 << k)
+
+
 def plan_remaps(ops: Sequence[FusedOp], L: int, qmap: Sequence[int],
-                lookahead=None):
+                lookahead=None, weights=None, batched: bool = True):
     """Score the pending window (+ multi-window lookahead) and pick
     placement swaps that turn globally-placed gen targets into local
     sweeps.  Returns ``(swaps, new_qmap)``: PHYSICAL transpositions for
-    the window prologue and the table after them.
+    the window prologue and the table after them.  cphase/diag are
+    collective-free at any placement, so only non-diagonal hits score.
 
-    Cost model (units of state nbytes): a gen/inv on a physical-global
-    target pays ~1.0 per hit (ppermute pair exchange); one remap
-    transposition pays ~0.5 once.  cphase/diag are collective-free at
-    any placement, so only non-diagonal hits score.  Greedy pairing:
-    hottest global logical qubit against coldest local one, firing while
-    hits[hot] > hits[cold] + 0.5 (the cold qubit inherits the global
-    slot, so its own future hits count against the move)."""
+    Batched model (default; units of state nbytes, scaled by the
+    per-page-bit ``weights`` when the mesh spans DCN): all k mixed pairs
+    of one prologue ship together for ``batched_exchange_cost`` — the
+    marginal pair is nearly free — so candidates are ranked jointly.  A
+    hot global's benefit is its in-window hits (which MUST otherwise pay
+    GEN_GLOBAL_COST each, this window) plus lookahead hits capped at
+    REMAP_PAIR_COST (deferring to a later prologue never costs more
+    than a 1-pair batch).  A victim's charge is the same quantity for
+    the hits it will pay from the inherited global slot.  The best
+    hot-desc/cold-asc prefix with positive net fires as ONE batch.
+    ``batched=False`` keeps the PR 10 greedy pair-at-a-time rule.
+
+    When ``weights`` are non-uniform (multi-host mesh: DCN bits cost
+    more than ICI bits, parallel/cluster.py page_bit_weights) a second
+    pass swaps hot global qubits off expensive page bits onto cheaper
+    ones — pure page-bit transpositions that fold into the same
+    prologue's composed page permutation."""
     n = len(qmap)
     if L >= n:
         return (), list(qmap)
-    hits = [0.0] * n
+    win = [0.0] * n
+    look = [0.0] * n
     for op in ops:
         if op.kind in ("gen", "inv") and op.target < n:
-            hits[op.target] += 1.0
+            win[op.target] += 1.0
     if lookahead:
         for kind, target in lookahead:
             if kind in ("gen", "inv") and 0 <= target < n:
-                hits[target] += 1.0
+                look[target] += 1.0
+
+    def wt(pos):
+        if weights is None or pos < L:
+            return 1.0
+        return weights[pos - L]
+
     new_qmap = list(qmap)
     swaps = []
-    while True:
-        glob = [(hits[q], -q) for q in range(n)
-                if new_qmap[q] >= L and hits[q] > 0]
-        loc = [(hits[q], q) for q in range(n) if new_qmap[q] < L]
-        if not glob or not loc:
-            break
-        gh, negg = max(glob)
-        vh, v = min(loc)
-        if gh <= vh + REMAP_PAIR_COST:
-            break
-        g = -negg
-        p_g, p_v = new_qmap[g], new_qmap[v]
+    if not batched:
+        hits = [win[q] + look[q] for q in range(n)]
+        while True:
+            glob = [(hits[q], -q) for q in range(n)
+                    if new_qmap[q] >= L and hits[q] > 0]
+            loc = [(hits[q], q) for q in range(n) if new_qmap[q] < L]
+            if not glob or not loc:
+                break
+            gh, negg = max(glob)
+            vh, v = min(loc)
+            if gh <= vh + REMAP_PAIR_COST:
+                break
+            g = -negg
+            p_g, p_v = new_qmap[g], new_qmap[v]
+            swaps.append((p_v, p_g))
+            new_qmap[g], new_qmap[v] = p_v, p_g
+        return tuple(swaps), new_qmap
+
+    def worth(q, pos):
+        return (win[q] * GEN_GLOBAL_COST
+                + min(look[q], REMAP_PAIR_COST)) * wt(pos)
+
+    hot = sorted(((worth(q, new_qmap[q]), q) for q in range(n)
+                  if new_qmap[q] >= L and (win[q] or look[q])),
+                 key=lambda t: (-t[0], t[1]))
+    cold = sorted(((win[q] * GEN_GLOBAL_COST + min(look[q],
+                                                   REMAP_PAIR_COST), q)
+                   for q in range(n) if new_qmap[q] < L),
+                  key=lambda t: (t[0], t[1]))
+    best_k, best_net = 0, 0.0
+    for k in range(1, min(len(hot), len(cold)) + 1):
+        gbits = [new_qmap[q] - L for _, q in hot[:k]]
+        net = -batched_exchange_cost(gbits, weights)
+        for (ben, hq), (esc, cq) in zip(hot[:k], cold[:k]):
+            net += ben - esc * wt(new_qmap[hq])
+        if net > best_net + 1e-9:
+            best_k, best_net = k, net
+    for (_, hq), (_, cq) in zip(hot[:best_k], cold[:best_k]):
+        p_g, p_v = new_qmap[hq], new_qmap[cq]
         swaps.append((p_v, p_g))
-        new_qmap[g], new_qmap[v] = p_v, p_g
+        new_qmap[hq], new_qmap[cq] = p_v, p_g
+    if weights is not None and len(set(weights)) > 1:
+        h = [win[q] + look[q] for q in range(n)]
+        used = {p - L for pair in swaps for p in pair if p >= L}
+        while True:
+            best = None
+            for q in range(n):
+                pq = new_qmap[q]
+                if pq < L or (pq - L) in used or h[q] <= 0:
+                    continue
+                for r in range(n):
+                    pr = new_qmap[r]
+                    if r == q or pr < L or (pr - L) in used:
+                        continue
+                    gain = ((h[q] - h[r]) * (wt(pq) - wt(pr))
+                            - REMAP_PAIR_COST * max(wt(pq), wt(pr)))
+                    if gain > 1e-9 and (best is None or gain > best[0]):
+                        best = (gain, q, r)
+            if best is None:
+                break
+            _, q, r = best
+            pq, pr = new_qmap[q], new_qmap[r]
+            swaps.append((pr, pq))
+            new_qmap[q], new_qmap[r] = pr, pq
+            used.add(pq - L)
+            used.add(pr - L)
     return tuple(swaps), new_qmap
 
 
@@ -446,7 +544,8 @@ def sharded_structure_of(ops: Sequence[FusedOp]) -> Tuple:
                   op.target, op.cmask != 0) for op in ops)
 
 
-def sharded_window_body(L: int, npg: int, structure: Tuple, remap=()):
+def sharded_window_body(L: int, npg: int, structure: Tuple, remap=(),
+                        batched: bool = True):
     """Per-shard traced body fn(local, *operands) for one window.  Masks
     arrive pre-split host-side into (local, page) int32 halves — same
     exact-past-int32 discipline as the eager pager kernels: cphase takes
@@ -460,7 +559,7 @@ def sharded_window_body(L: int, npg: int, structure: Tuple, remap=()):
 
     def fn(local, *operands):
         if remap:
-            local = shb.apply_remap(local, npg, L, remap)
+            local = shb.apply_remap(local, npg, L, remap, batched=batched)
         i = 0
         for kind, target, has_ctrl in structure:
             p = operands[i]
@@ -674,7 +773,8 @@ def sharded_kernel_lowering(L: int, structure: Tuple, backend: str = None):
 
 def sharded_kernel_window_body(L: int, npg: int, structure: Tuple,
                                block_pow: int = None,
-                               interpret: bool = False, remap=()):
+                               interpret: bool = False, remap=(),
+                               batched: bool = True):
     """Per-shard traced body fn(local, *operands) — SAME sharded operand
     layout as :func:`sharded_window_body`, kernel-lowered local runs,
     with the optional remap prologue ahead of the first segment."""
@@ -690,7 +790,7 @@ def sharded_kernel_window_body(L: int, npg: int, structure: Tuple,
 
     def fn(local, *operands):
         if remap:
-            local = shb.apply_remap(local, npg, L, remap)
+            local = shb.apply_remap(local, npg, L, remap, batched=batched)
         pid = shb.page_id()
         for seg in segments:
             if seg[0] == "global":

@@ -15,6 +15,8 @@ subsets = :453,563.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
@@ -226,22 +228,227 @@ def mixed_swap(local, npg: int, L: int, lpos: int, gpos: int):
     return jnp.stack([s0, s1], axis=2).reshape(local.shape)
 
 
-def apply_remap(local, npg: int, L: int, swaps):
-    """Batched placement change: apply a sequence of PHYSICAL bit-position
-    transpositions (p1, p2), each local-local (free axis shuffle),
-    page-page (MetaSwap ppermute) or mixed (half-buffer exchange).  The
-    planner (ops/fusion.py plan_remaps) emits these as the prologue of a
-    fused window program, so remap + window is ONE dispatch."""
+# ---------------------------------------------------------------------------
+# batched exchange collectives: ANY sequence of physical bit-position
+# transpositions composes into one permutation, which lowers as
+#   L_post . page_perm . mixed_batch . L_pre
+# where L_pre/L_post are free in-page bit shuffles, mixed_batch moves the
+# k boundary-crossing sub-buffers in 2^k-1 sub-block ppermutes totalling
+# (1 - 2^-k) state volumes (vs k/2 for k sequential half-buffer swaps;
+# mpiQulacs' fused multi-qubit exchange, arXiv:2203.16044), and page_perm
+# is one whole-slab ppermute for any residual page-bit permutation.
+# ---------------------------------------------------------------------------
+
+class ExchangePlan(NamedTuple):
+    """Static decomposition of a composed bit permutation (host-side)."""
+    pre: tuple        # local transpositions before the exchange (free)
+    k: int            # boundary-crossing pair count
+    gpos: tuple       # page bit paired with carrier local bit (L-k+j)
+    page_dest: tuple  # page-bit position map i -> page_dest[i], or None
+    post: tuple       # local transpositions after the exchange (free)
+
+
+def compose_swaps(n: int, swaps):
+    """``src[p]`` = original position of the content that a sequential
+    application of ``swaps`` leaves at position p."""
+    src = list(range(n))
     for p1, p2 in swaps:
-        if p1 > p2:
-            p1, p2 = p2, p1
-        if p2 < L:
-            local = gk.swap_bits(local, L, p1, p2)
-        elif p1 >= L:
-            local = page_swap(local, npg, p1 - L, p2 - L)
+        src[p1], src[p2] = src[p2], src[p1]
+    return src
+
+
+def _perm_swaps(f):
+    """Transpositions realizing position map f (content at x ends at
+    f[x]) when applied in order — selection-sort cycle decomposition,
+    <= len(f)-1 pairs."""
+    n = len(f)
+    cur = list(range(n))   # cur[p] = content at position p
+    pos = list(range(n))   # pos[c] = position of content c
+    g = [0] * n
+    for x in range(n):
+        g[f[x]] = x
+    out = []
+    for p in range(n):
+        c = g[p]
+        q = pos[c]
+        if q != p:
+            out.append((p, q))
+            c2 = cur[p]
+            cur[p], cur[q] = c, c2
+            pos[c], pos[c2] = p, q
+    return tuple(out)
+
+
+def plan_exchange(L: int, g: int, swaps):
+    """Decompose a transposition sequence over L local + g page bits into
+    an :class:`ExchangePlan`.  None when the composition is identity."""
+    n = L + g
+    src = compose_swaps(n, swaps)
+    dest = [0] * n
+    for p in range(n):
+        dest[src[p]] = p
+    if all(dest[c] == c for c in range(n)):
+        return None
+    cross_in = [c for c in range(L) if dest[c] >= L]   # local -> page
+    crossers = [t for t in range(L, n) if dest[t] < L]  # page -> local
+    k = len(cross_in)
+    carriers = list(range(L - k, L))
+    # pair each crossing content with the carrier of the page slot it is
+    # DESTINED for whenever that slot is itself vacating (crossers[j]
+    # receives carrier j's content) — the planner's disjoint
+    # local<->global batches then leave an IDENTITY residual page
+    # permutation instead of paying a whole-slab ppermute to fix an
+    # arbitrary pairing
+    by_dest = {dest[c]: c for c in cross_in}
+    ordered = [by_dest.pop(t, None) for t in crossers]
+    leftovers = iter(c for c in cross_in if c in by_dest.values())
+    cross_in = [c if c is not None else next(leftovers) for c in ordered]
+    # pre-shuffle: crossing local contents onto the carrier (top-k) bits,
+    # everything else staying put where possible
+    A = {c: carriers[j] for j, c in enumerate(cross_in)}
+    freeset = {p for p in range(L) if p not in set(A.values())}
+    later = []
+    for c in range(L):
+        if c in A:
+            continue
+        if c in freeset:
+            A[c] = c
+            freeset.discard(c)
         else:
-            local = mixed_swap(local, npg, L, p1, p2 - L)
+            later.append(c)
+    for c, p in zip(later, sorted(freeset)):
+        A[c] = p
+    pre = _perm_swaps([A[c] for c in range(L)])
+    gpos = tuple(t - L for t in crossers)
+    # residual page permutation after the mixed batch: position t holds
+    # the content that crossed in (dest >= L for it), other page bits
+    # keep their own content
+    content_at_page = {t: cross_in[j] for j, t in enumerate(crossers)}
+    page_dest = tuple(dest[content_at_page.get(L + i, L + i)] - L
+                      for i in range(g))
+    if all(page_dest[i] == i for i in range(g)):
+        page_dest = None
+    # post-shuffle: carriers now hold the crossed-in page contents; send
+    # every local content to its final slot
+    content_at = {carriers[j]: t for j, t in enumerate(crossers)}
+    content_at.update({A[c]: c for c in range(L) if c not in cross_in})
+    post = _perm_swaps([dest[content_at[x]] for x in range(L)])
+    return ExchangePlan(pre, k, gpos, page_dest, post)
+
+
+def page_perm_of(page_dest, g: int):
+    """[(src_page, dst_page)] total map for a page-bit position map."""
+    npg = 1 << g
+    perm = []
+    for j in range(npg):
+        r = 0
+        for i in range(g):
+            if (j >> i) & 1:
+                r |= 1 << page_dest[i]
+        perm.append((j, r))
+    return perm
+
+
+def batched_mixed_swap(local, npg: int, k: int, gpos):
+    """k disjoint mixed transpositions — carrier local bits [L-k, L)
+    against page bits ``gpos`` — as one batched exchange: for every
+    non-zero offset d over the k pair axes, each page ships the 2^-k
+    sub-block its XOR-d partner needs, in one ppermute.  The d=0
+    diagonal never moves, so total traffic is (1 - 2^-k) state volumes
+    and all 2^k - 1 transfers are independent (one collective round on
+    hardware that overlaps them, vs k serialized half-buffer swaps)."""
+    pid = page_id()
+    nsub = 1 << k
+    sub = local.reshape(local.shape[0], nsub, -1)
+    b = jnp.zeros((), pid.dtype)
+    for j, gp in enumerate(gpos):
+        b = b | (((pid >> gp) & 1) << j)
+    out = sub
+    for d in range(1, nsub):
+        pd = 0
+        for j, gp in enumerate(gpos):
+            if (d >> j) & 1:
+                pd |= 1 << gp
+        perm = [(j2, j2 ^ pd) for j2 in range(npg)]
+        payload = jax.lax.dynamic_index_in_dim(sub, b ^ d, axis=1,
+                                               keepdims=True)
+        got = jax.lax.ppermute(payload, "pages", perm)
+        out = jax.lax.dynamic_update_slice_in_dim(out, got, b ^ d, axis=1)
+    return out.reshape(local.shape)
+
+
+def apply_remap(local, npg: int, L: int, swaps, batched: bool = True):
+    """Batched placement change: apply a sequence of PHYSICAL bit-position
+    transpositions (p1, p2).  The planner (ops/fusion.py plan_remaps)
+    emits these as the prologue of a fused window program, so remap +
+    window is ONE dispatch.
+
+    ``batched`` (default) composes the whole sequence into one
+    permutation and lowers it through :func:`plan_exchange` — free local
+    shuffles, one (1-2^-k)-volume mixed batch, one residual page
+    ppermute.  ``batched=False`` keeps the PR 10 pair-at-a-time lowering
+    (one half-buffer collective per page-touching pair) for A/B runs
+    (QRACK_TPU_COLLECTIVE=off)."""
+    if not batched:
+        for p1, p2 in swaps:
+            if p1 > p2:
+                p1, p2 = p2, p1
+            if p2 < L:
+                local = gk.swap_bits(local, L, p1, p2)
+            elif p1 >= L:
+                local = page_swap(local, npg, p1 - L, p2 - L)
+            else:
+                local = mixed_swap(local, npg, L, p1, p2 - L)
+        return local
+    g = npg.bit_length() - 1
+    plan = plan_exchange(L, g, swaps)
+    if plan is None:
+        return local
+    for p1, p2 in plan.pre:
+        local = gk.swap_bits(local, L, p1, p2)
+    if plan.k:
+        local = batched_mixed_swap(local, npg, plan.k, plan.gpos)
+    if plan.page_dest is not None:
+        local = jax.lax.ppermute(local, "pages",
+                                 page_perm_of(plan.page_dest, g))
+    for p1, p2 in plan.post:
+        local = gk.swap_bits(local, L, p1, p2)
     return local
+
+
+def exchange_cost(L: int, g: int, swaps, weights=None,
+                  batched: bool = True) -> float:
+    """Host-side accounting twin of :func:`apply_remap`: the fraction of
+    state nbytes the lowering ships.  ``weights`` (per page bit, e.g.
+    DCN > ICI from parallel/cluster.py) turn bytes into planner cost
+    units; None counts raw bytes."""
+    def w(bits):
+        if not weights:
+            return 1.0
+        return max(weights[b] for b in bits)
+
+    if not batched:
+        tot = 0.0
+        for p1, p2 in swaps:
+            lo, hi = min(p1, p2), max(p1, p2)
+            if hi < L:
+                continue
+            tot += 0.5 * w([b - L for b in (lo, hi) if b >= L])
+        return tot
+    plan = plan_exchange(L, g, swaps)
+    if plan is None:
+        return 0.0
+    tot = 0.0
+    nsub = 1 << plan.k
+    for d in range(1, nsub):
+        tot += w([plan.gpos[j] for j in range(plan.k)
+                  if (d >> j) & 1]) / nsub
+    if plan.page_dest is not None:
+        npg = 1 << g
+        for j, r in page_perm_of(plan.page_dest, g):
+            if r != j:
+                tot += w([b for b in range(g) if ((j ^ r) >> b) & 1]) / npg
+    return tot
 
 
 def split_masks(mask: int, val: int, local_bits: int):

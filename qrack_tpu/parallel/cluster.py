@@ -165,6 +165,60 @@ def global_page_mesh(n_pages: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:n_pages]), ("pages",))
 
 
+def dcn_weight() -> float:
+    """Relative exchange cost of a DCN-crossing page bit vs an ICI one
+    (``QRACK_TPU_DCN_WEIGHT``, default 4.0 — DCN bandwidth per chip is a
+    small fraction of ICI on v5e-class pods)."""
+    try:
+        return float(os.environ.get("QRACK_TPU_DCN_WEIGHT", "4.0"))
+    except ValueError:
+        return 4.0
+
+
+def page_bit_kinds(devices):
+    """('ici'|'dcn') per page bit for a 2^g device list: page bit b is
+    DCN when any ppermute partner pair differing only in b spans two
+    processes — exactly the pairs :func:`ops.sharded.batched_mixed_swap`
+    and the pair-exchange gates put on the wire for that axis."""
+    devices = list(devices)
+    n = len(devices)
+    g = n.bit_length() - 1
+    kinds = []
+    for b in range(g):
+        cross = any(devices[j].process_index
+                    != devices[j ^ (1 << b)].process_index
+                    for j in range(n))
+        kinds.append("dcn" if cross else "ici")
+    return tuple(kinds)
+
+
+def page_bit_weights(devices, dcn_bits: Optional[int] = None):
+    """Per-page-bit exchange weights for the remap planner
+    (ops/fusion.py plan_remaps), or None when uniform (single host and
+    no override).  ``dcn_bits`` / ``QRACK_TPU_DCN_BITS`` forces the top
+    N page bits to DCN pricing — the single-process stand-in for
+    multi-slice meshes in CI and soaks."""
+    devices = list(devices)
+    g = len(devices).bit_length() - 1
+    if g <= 0:
+        return None
+    if dcn_bits is None:
+        env = os.environ.get("QRACK_TPU_DCN_BITS")
+        if env:
+            try:
+                dcn_bits = int(env)
+            except ValueError:
+                dcn_bits = None
+    kinds = list(page_bit_kinds(devices))
+    if dcn_bits:
+        for b in range(max(0, g - dcn_bits), g):
+            kinds[b] = "dcn"
+    if "dcn" not in kinds:
+        return None
+    w = dcn_weight()
+    return tuple(w if k == "dcn" else 1.0 for k in kinds)
+
+
 def replicate_program(mesh: Mesh, length: int):
     """Program fetching a (2, length) window of a sharded ket, output
     REPLICATED over the mesh — the only read pattern that is legal on a

@@ -131,7 +131,9 @@ class QPager(QEngine):
 
     def __init__(self, qubit_count: int, init_state: int = 0, devices=None,
                  n_pages: Optional[int] = None, dtype=None,
-                 remap: Optional[str] = None, **kwargs):
+                 remap: Optional[str] = None,
+                 collective: Optional[str] = None,
+                 dcn_bits: Optional[int] = None, **kwargs):
         super().__init__(qubit_count, init_state=init_state, **kwargs)
         if dtype is None:
             # FPPOW policy (config.py device_real_dtype; enables x64
@@ -179,6 +181,12 @@ class QPager(QEngine):
         # per-instance remap-planner override (None = QRACK_TPU_REMAP):
         # soaks/tests arm the placement table without touching process env
         self._remap = remap
+        # per-instance batched-collective override (None =
+        # QRACK_TPU_COLLECTIVE) and DCN stand-in (None =
+        # QRACK_TPU_DCN_BITS / mesh process topology) — same discipline
+        self._collective = collective
+        self._dcn_bits = dcn_bits
+        self._xw_mesh = None
         self._map_reset()
         self.SetPermutation(init_state)
 
@@ -282,37 +290,72 @@ class QPager(QEngine):
         mode = self._remap if self._remap is not None else fu.remap_mode()
         return mode != "off" and self.n_pages > 1
 
-    def _p_remap(self, swaps):
+    def _collective_batched(self) -> bool:
+        """True when remap prologues lower as ONE batched exchange
+        collective (QRACK_TPU_COLLECTIVE / per-instance override);
+        False restores the PR 10 pair-at-a-time lowering for A/B."""
+        from ..ops import fusion as fu
+
+        mode = (self._collective if self._collective is not None
+                else fu.collective_mode())
+        return mode != "off"
+
+    @property
+    def _exchange_weights(self):
+        """Per-page-bit planner weights (DCN > ICI) for the CURRENT
+        mesh, or None when uniform — recomputed lazily whenever the
+        mesh changes (elastic/quarantine re-paging)."""
+        mesh = self.mesh
+        if self._xw_mesh is not mesh:
+            from . import cluster as _cluster
+
+            self._xw = _cluster.page_bit_weights(
+                list(mesh.devices.flat), dcn_bits=self._dcn_bits)
+            self._xw_mesh = mesh
+        return self._xw
+
+    def _p_remap(self, swaps, batched: bool = True):
         """One program applying a batch of physical transpositions —
-        local axis shuffles, MetaSwap page permutations and mixed
-        half-buffer exchanges, all inside one shard_map dispatch."""
+        free local axis shuffles, one batched mixed exchange and one
+        composed page permutation (ops/sharded.py plan_exchange), all
+        inside one shard_map dispatch."""
         from ..ops import sharded as shb
 
         L, mesh, npg = self.local_bits, self.mesh, self.n_pages
 
         def build():
             def f(local):
-                return shb.apply_remap(local, npg, L, swaps)
+                return shb.apply_remap(local, npg, L, swaps,
+                                       batched=batched)
 
             return jax.jit(_compat_shard_map(
                 f, mesh=mesh, in_specs=P(None, "pages"),
                 out_specs=P(None, "pages")), donate_argnums=(0,))
 
-        return _program(self._key("remap", swaps), build,
+        return _program(self._key("remap", swaps, batched), build,
                         site="pager.exchange")
 
-    def _tele_remap(self, swaps) -> None:
-        """Count placement-transposition traffic: local-local pairs are
-        free axis shuffles; any pair touching a page bit ships half the
-        state once (exchange.pager.remap — half a global_2x2's cost)."""
+    def _tele_remap(self, swaps, batched: bool = True) -> None:
+        """Count placement-transposition traffic, mirroring the lowering
+        exactly (ops/sharded.py exchange_cost): batched prologues ship
+        (1-2^-k) of the state for k mixed pairs plus the displaced-page
+        fraction of any composed page permutation; pair-at-a-time ships
+        half the state per page-touching pair."""
         if not (_tele._ENABLED and swaps):
             return
+        from ..ops import sharded as shb
+
         L = self.local_bits
         nb = self._state_raw.nbytes
         _tele.inc("remap.pager.pairs", len(swaps))
-        for p1, p2 in swaps:
-            if max(p1, p2) >= L:
-                self._tele_exchange("remap", nb / 2)
+        frac = shb.exchange_cost(L, self.g_bits, swaps, batched=batched)
+        if frac <= 0:
+            return
+        if batched:
+            if sum(1 for p1, p2 in swaps if max(p1, p2) >= L) >= 2:
+                _tele.inc("remap.pager.batched")
+            _tele.inc("exchange.pager.collective_bytes", frac * nb)
+        self._tele_exchange("remap", frac * nb)
 
     def _unmap(self) -> None:
         """Physically restore logical bit order (identity table) in one
@@ -335,8 +378,10 @@ class QPager(QEngine):
             qinv[l], qinv[p] = l, o
         if _tele._ENABLED:
             _tele.inc("remap.pager.unmap")
-        self._tele_remap(tuple(swaps))
-        self._state = self._p_remap(tuple(swaps))(self._state)
+        batched = self._collective_batched()
+        self._tele_remap(tuple(swaps), batched=batched)
+        self._state = self._p_remap(tuple(swaps),
+                                    batched=batched)(self._state)
         self._map_reset()
 
     @property
@@ -591,14 +636,15 @@ class QPager(QEngine):
         return True
 
     def _p_fuse_window(self, structure, n_operands: int, kernel_plan=None,
-                       remap=()):
+                       remap=(), batched: bool = True):
         from ..ops import fusion as fu
 
         L, mesh, npg = self.local_bits, self.mesh, self.n_pages
 
         if kernel_plan is None:
             def build():
-                body = fu.sharded_window_body(L, npg, structure, remap=remap)
+                body = fu.sharded_window_body(L, npg, structure, remap=remap,
+                                              batched=batched)
                 return _tele.instrument_jit("fuse.window", jax.jit(
                     _compat_shard_map(body, mesh=mesh,
                                       in_specs=_state_specs(n_operands),
@@ -606,7 +652,7 @@ class QPager(QEngine):
                     donate_argnums=(0,)))
 
             return _program(self._key("fusewin", str(self.dtype), structure,
-                                      remap),
+                                      remap, batched),
                             build, site="tpu.fuse.flush")
 
         interpret = kernel_plan["interpret"]
@@ -616,7 +662,8 @@ class QPager(QEngine):
             body = fu.sharded_kernel_window_body(L, npg, structure,
                                                  block_pow=bp,
                                                  interpret=interpret,
-                                                 remap=remap)
+                                                 remap=remap,
+                                                 batched=batched)
             # pallas_call inside shard_map trips the replication checker
             # on per-shard refs; the body is manifestly per-page, so the
             # check is safely off for this one program (compat translates
@@ -630,7 +677,8 @@ class QPager(QEngine):
 
         return _program(self._key("fusewin-k",
                                   "interp" if interpret else "mosaic", bp,
-                                  str(self.dtype), structure, remap),
+                                  str(self.dtype), structure, remap,
+                                  batched),
                         build, site="tpu.fuse.flush")
 
     def _fuse_flush(self, gates) -> int:
@@ -661,8 +709,11 @@ class QPager(QEngine):
         L = self.local_bits
         swaps = ()
         new_qmap = self._qmap
+        batched = self._collective_batched()
         if self._remap_active():
-            swaps, new_qmap = fu.plan_remaps(ops, L, self._qmap, lookahead)
+            swaps, new_qmap = fu.plan_remaps(
+                ops, L, self._qmap, lookahead,
+                weights=self._exchange_weights, batched=batched)
         tops = (fu.translate_ops(ops, new_qmap)
                 if (swaps or self._map_nonid()) else ops)
         if len(tops) == 1 and not swaps:
@@ -699,10 +750,11 @@ class QPager(QEngine):
                     self._tele_exchange("global_2x2", nb)
             if swaps:
                 _tele.inc("remap.pager.windows")
-            self._tele_remap(swaps)
+            self._tele_remap(swaps, batched=batched)
         plan, why = fu.sharded_kernel_lowering(L, structure)
         prog = self._p_fuse_window(structure, len(operands),
-                                   kernel_plan=plan, remap=swaps)
+                                   kernel_plan=plan, remap=swaps,
+                                   batched=batched)
         self._state = prog(self._state, *operands)
         self._map_assign(new_qmap)
         if plan is not None:
@@ -742,7 +794,9 @@ class QPager(QEngine):
             if _tele._ENABLED:
                 _tele.inc("remap.pager.swap")
                 self._tele_exchange("remap", self._state.nbytes / 2)
-            self._state = self._p_remap(((p1, p2),))(self._state)
+            self._state = self._p_remap(
+                ((p1, p2),),
+                batched=self._collective_batched())(self._state)
 
     def _global_iota(self):
         """Sharded full-width index vector (int32-safe only to 31 qubits)."""

@@ -216,6 +216,15 @@ class SessionManager:
                                       engine_kwargs)
         if _tele._ENABLED:
             _tele.inc("serve.session.created")
+            # sessions whose engines were built while jax.distributed
+            # spans processes shard state over the GLOBAL mesh — their
+            # pager exchanges ride DCN, so operators want them visible
+            # (every process must drive the same dispatch order; the
+            # fleet plane launches one driver per host for exactly this)
+            from ..parallel import cluster as _cluster
+
+            if _cluster.is_initialized() and _cluster.process_count() > 1:
+                _tele.inc("serve.session.multihost")
             _tele.event("serve.session.create", sid=sid, width=width,
                         accel=touches_accelerator(layers))
             _tele.gauge("serve.sessions.active", len(self._sessions))

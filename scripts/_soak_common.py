@@ -45,11 +45,15 @@ __all__ = ["REPO", "N", "_ops", "STACKS", "fidelity", "submit_retry",
            "resilience_up", "resilience_down", "soak_main"]
 
 # stacks that exercise each guarded dispatch family; the second pager
-# lane forces the placement planner on so remapped windows soak too
+# lane forces the placement planner on so remapped windows soak too,
+# and the third prices the top page bit as DCN (the multi-host
+# stand-in: cluster.page_bit_weights dcn_bits override) so fault and
+# integrity soaks cross the batched-collective + weighted-planner path
 STACKS = [
     ("tpu", {}),
     ("pager", {"n_pages": 4}),
     ("pager", {"n_pages": 4, "remap": "on"}),
+    ("pager", {"n_pages": 4, "remap": "on", "dcn_bits": 1}),
     ("hybrid", {"tpu_threshold_qubits": 3}),
 ]
 

@@ -197,6 +197,12 @@ def report(snap: dict, top: int) -> dict:
         total = kinds.get("hit", 0) + kinds.get("miss", 0)
         if total:
             kinds["miss_ratio"] = round(kinds.get("miss", 0) / total, 4)
+    # share of remap traffic that rode batched exchange collectives
+    # (1.0 = every prologue batched, 0 = pair-at-a-time / collective off)
+    rb = out["exchange"].get("exchange.pager.remap", 0)
+    if rb:
+        cb = out["exchange"].get("exchange.pager.collective_bytes", 0)
+        out["remap"]["remap.pager.collective_share"] = round(cb / rb, 4)
     for k in [k for k in out["fusion"] if k.endswith(".gates")]:
         eng = k[len("fuse."):-len(".gates")]
         gates = out["fusion"][k]
@@ -284,7 +290,8 @@ def main(argv=None) -> int:
     if rep["remap"]:
         print("== remap ==")
         for name, v in sorted(rep["remap"].items()):
-            print(f"  {name:<40s} {v:>12.0f}")
+            shown = f"{v:.0f}" if float(v).is_integer() else f"{v:.3f}"
+            print(f"  {name:<40s} {shown:>12s}")
     if rep["serve"]:
         print("== serve ==")
         for name, v in sorted(rep["serve"].items()):

@@ -180,6 +180,25 @@ run_stage pager_remap_off_w22 420 env QRACK_BENCH_PAGER=1 \
   QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
   QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=390 \
   python bench.py
+
+# ---- batched exchange collective A/B: the same remap planner lowering
+#      each prologue as ONE (1-2^-k)-volume collective (auto) vs the
+#      PR 10 pair-at-a-time half-buffer swaps (off) — on-chip bytes and
+#      walls for the mpiQulacs-style fused exchange (ISSUE 14).
+run_stage pager_collective_w22 420 env QRACK_BENCH_PAGER=1 \
+  QRACK_TPU_REMAP=auto QRACK_TPU_COLLECTIVE=auto \
+  QRACK_TPU_FUSE_KERNEL=auto \
+  QRACK_BENCH_SUFFIX=_multichip_collective_on \
+  QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=390 \
+  python bench.py
+run_stage pager_collective_off_w22 420 env QRACK_BENCH_PAGER=1 \
+  QRACK_TPU_REMAP=auto QRACK_TPU_COLLECTIVE=off \
+  QRACK_TPU_FUSE_KERNEL=auto \
+  QRACK_BENCH_SUFFIX=_multichip_collective_off \
+  QRACK_BENCH=qft QRACK_BENCH_QB=22 QRACK_BENCH_QB_FIRST=22 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=390 \
+  python bench.py
 run_stage xeb_w22 300 env QRACK_BENCH=xeb QRACK_BENCH_QB=22 \
   QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
   QRACK_BENCH_BUDGET=280 python bench.py

@@ -1,0 +1,107 @@
+"""One process of the 2-process DCN-stand-in PAGER run (see
+tests/test_multihost.py::test_multihost_pager_w20_qft).
+
+Brings up jax.distributed via qrack_tpu.parallel.cluster, builds a
+remap-on QPager whose 8 pages span both processes (gloo standing in
+for DCN on the top page bit), runs a w20 QFT through QCircuit.Run so
+the remap planner sees the full lookahead and fires BATCHED exchange
+collectives across the process boundary, then round-trips a checkpoint
+written under the global mesh.  The parent checks fidelity vs the CPU
+oracle (run in-process here: shipping 2^20 amplitudes through a pipe
+is the only thing that would not scale), the exchange/remap telemetry,
+and the bit-identical restore."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qrack_tpu.utils.platform import pin_host_cpu
+
+pin_host_cpu(int(os.environ.get("QRACK_WORKER_LOCAL_DEVICES", "4")))
+
+from qrack_tpu.parallel.cluster import (init_cluster, page_bit_kinds,
+                                        process_count, process_index)
+
+init_cluster()
+
+import jax
+import numpy as np
+
+from qrack_tpu import QEngineCPU
+from qrack_tpu import telemetry as tele
+from qrack_tpu.checkpoint import load_state, save_state
+from qrack_tpu.layers.qcircuit import QCircuit
+from qrack_tpu.parallel import QPager
+from qrack_tpu.utils.rng import QrackRandom
+
+
+def _qft_circuit(n: int) -> QCircuit:
+    """Descending-gen QFT (the order the batched planner exists for)."""
+    h = np.array([[1, 1], [1, -1]], dtype=np.complex128) / np.sqrt(2)
+    c = QCircuit(n)
+    for i in range(n):
+        hq = n - 1 - i
+        for j in range(i):
+            ph = np.exp(1j * np.pi / 2.0 ** (i - j))
+            c.append_ctrl([hq + 1 + j], hq,
+                          np.diag([1.0, ph]).astype(np.complex128), 1)
+        c.append_1q(hq, h)
+    return c
+
+
+def main() -> None:
+    n = 20
+    circ = _qft_circuit(n)
+    tele.enable()
+    # identical seed on every process (parallel/cluster.py docstring)
+    q = QPager(n, rng=QrackRandom(99), rand_global_phase=False,
+               devices=jax.devices(), n_pages=8, remap="on")
+    q.SetPermutation(0b1011)
+    circ.Run(q)
+    got = np.asarray(q.GetQuantumState())
+    p3 = q.Prob(3)
+    c = tele.snapshot()["counters"]
+    tele.disable()
+    tele.reset()
+
+    o = QEngineCPU(n, rng=QrackRandom(99), rand_global_phase=False)
+    o.SetPermutation(0b1011)
+    circ.Run(o)
+    ref = np.asarray(o.GetQuantumState())
+    fid = float(abs(np.vdot(ref, got)) ** 2
+                / (np.vdot(ref, ref).real * np.vdot(got, got).real))
+
+    # checkpoint under the global mesh: every process captures through
+    # the replicated fetch (no process addresses all 8 shards), restores
+    # into a fresh global-mesh pager, and must read back bit-identically
+    path = os.path.join(os.environ.get("QRACK_CKPT_DIR", "."),
+                        f"pager_w20.p{process_index()}.qckpt")
+    save_state(q, path)
+    r = QPager(n, rng=QrackRandom(99), rand_global_phase=False,
+               devices=jax.devices(), n_pages=8, remap="on")
+    load_state(path, into=r)
+    restore_identical = bool(
+        np.array_equal(got, np.asarray(r.GetQuantumState())))
+    restore_qmap_ok = list(r._qmap) == list(q._qmap)
+
+    print("RESULT " + json.dumps({
+        "proc": process_index(),
+        "procs": process_count(),
+        "n_global_devices": len(jax.devices()),
+        "kinds": list(page_bit_kinds(jax.devices())),
+        "fidelity": fid,
+        "prob3_diff": float(p3 - o.Prob(3)),
+        "remap_pairs": int(c.get("remap.pager.pairs", 0)),
+        "remap_batched": int(c.get("remap.pager.batched", 0)),
+        "exchange_bytes": float(c.get("exchange.pager.bytes", 0.0)),
+        "collective_bytes": float(
+            c.get("exchange.pager.collective_bytes", 0.0)),
+        "restore_identical": restore_identical,
+        "restore_qmap_ok": restore_qmap_ok,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,12 +23,76 @@ from qrack_tpu.utils.rng import QrackRandom
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "multihost_worker.py")
+PAGER_WORKER = os.path.join(HERE, "multihost_pager_worker.py")
+
+# coordinator bring-up failures are ENVIRONMENT, not regression: the
+# free port can be stolen between bind and use, and CI sandboxes can
+# forbid the loopback listener outright — skip, never hang or fail
+_INIT_FAIL_MARKERS = (
+    "Address already in use",
+    "address already in use",
+    "Connection refused",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "failed to connect",
+)
 
 
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
+
+
+def _run_cluster(worker, n_procs, timeout=240, extra_env=None):
+    """Launch n_procs copies of ``worker`` wired to one coordinator and
+    return their parsed RESULT dicts.  Worker crashes that smell like
+    coordinator bring-up failure skip the test; timeouts kill the whole
+    cohort and fail (tier-1 must never hang on a wedged rendezvous)."""
+    local = 8 // n_procs
+    port = _free_port()
+    procs = []
+    for pid in range(n_procs):
+        env = dict(
+            os.environ,
+            QRACK_COORDINATOR=f"localhost:{port}",
+            QRACK_NUM_PROCESSES=str(n_procs),
+            QRACK_PROCESS_ID=str(pid),
+            QRACK_WORKER_LOCAL_DEVICES=str(local),
+            # the parent test process pins 8 virtual devices via
+            # XLA_FLAGS (conftest); workers get 8/n_procs each
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={local}",
+        )
+        if extra_env:
+            env.update(extra_env)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"multihost worker timed out after {timeout}s "
+                            "(coordinator rendezvous wedged?)")
+            if p.returncode != 0:
+                if any(m in err for m in _INIT_FAIL_MARKERS):
+                    pytest.skip("cluster bring-up unavailable here: "
+                                + err.strip().splitlines()[-1][:200])
+                assert False, f"worker failed:\n{err[-3000:]}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    results = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert lines, f"no RESULT line in worker output:\n{out[-2000:]}"
+        results.append(json.loads(lines[0][len("RESULT "):]))
+    return results
 
 
 def _oracle_state_and_prob():
@@ -47,39 +111,7 @@ def _oracle_state_and_prob():
 
 @pytest.mark.parametrize("n_procs", [2, 4])
 def test_cluster_matches_oracle(n_procs):
-    local = 8 // n_procs
-    port = _free_port()
-    procs = []
-    for pid in range(n_procs):
-        env = dict(
-            os.environ,
-            QRACK_COORDINATOR=f"localhost:{port}",
-            QRACK_NUM_PROCESSES=str(n_procs),
-            QRACK_PROCESS_ID=str(pid),
-            QRACK_WORKER_LOCAL_DEVICES=str(local),
-            # the parent test process pins 8 virtual devices via
-            # XLA_FLAGS (conftest); workers get 8/n_procs each
-            XLA_FLAGS=f"--xla_force_host_platform_device_count={local}",
-        )
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER], env=env, text=True,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(out)
-
-    results = []
-    for out in outs:
-        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
-        assert lines, f"no RESULT line in worker output:\n{out[-2000:]}"
-        results.append(json.loads(lines[0][len("RESULT "):]))
+    results = _run_cluster(WORKER, n_procs)
 
     ref_state, ref_p3 = _oracle_state_and_prob()
     # single-process references for the fused sharded programs
@@ -111,3 +143,31 @@ def test_cluster_matches_oracle(n_procs):
     # host-side measurement draws must agree across processes
     assert len({r["mall"] for r in results}) == 1
     assert len({r["tq_mall"] for r in results}) == 1
+
+
+def test_multihost_pager_w20_qft(tmp_path):
+    """2-process / 8-device global mesh: a remap-on QPager runs a w20
+    QFT end-to-end with the BATCHED exchange collective riding the
+    inter-host page axis (top page bit = DCN stand-in), stays at
+    fidelity ~1.0 vs the CPU oracle, and a checkpoint written under the
+    global mesh restores bit-identically on every process."""
+    results = _run_cluster(
+        PAGER_WORKER, 2, timeout=360,
+        extra_env={"QRACK_CKPT_DIR": str(tmp_path),
+                   "QRACK_TPU_FUSE_WINDOW": "16"})
+    assert len(results) == 2
+    for r in results:
+        assert r["procs"] == 2 and r["n_global_devices"] == 8
+        # pages 0-3 live on process 0, 4-7 on process 1: the TOP page
+        # bit is the process-spanning (DCN) axis, the low two are ICI
+        assert r["kinds"] == ["ici", "ici", "dcn"]
+        assert r["fidelity"] > 1 - 1e-6, r["fidelity"]
+        assert abs(r["prob3_diff"]) < 3e-5
+        # the planner fired at least one >= 2-pair batched prologue and
+        # its collective crossed the wire (bytes counted by the
+        # lowering's accounting twin)
+        assert r["remap_batched"] >= 1
+        assert r["remap_pairs"] >= 2
+        assert r["exchange_bytes"] > 0
+        assert r["collective_bytes"] > 0
+        assert r["restore_identical"] and r["restore_qmap_ok"]

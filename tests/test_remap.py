@@ -49,13 +49,15 @@ def _op_skip_setbit(rng):
 # fuzz parity: the whole non-measuring op vocabulary on a remap-on pager
 # ---------------------------------------------------------------------------
 
+@pytest.mark.parametrize("collective", ["auto", "off"])
 @pytest.mark.parametrize("window", [1, 16])
 @pytest.mark.parametrize("trial", range(3))
-def test_fuzz_parity_remap_on(trial, window, monkeypatch):
+def test_fuzz_parity_remap_on(trial, window, collective, monkeypatch):
     monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", str(window))
     rng = np.random.Generator(np.random.PCG64(7000 + trial))
     o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
     s = create_quantum_interface("pager", N, n_pages=8, remap="on",
+                                 collective=collective,
                                  rng=QrackRandom(trial),
                                  rand_global_phase=False)
     for step in range(25):
@@ -200,49 +202,235 @@ def _circuit_ops(width, kind):
     return ops
 
 
-def _account(ops, width, L, window, remap_on):
+def _account(ops, width, L, window, remap_on, batched=True):
     """Replay the _dispatch_ops cost accounting host-side: window at a
-    time, remap prologue swaps at nb/2 per paged pair, translated gens
-    on paged targets at nb — exact at any width (pure arithmetic)."""
+    time, prologue swaps priced by the lowering's own accounting twin
+    (ops/sharded.py exchange_cost — mirrors _tele_remap exactly),
+    translated gens on paged targets at nb — exact at any width (pure
+    arithmetic, no state allocated)."""
+    from qrack_tpu.ops import sharded as shb
+
     nb = 2 * (1 << width) * 4  # f32 planes
     qmap = list(range(width))
-    total = 0
+    total = 0.0
     pairs = 0
     for s in range(0, len(ops), window):
         win = ops[s:s + window]
         rest = [("gen" if op.kind in ("gen", "inv") else "diag", op.target)
                 for op in ops[s + window:]]
         if remap_on:
-            swaps, qmap = fu.plan_remaps(win, L, qmap, rest)
+            swaps, qmap = fu.plan_remaps(win, L, qmap, rest,
+                                         batched=batched)
             pairs += len(swaps)
-            for p1, p2 in swaps:
-                if max(p1, p2) >= L:
-                    total += nb // 2
+            total += shb.exchange_cost(L, width - L, swaps,
+                                       batched=batched) * nb
         for op in fu.translate_ops(win, qmap):
             if op.kind in ("gen", "inv") and op.target >= L:
                 total += nb
     return total, pairs
 
 
-def test_w26_iqft_accounting_2x():
-    """The acceptance-scale claim without the 512 MiB ket: at w26 on 8
-    pages the planner moves each of the 3 paged qubits once (gen-done
-    victims, zero pay-back) — exactly half the off-mode bytes."""
-    w, L = 26, 23
+def test_w26_iqft_accounting_batched_collective():
+    """The acceptance-scale claim without the 2 GiB ket: w26 on 16
+    pages (k=4).  Per-pair prologues ship nb/2 per paged qubit (the PR
+    10 2x-halving baseline); the batched collective ships all four in
+    one exchange at (1 - 2^-4) x nb — under 0.47x the per-pair bytes,
+    0.55x required."""
+    w, L = 26, 22
     ops = _circuit_ops(w, "iqft")
-    off, _ = _account(ops, w, L, 16, remap_on=False)
-    auto, pairs = _account(ops, w, L, 16, remap_on=True)
     nb = 2 * (1 << w) * 4
-    assert off == 3 * nb
-    assert pairs == 3 and auto * 2 == off, (off, auto, pairs)
+    off, _ = _account(ops, w, L, 16, remap_on=False)
+    per_pair, pp_pairs = _account(ops, w, L, 16, remap_on=True,
+                                  batched=False)
+    batch, b_pairs = _account(ops, w, L, 16, remap_on=True, batched=True)
+    assert off == 4 * nb
+    assert pp_pairs == 4 and per_pair == 2 * nb, (per_pair, pp_pairs)
+    assert b_pairs == 4 and batch == (1 - 2.0 ** -4) * nb, (batch, b_pairs)
+    assert batch <= 0.55 * per_pair, (batch, per_pair)
 
 
-def test_w26_qft_accounting_never_worse():
-    """Descending-gen QFT: every remap victim still owes a gen, so
-    per-window prologues cannot beat 2g/(g+1) — the planner must simply
-    never ship MORE than the pair-exchange path."""
+def test_w26_qft_accounting_delivery_ratio():
+    """Descending-gen QFT: every per-pair remap victim still owes a gen,
+    so PR 10 prologues were bound at 2g/(g+1) and never fired (per-pair
+    == remap-off == 3nb at w26/8 pages).  The batched collective breaks
+    the bound: two k=3 batches (hot trio in window 1, pay-back trio once
+    its victims are gen-done) ship 2 x (1 - 2^-3) x nb = 1.75nb — a
+    12/7 ~ 1.71x delivery ratio vs remap-off, >= 1.6x required."""
     w, L = 26, 23
     ops = _circuit_ops(w, "qft")
+    nb = 2 * (1 << w) * 4
     off, _ = _account(ops, w, L, 16, remap_on=False)
-    auto, _ = _account(ops, w, L, 16, remap_on=True)
-    assert auto <= off, (off, auto)
+    per_pair, _ = _account(ops, w, L, 16, remap_on=True, batched=False)
+    batch, _ = _account(ops, w, L, 16, remap_on=True, batched=True)
+    assert off == 3 * nb and per_pair == off, (off, per_pair)
+    assert batch == 2 * (1 - 2.0 ** -3) * nb, batch
+    assert off / batch >= 1.6, (off, batch)
+
+
+# ---------------------------------------------------------------------------
+# measured batched collective: telemetry bytes on a real pager, driven
+# through QCircuit.Run so the planner sees the full-circuit lookahead
+# ---------------------------------------------------------------------------
+
+def _iqft_qcircuit(width):
+    """registers.py IQFT gate order as a QCircuit (ascending-gen:
+    cphases then H per target) — Run() primes the fuser lookahead."""
+    from qrack_tpu.layers.qcircuit import QCircuit
+
+    h = np.array([[1, 1], [1, -1]], dtype=np.complex128) / np.sqrt(2)
+    c = QCircuit(width)
+    for i in range(width):
+        for j in range(i):
+            ph = np.exp(-1j * np.pi / 2.0 ** (j + 1))
+            c.append_ctrl([i - (j + 1)], i,
+                          np.diag([1.0, ph]).astype(np.complex128), 1)
+        c.append_1q(i, h)
+    return c
+
+
+def _measured_circuit_bytes(width, n_pages, collective, monkeypatch):
+    monkeypatch.setenv("QRACK_TPU_FUSE_WINDOW", "16")
+    circ = _iqft_qcircuit(width)
+    o = QEngineCPU(width, rng=QrackRandom(3), rand_global_phase=False)
+    o.SetPermutation(314)
+    circ.Run(o)
+    tele.reset()
+    tele.enable()
+    q = QPager(width, rng=QrackRandom(3), rand_global_phase=False,
+               n_pages=n_pages, remap="auto", collective=collective)
+    q.SetPermutation(314)
+    circ.Run(q)
+    _ = q.GetAmplitude(0)  # read boundary: flush the fused window
+    c = tele.snapshot()["counters"]
+    tele.disable()
+    tele.reset()
+    f = _fidelity(o.GetQuantumState(), q.GetQuantumState())
+    return c, f
+
+
+def test_collective_measured_w10(monkeypatch):
+    """w10 IQFT / 8 pages, measured: the batched lowering ships exactly
+    (1 - 2^-3) x nb in ONE collective where per-pair ships 3 x nb/2 —
+    the (1 - 2^-k)x ratio of mpiQulacs' fused exchange, on the wire."""
+    nb = 2 * (1 << 10) * 4
+    on, f_on = _measured_circuit_bytes(10, 8, "auto", monkeypatch)
+    off, f_off = _measured_circuit_bytes(10, 8, "off", monkeypatch)
+    assert f_on > 1 - 1e-6 and f_off > 1 - 1e-6, (f_on, f_off)
+    assert on.get("exchange.pager.bytes", 0) == (1 - 2.0 ** -3) * nb, on
+    assert on.get("exchange.pager.collective_bytes", 0) \
+        == on["exchange.pager.bytes"]
+    assert on.get("remap.pager.batched", 0) >= 1
+    assert off.get("exchange.pager.bytes", 0) == 1.5 * nb, off
+    assert off.get("remap.pager.batched", 0) == 0
+    assert off.get("exchange.pager.collective_bytes", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# the permutation lowering itself: random transposition batches vs the
+# numpy bit-permutation oracle, on a real 8-device mesh
+# ---------------------------------------------------------------------------
+
+def test_apply_remap_random_oracle():
+    """apply_remap (batched AND per-pair) must realize the composed bit
+    permutation of any transposition sequence — local, mixed and
+    page-page, including the page-bit swaps the DCN pass emits."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from qrack_tpu.ops import sharded as shb
+    from qrack_tpu.parallel.pager import _compat_shard_map
+
+    L, g = 4, 3
+    n = L + g
+    mesh = Mesh(np.array(jax.devices()[:1 << g]), ("pages",))
+    sh = NamedSharding(mesh, P(None, "pages"))
+    rng = np.random.default_rng(17)
+    for trial in range(8):
+        swaps = tuple(tuple(int(x) for x in
+                            rng.choice(n, size=2, replace=False))
+                      for _ in range(int(rng.integers(1, 6))))
+        state = rng.normal(size=(2, 1 << n)).astype(np.float32)
+        src = shb.compose_swaps(n, swaps)
+        j = np.zeros(1 << n, dtype=np.int64)
+        for p in range(n):
+            j |= ((np.arange(1 << n) >> p) & 1) << src[p]
+        want = state[:, j]
+        for batched in (True, False):
+            prog = jax.jit(_compat_shard_map(
+                lambda local: shb.apply_remap(local, 1 << g, L, swaps,
+                                              batched=batched),
+                mesh=mesh, in_specs=P(None, "pages"),
+                out_specs=P(None, "pages")))
+            got = np.asarray(prog(jax.device_put(state, sh)))
+            np.testing.assert_array_equal(got, want,
+                                          err_msg=f"{trial} {batched} "
+                                                  f"{swaps}")
+
+
+# ---------------------------------------------------------------------------
+# DCN-aware planning: the multi-host cost model prefers ICI page bits
+# ---------------------------------------------------------------------------
+
+def test_plan_remaps_dcn_weights_prefer_ici():
+    """With non-uniform page-bit weights (DCN stand-in) the planner
+    moves a hot qubit OFF the expensive page bit onto a gen-done ICI
+    one — a pure page-bit transposition — when evicting to a local
+    would charge the victim at the DCN rate."""
+    eye = np.eye(2, dtype=np.complex128)
+    L, n = 4, 6            # g=2: page bit 0 ICI, page bit 1 DCN
+    weights = (1.0, 4.0)
+    ops = [fu.FusedOp("gen", 5, 0, 0, eye)]
+    look = [("gen", q) for q in range(L)]  # every local still owes one
+    swaps, qmap = fu.plan_remaps(ops, L, list(range(n)), look,
+                                 weights=weights, batched=True)
+    assert swaps == ((4, 5),), swaps       # page-page, off the DCN bit
+    assert qmap[5] == 4 and qmap[4] == 5
+    # uniform weights: same window fires nothing (net-zero local swap)
+    swaps_u, qmap_u = fu.plan_remaps(ops, L, list(range(n)), look,
+                                     weights=None, batched=True)
+    assert swaps_u == () and qmap_u == list(range(n))
+
+
+def test_page_bit_weights_standin():
+    """cluster.page_bit_weights: single host is uniform (None) unless
+    the DCN stand-in forces the top bits to DCN pricing."""
+    import jax
+
+    from qrack_tpu.parallel import cluster
+
+    devs = jax.devices()[:8]
+    assert cluster.page_bit_weights(devs) is None
+    w = cluster.page_bit_weights(devs, dcn_bits=1)
+    assert w is not None and len(w) == 3
+    assert w[2] == cluster.dcn_weight() and w[0] == w[1] == 1.0
+    assert cluster.page_bit_kinds(devs) == ("ici",) * 3
+
+
+# ---------------------------------------------------------------------------
+# structural ops mid-BATCHED-prologue
+# ---------------------------------------------------------------------------
+
+def test_shrink_mid_batched_prologue_resets_table():
+    """Elastic shrink right after a >= 2-pair batched prologue: the
+    repage gathers the LOGICAL view, the table resets, and the stack
+    stays on-oracle."""
+    n = 7
+    o = QEngineCPU(n, rng=QrackRandom(21), rand_global_phase=False)
+    p = QPager(n, rng=QrackRandom(21), rand_global_phase=False,
+               n_pages=8, remap="on")
+    tele.reset()
+    tele.enable()
+    _force_nonid(o, p)
+    c = tele.snapshot()["counters"]
+    tele.disable()
+    tele.reset()
+    assert c.get("remap.pager.batched", 0) >= 1, c
+    assert c.get("exchange.pager.collective_bytes", 0) > 0, c
+    p.shrink_pages()
+    assert p.n_pages == 4 and not p._map_nonid()
+    for eng in (o, p):
+        eng.RY(0.7, 5)
+        eng.CNOT(6, 2)
+        eng.H(0)
+    np.testing.assert_allclose(p.GetQuantumState(), o.GetQuantumState(),
+                               atol=3e-5)
