@@ -528,6 +528,30 @@ class QEngineTurboQuant(QEngineTPU):
                                      jnp.dtype(self._code_np).name)
         self._codes = codes
         self._scales = scales
+        self._note_resident()
+
+    def _note_resident(self) -> None:
+        """Resident-footprint gauges: codes+scales bytes vs what the
+        same ket would cost as two f32 planes (the compression-ratio
+        numerator/denominator telemetry_report's == compression ==
+        section reads).  Reads the raw arrays — the public properties
+        flush the fuser, which must not fire from bookkeeping."""
+        if not _tele._ENABLED:
+            return
+        codes = getattr(self, "_codes_raw", None)
+        if codes is None:
+            return
+        _tele.gauge("tq.resident.bytes",
+                    float(codes.nbytes + self._scales_raw.nbytes))
+        _tele.gauge("tq.resident.dense_equiv_bytes",
+                    float(8 * (1 << self.qubit_count)))
+
+    def _note_sweeps(self, n: int = 2) -> None:
+        """Counted decompress/recompress passes over the resident codes
+        (one of each per dispatched program) — the denominator of the
+        single-pass fused-window win."""
+        if _tele._ENABLED:
+            _tele.inc("tq.sweeps", n)
 
     def _decompress_planes(self):
         rows = _j_dec_rows(self._codes, self._scales, self._rot_t, self._qmax)
@@ -615,6 +639,7 @@ class QEngineTurboQuant(QEngineTPU):
     def _store3(self, codes3, scales2) -> None:
         self._codes = codes3.reshape(-1, codes3.shape[-1])
         self._scales = scales2.reshape(-1)
+        self._note_resident()
 
     def _layout_key(self):
         return (self.qubit_count, self._tq_chunk_pow, self._tq_block_pow,
@@ -705,6 +730,7 @@ class QEngineTurboQuant(QEngineTPU):
         return _program(("tq_pl_diag", self._layout_key(), tp), build)
 
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:
+        self._note_sweeps()
         cmask, cval = self._cmask_cval(controls, perm)
         mp = gk.mtrx_planes(np.asarray(m2, dtype=np.complex128), jnp.float32)
         ca = self._tq_chunk_pow
@@ -742,6 +768,7 @@ class QEngineTurboQuant(QEngineTPU):
         return _program(("tq_diag", self._layout_key()), build)
 
     def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
+        self._note_sweeps()
         cmask, cval = self._cmask_cval(controls, perm)
         ca = self._tq_chunk_pow
         cs = self._chunk_amps
@@ -775,12 +802,11 @@ class QEngineTurboQuant(QEngineTPU):
     # ------------------------------------------------------------------
 
     def _fuse_admit(self, m, target, controls) -> bool:
-        # the Pallas path stays per-gate (its kernels fuse decompress/
-        # gate/recompress already); cross-chunk pair mixing (non-diagonal
-        # target at/above the chunk axis) can't join a single-chunk
-        # window body
+        # both backends fuse whole windows into ONE decompress -> ops ->
+        # recompress pass now; only cross-boundary non-diagonal targets
+        # (pair mixing above the chunk/tile axis) stay per-gate
         if self._use_pallas():
-            return False
+            return mat.is_phase(m) or target < self._pallas_tile_pow()
         return mat.is_phase(m) or target < self._tq_chunk_pow
 
     def _fuse_tick(self) -> None:
@@ -801,6 +827,30 @@ class QEngineTurboQuant(QEngineTPU):
         return _program(("tq_fusewin", self._layout_key(), structure),
                         build, site="tpu.fuse.flush")
 
+    def _p_pallas_window(self, structure, tp: int):
+        from ..ops import pallas_turboquant as ptq
+
+        def build():
+            return _tele.instrument_jit("fuse.window", jax.jit(
+                ptq.make_tq_window(
+                    self.qubit_count, self._tq_block_pow, self._tq_bits,
+                    structure, tile_pow=tp,
+                    interpret=self._pallas_interpret()),
+                donate_argnums=(0, 1)))
+
+        return _program(("tq_pl_fusewin", self._layout_key(), tp,
+                         structure), build, site="tpu.fuse.flush")
+
+    def _note_window(self, n_ops: int) -> None:
+        """Single-pass window accounting: one decompress + one
+        recompress sweep total, where the per-gate path would have paid
+        a pair per op — `fuse.tq.sweeps_saved` is the difference."""
+        self._note_sweeps()
+        if _tele._ENABLED:
+            _tele.inc("fuse.tq.windows")
+            _tele.inc("fuse.tq.ops", n_ops)
+            _tele.inc("fuse.tq.sweeps_saved", 2 * (n_ops - 1))
+
     def _fuse_flush(self, gates) -> int:
         from ..ops import fusion as fu
 
@@ -818,9 +868,23 @@ class QEngineTurboQuant(QEngineTPU):
                 self._k_apply_2x2(m, op.target, controls, perm)
             return 1
         structure = fu.sharded_structure_of(ops)
+        if self._use_pallas():
+            # single-pass per VMEM tile: masks split at the tile
+            # boundary, whole window in-register between dequant/requant
+            tp = self._pallas_tile_pow()
+            operands = fu.sharded_operands(ops, tp, jnp.float32)
+            self._note_transient(1)
+            self._note_window(len(ops))
+            prog = self._p_pallas_window(structure, tp)
+            self._codes, self._scales = prog(
+                self._codes, self._scales, self._rot, self._rot_t,
+                *operands)
+            self._note_resident()
+            return 1
         operands = fu.sharded_operands(ops, self._tq_chunk_pow,
                                        jnp.float32)
         self._note_transient(1)
+        self._note_window(len(ops))
         prog = self._p_fuse_window(structure)
         c3, s2 = self._chunk3()
         nc, ns = prog(c3, s2, self._rot, self._rot_t, *operands)
@@ -842,6 +906,7 @@ class QEngineTurboQuant(QEngineTPU):
         return _program(("tq_phase", self._layout_key(), tuple(key)), build)
 
     def _k_phase_fn(self, fn, split=None) -> None:
+        self._note_sweeps()
         self._note_transient(1)
         if split is not None:
             # split (chunk_id, local_idx) form: exact past 31 qubits,

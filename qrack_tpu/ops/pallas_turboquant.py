@@ -24,6 +24,16 @@ payloads with target < log2(tile amplitudes) (pairs live inside a
 tile); controls anywhere.  The engine routes the rest to the XLA
 programs.
 
+:func:`make_tq_window` extends the same fusion to a WHOLE gate window:
+one dequant, every window op through the shared tile primitives
+(ops/pallas_kernels.py), one requant — so a W-op window costs a single
+read+write of the codes instead of W (the single-pass sweep the
+`fuse.tq.sweeps_saved` counter measures).  Gate payloads and control
+masks stay runtime operands; the compile cache is keyed on the window
+STRUCTURE (per-op kind/target/controlled), so every QFT sweep at one
+width shares one binary.  Tiles no window op dirtied keep their codes
+bit-for-bit, same as the per-gate kernels.
+
 Opt-in via QRACK_USE_PALLAS=1 (same flag as the dense segment sweep;
 off by default until validated on a healthy chip); `interpret=True`
 runs the identical kernels on CPU for the conformance tests.
@@ -171,3 +181,125 @@ def make_tq_diag(n: int, block_pow: int, bits: int,
                         qmax, cdt, TB, D)
 
     return _mk_call(kernel, B, D, TB, nblk, cdt, 6, interpret)
+
+
+def make_tq_window(n: int, block_pow: int, bits: int, structure,
+                   tile_pow: int = 18, interpret: bool = False):
+    """fn(codes, scales, rot, rot_t, *operands) running a whole fused
+    window — ONE dequant, every op, ONE requant — per VMEM tile.
+
+    `structure` is fusion.sharded_structure_of's (kind, target,
+    controlled?) tuple and `operands` fusion.sharded_operands' layout
+    with the lo/hi mask split at THIS kernel's tile boundary: cphase
+    ops carry a (2,) phase payload (+2 combined-mask scalars when
+    controlled), diag a (2, 2) factor table (+4 split-mask scalars),
+    gen a (2, 2, 2) matrix-planes payload (+4).  Per-op tile math is
+    the shared pallas_kernels primitives, f32 throughout; the dirty
+    accumulator mirrors engines/turboquant.py _mk_fuse_window so tiles
+    no op acted on (failed high-control tests, identically-1 diagonal
+    factors) keep their exact codes."""
+    from . import pallas_kernels as pk
+
+    D = 1 << block_pow
+    tp = min(tile_pow, n)
+    T = 1 << tp
+    TB = max(1, T // D)
+    B = (1 << n) // D
+    nblk = max(1, B // TB)
+    qmax = float((1 << (bits - 1)) - 1)
+    cdt = jnp.int8 if bits <= 8 else jnp.int16
+    lbits = T - 1
+
+    # operand slot layout mirroring fusion.sharded_operands: "f" slots
+    # are small float payload arrays, "i" slots int32 mask scalars
+    slots = []
+    for kind, _target, has_ctrl in structure:
+        if kind == "cphase":
+            slots.append(("f", (2,)))
+            if has_ctrl:
+                slots += [("i", (1,))] * 2
+        else:
+            slots.append(("f", (2, 2) if kind == "diag" else (2, 2, 2)))
+            if has_ctrl:
+                slots += [("i", (1,))] * 4
+
+    def kernel(*refs):
+        c_ref, s_ref, rot_ref, rott_ref = refs[:4]
+        op_refs = refs[4:4 + len(slots)]
+        oc_ref, os_ref = refs[4 + len(slots):]
+        blk = pl.program_id(0)
+        v = _dequant_to_planes(c_ref, s_ref, rott_ref, qmax, TB, D)
+        lidx = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)[0]
+        dirty = jnp.zeros((), jnp.bool_)
+        i = 0
+        for kind, target, has_ctrl in structure:
+            p = op_refs[i][...]
+            i += 1
+            if kind == "cphase":
+                if has_ctrl:
+                    clo, chi = op_refs[i][0], op_refs[i + 1][0]
+                    i += 2
+                else:
+                    comb = 1 << target
+                    clo, chi = comb & lbits, comb >> tp
+                v, hi_ok = pk.tile_cphase(v, lidx, blk, clo, chi,
+                                          p[0], p[1])
+                dirty = dirty | hi_ok
+                continue
+            if has_ctrl:
+                lo_cm, lo_cv = op_refs[i][0], op_refs[i + 1][0]
+                hi_cm, hi_cv = op_refs[i + 2][0], op_refs[i + 3][0]
+                i += 4
+            else:
+                lo_cm = lo_cv = hi_cm = hi_cv = 0
+            if kind == "diag":
+                v, hi_ok = pk.tile_diag(
+                    v, lidx, blk, target, tp,
+                    p[0, 0], p[0, 1], p[1, 0], p[1, 1],
+                    lo_cm, lo_cv, hi_cm, hi_cv)
+                if target >= tp:
+                    # whole-tile constant factor: exact-keep tiles whose
+                    # factor is identically 1 (make_tq_diag's ident)
+                    hi_bit = (blk & (1 << (target - tp))) != 0
+                    cf_re = jnp.where(hi_bit, p[1, 0], p[0, 0])
+                    cf_im = jnp.where(hi_bit, p[1, 1], p[0, 1])
+                    ident = ((lo_cm == 0) & (cf_re == 1.0)
+                             & (cf_im == 0.0))
+                    dirty = dirty | (hi_ok & ~ident)
+                else:
+                    dirty = dirty | hi_ok
+            else:  # gen: target < tile pow guaranteed by _fuse_admit
+                v, hi_ok = pk.tile_local_2x2(v, lidx, blk, target, p,
+                                             lo_cm, lo_cv, hi_cm, hi_cv)
+                dirty = dirty | hi_ok
+        _requant_select(v, dirty, c_ref, s_ref, rot_ref, oc_ref, os_ref,
+                        qmax, cdt, TB, D)
+
+    _MAPS = {1: lambda i: (0,), 2: lambda i: (0, 0),
+             3: lambda i: (0, 0, 0)}
+
+    def fn(codes, scales, rot, rot_t, *operands):
+        in_specs = [
+            pl.BlockSpec((TB, 2 * D), lambda i: (i, 0)),
+            pl.BlockSpec((TB,), lambda i: (i,)),
+            pl.BlockSpec((2 * D, 2 * D), lambda i: (0, 0)),
+            pl.BlockSpec((2 * D, 2 * D), lambda i: (0, 0)),
+        ]
+        packed = []
+        for (tag, shape), val in zip(slots, operands):
+            in_specs.append(pl.BlockSpec(shape, _MAPS[len(shape)]))
+            packed.append(jnp.asarray(val, jnp.float32) if tag == "f"
+                          else jnp.asarray(val, jnp.int32).reshape(1))
+        call = pl.pallas_call(
+            kernel,
+            out_shape=(jax.ShapeDtypeStruct((B, 2 * D), cdt),
+                       jax.ShapeDtypeStruct((B,), jnp.float32)),
+            grid=(nblk,),
+            in_specs=in_specs,
+            out_specs=(pl.BlockSpec((TB, 2 * D), lambda i: (i, 0)),
+                       pl.BlockSpec((TB,), lambda i: (i,))),
+            interpret=interpret,
+        )
+        return call(codes, scales, rot, rot_t, *packed)
+
+    return fn

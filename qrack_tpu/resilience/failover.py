@@ -123,19 +123,24 @@ _SELF_ATTRS = ("_engine", "_chain_pos")
 def _engine_kind(engine) -> str:
     name = type(engine).__name__
     return {"QPager": "pager", "QEngineTPU": "tpu",
-            "QEngineCPU": "cpu"}.get(name, name.lower())
+            "QEngineCPU": "cpu",
+            "QEngineTurboQuant": "turboquant",
+            "QPagerTurboQuant": "turboquant_pager"}.get(name, name.lower())
 
 
 def _fallback_candidates(engine):
     """Yield (kind, builder) pairs downstream of `engine` in the chain
-    pager -> tpu -> cpu.  Builders take (qubit_count, state, rng)."""
+    pager -> tpu -> cpu.  Builders take (qubit_count, state, rng).
+    Quantized engines climb the PRECISION ladder first — turboquant ->
+    full f32 planes — so exhausted drift replays land on a
+    representation without quantization error instead of the host."""
     from ..engines.cpu import QEngineCPU
     from ..engines.tpu import MAX_DENSE_QB, QEngineTPU
 
     kind = _engine_kind(engine)
     n = engine.qubit_count
-    if kind == "pager" and getattr(engine, "can_shrink", None) \
-            and engine.can_shrink():
+    if kind in ("pager", "turboquant_pager") \
+            and getattr(engine, "can_shrink", None) and engine.can_shrink():
         # elastic first: halve the page count onto the surviving device
         # prefix and stay on the mesh (docs/ELASTICITY.md).  Mutates the
         # SAME engine object; the snapshot the caller took is handed in
@@ -146,6 +151,11 @@ def _fallback_candidates(engine):
         # single-device TPU is only worth trying when the tunnel is not
         # the thing that just failed (breaker still closed => the
         # failure was local to the paged path, e.g. one exchange site)
+        yield "tpu", lambda st, rng: _rehydrate(QEngineTPU, n, st, rng)
+    if kind in ("turboquant", "turboquant_pager") and n <= MAX_DENSE_QB:
+        # drift giveup is a precision phenomenon, not a tunnel failure,
+        # so this rung is NOT breaker-gated: if the tunnel really is
+        # down the dense build fails and the chain falls through to cpu
         yield "tpu", lambda st, rng: _rehydrate(QEngineTPU, n, st, rng)
     yield "cpu", lambda st, rng: _rehydrate(QEngineCPU, n, st, rng)
 

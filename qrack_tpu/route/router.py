@@ -28,8 +28,14 @@ new stack, rng object carried so measurement streams continue):
 * a QBdt whose node count blows past QRACK_ROUTE_BDT_MAX_NODES is
   re-materialized onto dense at the next job/read boundary.
 
-A mis-route that CANNOT escalate (width past the dense cap) raises the
-typed :class:`MisrouteError` at plan time, before any state is lost.
+"Dense" is the top of a LADDER, not a single rung: when the width (or
+the device HBM budget — cost.py's memory axis) rules the f32 planes
+out, the compressed turboquant tier is the dense-equivalent target, and
+a quantized session whose drift replays exhaust (DispatchGiveUp from
+the integrity plane) escalates turboquant→dense the same monotone
+direction when the width allows.  A mis-route that CANNOT escalate
+(width past every ladder rung) raises the typed :class:`MisrouteError`
+at plan time, before any state is lost.
 """
 
 from __future__ import annotations
@@ -46,9 +52,23 @@ from .features import extract_features
 
 
 class MisrouteError(RuntimeError):
-    """A routed session needs the dense representation but its width
-    cannot be densely represented — the circuit is refused at admission
+    """A routed session needs a dense-equivalent representation but no
+    ladder rung (dense planes, compressed turboquant) can hold its
+    width on this device budget — the circuit is refused at admission
     rather than destroying the session's cheap-representation state."""
+
+
+# escalations are monotone UP this ladder: cheap host-side stacks, then
+# the compressed dense-equivalent tier, then full f32 planes.  A plan
+# may upgrade a pending plan's rung; it never downgrades one.
+_RANK = {"stabilizer": 0, "bdt": 0, "qunit": 0, "turboquant": 1, "dense": 2}
+
+_QUANT_STACKS = ("turboquant", "turboquant_pager")
+
+# ctor kwargs owned by the quantized tier — stripped when the ladder
+# builds any other stack (a quantized session escalating to dense must
+# not forward `bits=` into QEngineTPU)
+_TQ_KWARGS = ("bits", "block_pow", "chunk_qb", "seed_rot")
 
 
 @dataclass
@@ -68,6 +88,15 @@ def decide(circuit, width: int, mode: Optional[str] = None) -> RouteDecision:
     mode = mode or _cost.route_mode()
     f = extract_features(circuit, width)
     stack, scores = _cost.choose_stack(f, knobs, mode=mode)
+    if _tele._ENABLED:
+        _tele.gauge("route.hbm.budget_bytes",
+                    float(_cost.hbm_budget_bytes(knobs)))
+        _tele.gauge(f"route.hbm.{stack}.bytes",
+                    _cost.hbm_bytes(stack, f, knobs))
+        if (scores.get("dense") == _cost.INFEASIBLE
+                and width <= knobs.dense_max_qb):
+            # the width knob allowed dense; the memory axis vetoed it
+            _tele.inc("route.hbm.dense_blocked")
     return RouteDecision(stack=stack,
                          layers=_cost.layers_for(stack, width, knobs),
                          reason="pinned" if mode != "auto" else "cost",
@@ -154,12 +183,15 @@ class QRouted:
         knobs = _cost.RouteKnobs.from_env()
         with self._lock:
             if self._engine is None:
-                if self._pending is not None and self._pending.stack == "dense":
+                if (self._pending is not None
+                        and self._pending.stack == "dense"):
                     return self._pending
                 d = decide(circuit, self.qubit_count)
-                if self._pending is None or d.stack == "dense":
+                if (self._pending is None
+                        or _RANK.get(d.stack, 0)
+                        > _RANK.get(self._pending.stack, 0)):
                     # first circuit decides; later pre-build circuits
-                    # may only upgrade the plan to dense
+                    # may only upgrade the plan UP the ladder
                     self._pending = d
                     self._note_decision(d)
                 return self._pending
@@ -169,15 +201,19 @@ class QRouted:
             if d.stack == "stabilizer":
                 f = extract_features(circuit, self.qubit_count)
                 if f.general_count > 0 or f.magic_count > knobs.max_magic:
-                    if self.qubit_count > knobs.dense_max_qb:
+                    # the cheapest dense-equivalent rung that can hold
+                    # this width on the device budget; no rung => refuse
+                    target = _cost.ladder_stack(self.qubit_count, knobs)
+                    if target is None:
                         raise MisrouteError(
-                            f"circuit needs a dense representation but "
-                            f"width {self.qubit_count} exceeds the dense "
-                            f"cap ({knobs.dense_max_qb}); refusing rather "
+                            f"circuit needs a dense-equivalent "
+                            f"representation but width {self.qubit_count} "
+                            f"exceeds every ladder rung (dense cap "
+                            f"{knobs.dense_max_qb}); refusing rather "
                             "than destroying the stabilizer state")
                     self._pending = RouteDecision(
-                        stack="dense",
-                        layers=_cost.layers_for("dense", self.qubit_count,
+                        stack=target,
+                        layers=_cost.layers_for(target, self.qubit_count,
                                                 knobs),
                         reason="misroute:planned", features=f.as_dict())
                     self._note_misroute("planned")
@@ -194,17 +230,29 @@ class QRouted:
             return
         if self._engine is None:
             self._build(pending)
-        elif pending.stack == "dense" and self.current_stack() != "dense":
-            self._escalate(pending.reason)
+        elif (_RANK.get(pending.stack, 0)
+                > _RANK.get(self.current_stack(), 0)):
+            self._escalate(pending.reason, to_stack=pending.stack)
 
     # -- engine lifecycle ----------------------------------------------
+
+    def _kwargs_for(self, stack: str) -> dict:
+        """Forwarded ctor kwargs, filtered per target stack: the
+        quantized tier's knobs must not leak into a dense/cheap build
+        (an escalating session would TypeError in QEngineTPU)."""
+        kw = dict(self._kwargs)
+        if stack not in _QUANT_STACKS:
+            for k in _TQ_KWARGS:
+                kw.pop(k, None)
+        return kw
 
     def _build(self, decision: RouteDecision) -> None:
         from ..factory import create_quantum_interface
 
         self._engine = create_quantum_interface(
             decision.layers, self.qubit_count,
-            init_state=self._init_state, rng=self.rng, **self._kwargs)
+            init_state=self._init_state, rng=self.rng,
+            **self._kwargs_for(decision.stack))
         self._decision = decision
         if _tele._ENABLED:
             _tele.inc(f"route.built.{decision.stack}")
@@ -228,36 +276,61 @@ class QRouted:
             self._note_decision(pending)
         self._build(pending)
 
-    def _escalate(self, reason: str) -> None:
-        """Snapshot-carry the state onto the dense stack (the failover
-        chain's rehydration idiom: full-state read, SetQuantumState on
-        the replacement, rng OBJECT carried so the measurement stream
-        position survives)."""
+    def _escalate(self, reason: str, to_stack: str = "dense") -> None:
+        """Snapshot-carry the state onto a higher ladder rung (the
+        failover chain's rehydration idiom: full-state read,
+        SetQuantumState on the replacement, rng OBJECT carried so the
+        measurement stream position survives)."""
         from ..factory import create_quantum_interface
 
         knobs = _cost.RouteKnobs.from_env()
-        if self.qubit_count > knobs.dense_max_qb:
-            raise MisrouteError(
-                f"cannot escalate width {self.qubit_count} to dense "
-                f"(cap {knobs.dense_max_qb})")
+        if to_stack == "dense" and self.qubit_count > knobs.dense_max_qb:
+            # a quantized session may still land on the width-switching
+            # hybrid up to the engine's representable cap; any other
+            # over-cap escalation refuses before state is lost
+            if (self.current_stack() not in _QUANT_STACKS
+                    or self.qubit_count > _cost._TQ_BASE_CAP):
+                raise MisrouteError(
+                    f"cannot escalate width {self.qubit_count} to dense "
+                    f"(cap {knobs.dense_max_qb})")
         old_stack = self.current_stack()
         state = self._engine.GetQuantumState()
-        dense = create_quantum_interface(
-            _cost.layers_for("dense", self.qubit_count, knobs),
-            self.qubit_count, rng=self.rng, **self._kwargs)
-        dense.SetQuantumState(state)
-        self._engine = dense
+        layers = _cost.layers_for(to_stack, self.qubit_count, knobs)
+        new = create_quantum_interface(
+            layers, self.qubit_count, rng=self.rng,
+            **self._kwargs_for(to_stack))
+        new.SetQuantumState(state)
+        self._engine = new
         self._decision = RouteDecision(
-            stack="dense",
-            layers=_cost.layers_for("dense", self.qubit_count, knobs),
-            reason=f"escalated:{reason}")
-        self._escalated = True
+            stack=to_stack, layers=layers, reason=f"escalated:{reason}")
+        # only the TOP rung is terminal: a session escalated into the
+        # quantized tier can still climb to dense on drift giveup
+        self._escalated = to_stack == "dense"
         if _tele._ENABLED:
             _tele.inc("route.misroute.escalated")
             _tele.event("route.escalate", reason=reason,
-                        from_stack=old_stack, to_stack="dense",
+                        from_stack=old_stack, to_stack=to_stack,
                         width=self.qubit_count)
         update_residency()
+
+    def _escalate_giveup(self) -> bool:
+        """Exhausted drift replays (DispatchGiveUp out of the integrity
+        plane) on a quantized stack: climb the ladder to dense rather
+        than serving garbage.  The integrity envelope restored the
+        pre-window planes before raising and the fuser KEPT the window,
+        so reading the state under faults.suspended() re-runs the kept
+        gates onto a good base — the triggering call (disjoint from the
+        window by the fuser's admit-after-flush discipline) is then
+        replayed by the caller, preserving exactly-once.  Returns False
+        when no higher rung can hold this width."""
+        if (self.current_stack() not in _QUANT_STACKS
+                or self.qubit_count > _cost._TQ_BASE_CAP):
+            return False
+        from ..resilience import faults
+
+        with faults.suspended():
+            self._escalate("quant_drift", to_stack="dense")
+        return True
 
     def route_for(self, circuit):
         """Library-path admission (layers/qcircuit.py Run/RunFused):
@@ -314,6 +387,28 @@ class QRouted:
                     self._escalate("bdt_nodes")
                 elif _tele._ENABLED:
                     _tele.inc("route.misroute.unescalatable")
+        elif d.stack in _QUANT_STACKS:
+            # a resilient quantized session whose drift replays gave up
+            # already climbed the ladder inside the failover chain
+            # (resilience/failover.py rehydrates onto dense); that swap
+            # WAS the escalation — observe and re-label
+            from ..resilience.failover import ResilientEngine
+
+            inner = self._engine
+            if isinstance(inner, ResilientEngine):
+                inner = inner.engine
+            if getattr(inner, "_tq_bits", None) is None:
+                self._note_misroute("quant_drift")
+                self._decision = RouteDecision(
+                    stack="dense", layers=d.layers,
+                    reason="escalated:quant_drift")
+                self._escalated = True
+                if _tele._ENABLED:
+                    _tele.inc("route.misroute.escalated")
+                    _tele.event("route.escalate", reason="quant_drift",
+                                from_stack=d.stack, to_stack="dense",
+                                width=self.qubit_count)
+                update_residency()
 
     def note_job(self) -> None:
         if _tele._ENABLED:
@@ -349,7 +444,32 @@ class QRouted:
             self._build_default()
         if name in _PROBE_BEFORE:
             self.misroute_check()
-        return getattr(self._engine, name)
+        attr = getattr(self._engine, name)
+        d = self.__dict__.get("_decision")
+        if callable(attr) and d is not None and d.stack in _QUANT_STACKS:
+            return self._ladder_guard(name, attr)
+        return attr
+
+    def _ladder_guard(self, name, attr):
+        """Last-resort DispatchGiveUp net for quantized sessions whose
+        terminal is not resilient-wrapped (resilience armed after the
+        engine was built, so no ResilientEngine sits below to fail over
+        first): climb the ladder to dense and replay the triggering
+        call exactly once (disjoint from the kept window)."""
+        import functools
+
+        from ..resilience.errors import DispatchGiveUp
+
+        @functools.wraps(attr)
+        def call(*args, **kwargs):
+            try:
+                return attr(*args, **kwargs)
+            except DispatchGiveUp:
+                if not self._escalate_giveup():
+                    raise
+                return getattr(self._engine, name)(*args, **kwargs)
+
+        return call
 
     def __repr__(self) -> str:
         stack = self.current_stack() or "unrouted"
@@ -387,7 +507,8 @@ class QRouted:
             from ..factory import create_quantum_interface
 
             self._engine = create_quantum_interface(
-                layers, self.qubit_count, rng=self.rng, **self._kwargs)
+                layers, self.qubit_count, rng=self.rng,
+                **self._kwargs_for(stack))
         self._decision = (RouteDecision(stack=stack, layers=layers,
                                         reason=meta.get("reason")
                                         or "restored")

@@ -34,6 +34,12 @@ Env knobs:
   (default 0 = off; the service only constructs a verifier when > 0).
 * ``QRACK_SERVE_CANARY_TOL`` — fidelity shortfall treated as a
   mismatch (default 1e-6).
+* ``QRACK_SERVE_CANARY_TOL_QUANT`` — the looser shortfall used when
+  the session runs on a QUANTIZED (turboquant) engine (default 1e-3):
+  requantization error is legitimate fidelity loss, not corruption.
+  Quantized sessions wider than the dense cap cannot materialize a
+  full ket at all — those samples are skipped and counted
+  (``integrity.canary.skipped``) rather than failed.
 """
 
 from __future__ import annotations
@@ -72,6 +78,11 @@ class CanaryVerifier:
             except ValueError:
                 tol = 1e-6
         self.tol = tol
+        try:
+            self.tol_quant = float(os.environ.get(
+                "QRACK_SERVE_CANARY_TOL_QUANT", "") or 1e-3)
+        except ValueError:
+            self.tol_quant = 1e-3
         # deterministic sampling: every k-th circuit job, not a coin
         # flip — a soak at rate r sees exactly the expected coverage
         self._every = max(1, round(1.0 / self.rate)) if self.rate else 0
@@ -101,6 +112,14 @@ class CanaryVerifier:
             with _faults.suspended():
                 pre = np.asarray(sess.engine.GetQuantumState())
                 devs = self._device_ids(sess.engine)
+        except MemoryError:
+            # quantized session past the dense cap: a full ket cannot
+            # exist, so the oracle replay has nothing to compare — skip
+            # the sample, don't fail it (the chunk-mass fingerprint in
+            # resilience/integrity.py still guards these widths)
+            if _tele._ENABLED:
+                _tele.inc("integrity.canary.skipped")
+            return
         except Exception:  # noqa: BLE001 — sampling must never fail a job
             if _tele._ENABLED:
                 _tele.inc("integrity.canary.capture_failed")
@@ -120,13 +139,22 @@ class CanaryVerifier:
 
             with _faults.suspended():
                 post = np.asarray(sess.engine.GetQuantumState())
+        except MemoryError:
+            if _tele._ENABLED:
+                _tele.inc("integrity.canary.skipped")
+            return
         except Exception:  # noqa: BLE001
             if _tele._ENABLED:
                 _tele.inc("integrity.canary.capture_failed")
             return
+        # quantized sessions are judged against the looser tolerance:
+        # the served state carries requantization error by design
+        tol = (self.tol_quant
+               if getattr(sess.engine, "_tq_bits", None) is not None
+               else self.tol)
         try:
             self._q.put_nowait((sess.sid, sess.width, job.circuit,
-                                pre, post, devs))
+                                pre, post, devs, tol))
         except queue.Full:
             if _tele._ENABLED:
                 _tele.inc("integrity.canary.dropped")
@@ -169,7 +197,8 @@ class CanaryVerifier:
                 if _tele._ENABLED:
                     _tele.inc("integrity.canary.errors")
 
-    def _verify(self, sid, width, circuit, pre, post, devs) -> None:
+    def _verify(self, sid, width, circuit, pre, post, devs,
+                tol: Optional[float] = None) -> None:
         from ..engines.cpu import QEngineCPU
 
         oracle = QEngineCPU(width)
@@ -177,7 +206,7 @@ class CanaryVerifier:
         circuit.Run(oracle)
         fid = _fidelity(np.asarray(oracle.GetQuantumState()), post)
         self.checked += 1
-        if fid < 1.0 - self.tol:
+        if fid < 1.0 - (self.tol if tol is None else tol):
             self.mismatches += 1
             if _tele._ENABLED:
                 _tele.event("integrity.canary.mismatch", sid=sid,

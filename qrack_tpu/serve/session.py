@@ -55,6 +55,11 @@ def planes_engine(engine):
             engine = engine._engine
             continue
         break
+    if getattr(engine, "_tq_bits", None) is not None:
+        # QEngineTurboQuant IS-A QEngineTPU but its ket is codes+scales,
+        # not stackable (2, 2^n) planes — quantized sessions run as
+        # singleton jobs
+        return None
     return engine if isinstance(engine, QEngineTPU) else None
 
 

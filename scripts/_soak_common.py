@@ -41,7 +41,8 @@ if _TESTS not in sys.path:
     sys.path.insert(0, _TESTS)
 from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
 
-__all__ = ["REPO", "N", "_ops", "STACKS", "fidelity", "submit_retry",
+__all__ = ["REPO", "N", "_ops", "STACKS", "ROUTED_TQ_LANE",
+           "ROUTED_TQ_FLOOR", "routed_tq_env", "fidelity", "submit_retry",
            "resilience_up", "resilience_down", "soak_main"]
 
 # stacks that exercise each guarded dispatch family; the second pager
@@ -56,6 +57,24 @@ STACKS = [
     ("pager", {"n_pages": 4, "remap": "on", "dcn_bits": 1}),
     ("hybrid", {"tpu_threshold_qubits": 3}),
 ]
+
+
+# the routed precision ladder's compressed rung: QRACK_ROUTE pins the
+# router onto turboquant (multi-chunk 16-bit geometry) so the chunk-
+# mass fingerprint, quantized window replay, and the drift-giveup ->
+# dense escalation all soak under injected corruption
+# (integrity_soak.py consumes this lane).  The fidelity verdict uses
+# the quantized floor — 16-bit requantization is legitimate loss.
+ROUTED_TQ_LANE = ("route", {"bits": 16, "chunk_qb": 3, "block_pow": 2})
+ROUTED_TQ_FLOOR = 1 - 1e-5
+
+
+def routed_tq_env(on: bool = True) -> None:
+    """Pin (or release) the router to the compressed rung for a trial."""
+    if on:
+        os.environ["QRACK_ROUTE"] = "turboquant"
+    else:
+        os.environ.pop("QRACK_ROUTE", None)
 
 
 def fidelity(a, b) -> float:

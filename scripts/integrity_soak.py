@@ -17,6 +17,24 @@ site that actually carries state commits for the trial's
                                    targets through remap prologues, so
                                    the pair-exchange site only carries
                                    commits with the planner off)
+    route @ window 1  -> turboquant.dispatch (the forced window-1 fuser
+                         flushes each gate through the per-gate chunk
+                         programs inside the guarded envelope)
+    route @ window 16 -> tpu.fuse.flush  (single-pass fused window)
+
+The ``route`` lane (the _soak_common.ROUTED_TQ_LANE rung of the
+precision ladder) pins QRACK_ROUTE=turboquant so the quantized chunk-
+mass fingerprint, scoped window replay on codes+scales, and the
+quant-drift giveup -> dense escalation all soak under corruption.  Two
+lane-specific rules: (a) non-diagonal targets are capped at the chunk
+axis — cross-chunk pair mixers dispatch eagerly OUTSIDE the guarded
+flush (the compressed analogue of the structural-op exclusion above);
+(b) a prep phase spreads mass into every block row before arming,
+because an amp-corrupt strike on an EMPTY block's scale multiplies
+zero codes — invisible to the mass fingerprint AND to the state, which
+would flake the fired=>violation criterion.  The lane's fidelity floor
+is the quantized ROUTED_TQ_FLOOR: 16-bit requantization is legitimate
+loss, not a mis-compute.
 
 The integrity guard plane (resilience/integrity.py) must then detect
 every fired corruption at the next flush verify, repair it by scoped
@@ -45,8 +63,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _soak_common import (N, fidelity, resilience_down,  # noqa: E402
-                          resilience_up, soak_main)
+from _soak_common import (N, ROUTED_TQ_FLOOR, ROUTED_TQ_LANE,  # noqa: E402
+                          fidelity, resilience_down, resilience_up,
+                          routed_tq_env, soak_main)
 
 import numpy as np  # noqa: E402
 
@@ -57,32 +76,49 @@ from qrack_tpu.resilience import integrity as integ  # noqa: E402
 from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
 STACKS = [("tpu", {}), ("pager", {"n_pages": 4, "remap": "off"}),
-          ("pager", {"n_pages": 4, "remap": "on"})]
+          ("pager", {"n_pages": 4, "remap": "on"}),
+          ROUTED_TQ_LANE]
 
 GATES1 = ("H", "X", "Y", "Z", "S", "T")
+_DIAG1 = ("Z", "S", "T")   # phase gates: window-admissible at ANY target
 ROTS = ("RX", "RY", "RZ")
 
 
-def _fusable_op(rng):
-    """One random op from the fusable vocabulary as (name, args)."""
+def _fusable_op(rng, ndt: int = N):
+    """One random op from the fusable vocabulary as (name, args).
+
+    ``ndt`` caps NON-DIAGONAL targets (default: no cap).  The routed-
+    turboquant lane passes its chunk axis: phase gates fuse at any
+    target, but mixing gates at or above the chunk boundary take the
+    eager cross-chunk pair path outside the guarded-flush envelope."""
     q = lambda: int(rng.integers(0, N))
+    qn = lambda: int(rng.integers(0, ndt))
     r = float(rng.random())
     if r < 0.5:
         g = GATES1[int(rng.integers(0, len(GATES1)))]
-        return g, (q(),)
+        return g, ((q() if g in _DIAG1 else qn()),)
     if r < 0.75:
         g = ROTS[int(rng.integers(0, len(ROTS)))]
-        return g, (float(rng.uniform(0, 2 * np.pi)), q())
-    a = q()
-    b = (a + 1 + int(rng.integers(0, N - 1))) % N
+        return g, (float(rng.uniform(0, 2 * np.pi)),
+                   q() if g == "RZ" else qn())
     if r < 0.95:
-        return ("CNOT" if rng.integers(0, 2) else "CZ"), (a, b)
-    return "CCNOT", (0, 1, 2 + int(rng.integers(0, N - 2)))
+        if rng.integers(0, 2):
+            t = qn()
+            c = (t + 1 + int(rng.integers(0, N - 1))) % N
+            return "CNOT", (c, t)
+        a = q()
+        b = (a + 1 + int(rng.integers(0, N - 1))) % N
+        return "CZ", (a, b)
+    return "CCNOT", (0, 1, 2 + int(rng.integers(0, max(1, min(N, ndt) - 2))))
 
 
 def _site_for(stack_name: str, kw: dict, window: int) -> str:
     if stack_name == "tpu":
         return "tpu.compile" if window == 1 else "tpu.fuse.flush"
+    if stack_name == "route":
+        # window 1: the forced fuser flushes single-op windows through
+        # the per-gate chunk programs; window 16: single-pass window
+        return "turboquant.dispatch" if window == 1 else "tpu.fuse.flush"
     if window == 1 and kw.get("remap") == "off":
         return "pager.exchange"  # per-gate pair exchanges still dispatch
     # the placement planner turns hot paged targets into remapped
@@ -93,7 +129,13 @@ def _site_for(stack_name: str, kw: dict, window: int) -> str:
 def run_trial(trial: int, seed: int) -> dict:
     rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
     stack_name, kw = STACKS[trial % len(STACKS)]
-    window = 1 if (trial // 2) % 2 else 16
+    routed = stack_name == "route"
+    # non-diagonal targets stay on the guarded surface (module doc)
+    ndt = min(kw["chunk_qb"], N) if routed else N
+    # alternate windows per stack CYCLE, not per trial pair: with the
+    # stack list at length 4 a (trial // 2) % 2 window would sync with
+    # the stack index and pin every lane to a single window forever
+    window = 1 if (trial // len(STACKS)) % 2 else 16
     site = _site_for(stack_name, kw, window)
     # window-16 merging can collapse a 24-gate trial to a SINGLE
     # matching dispatch, so any after_n > 0 risks a trial where nothing
@@ -109,6 +151,8 @@ def run_trial(trial: int, seed: int) -> dict:
             "times": times, "page": page}
 
     os.environ["QRACK_TPU_FUSE_WINDOW"] = str(window)
+    if routed:
+        routed_tq_env(True)
     resilience_up()
     tele.enable()
     tele.reset()
@@ -119,6 +163,15 @@ def run_trial(trial: int, seed: int) -> dict:
         o = QEngineCPU(N, rng=QrackRandom(trial), rand_global_phase=False)
         s = create_quantum_interface(stack_name, N, rng=QrackRandom(trial),
                                      rand_global_phase=False, **kw)
+        if routed:
+            # prep BEFORE arming: mass into every block row, drained
+            # clean, so no strike can land on an all-zero scale
+            for t in range(N):
+                for e in (o, s):
+                    e.H(t)
+                    e.RZ(0.37 * (t + 1), t)
+            _ = o.Prob(0)
+            _ = s.Prob(0)
         # NO seed: seeded specs coin-flip on every eligible call
         # (faults.should_fire), and a window-16 trial can merge into a
         # single matching dispatch — a tails coin would mean nothing
@@ -129,7 +182,7 @@ def run_trial(trial: int, seed: int) -> dict:
                           times=times,
                           page=page, n_pages=4 if page is not None else None)
         for _ in range(24):
-            name, args = _fusable_op(rng)
+            name, args = _fusable_op(rng, ndt)
             getattr(o, name)(*args)
             getattr(s, name)(*args)
         # drain the fuser OUTSIDE suspension so a pending spec still
@@ -149,15 +202,21 @@ def run_trial(trial: int, seed: int) -> dict:
         info["strikes"] = {str(k): v for k, v in integ.strikes().items()}
         info["quarantined"] = sorted(integ.quarantined())
         info["fidelity"] = f
+        if routed:
+            info["built"] = s.current_stack()
+            info["escalated"] = bool(getattr(s, "_escalated", False))
         # zero silent mis-computes: equivalence alone is not enough —
         # every fired corruption must have been SEEN by an invariant
-        info["ok"] = bool(f > 1 - 1e-6
+        floor = ROUTED_TQ_FLOOR if routed else 1 - 1e-6
+        info["ok"] = bool(f > floor
                           and (fired == 0 or info["violations"] >= 1))
     except Exception as e:  # noqa: BLE001 — a soak records, never dies
         info["ok"] = False
         info["error"] = f"{type(e).__name__}: {e}"
     finally:
         os.environ.pop("QRACK_TPU_FUSE_WINDOW", None)
+        if routed:
+            routed_tq_env(False)
         resilience_down()
         integ.reset()
         tele.disable()

@@ -25,6 +25,11 @@ sum; spans merge).  Sections:
   * routing: decisions and executed jobs per stack with per-stack hit
     rates, mis-routes and escalations, live residency gauges
     (route.residency.<stack>) — docs/ROUTING.md
+  * compression: the routable TurboQuant tier — resident codes+scales
+    bytes vs the f32 dense equivalent (compression_ratio), counted
+    decompress/recompress sweeps vs the single-pass fused-window
+    savings (sweeps_saved_share, ops_per_window), and drift replay
+    repairs vs giveups on the quantized rung — docs/PERFORMANCE.md
   * checkpoint: save/restore counts + bytes, spill-store footprint,
     warm-start programs recorded/prewarmed, recovery-lease traffic
   * elasticity: repage shrink/expand traffic, failed expansions,
@@ -142,6 +147,7 @@ def report(snap: dict, top: int) -> dict:
         "remap": {},
         "serve": {},
         "route": {},
+        "compression": {},
         "checkpoint": {},
         "elastic": {},
         "integrity": {},
@@ -247,6 +253,33 @@ def report(snap: dict, top: int) -> dict:
             stack = k[len("route.jobs."):]
             out["route"][f"hit_rate.{stack}"] = round(
                 out["route"][k] / routed_jobs, 4)
+    # compression: the TurboQuant tier's footprint and sweep economics —
+    # resident codes+scales vs the f32 dense equivalent, how many
+    # decompress/recompress passes the single-pass windows avoided, and
+    # whether drift replays had to repair (or give up on) the rung
+    comp = {k: v for k, v in counters.items() if k.startswith("tq.")}
+    gauges = snap.get("gauges", {})
+    res_b = gauges.get("tq.resident.bytes", 0)
+    dense_b = gauges.get("tq.resident.dense_equiv_bytes", 0)
+    if res_b:
+        comp["tq.resident.bytes"] = res_b
+        comp["tq.resident.dense_equiv_bytes"] = dense_b
+        if dense_b:
+            comp["compression_ratio"] = round(dense_b / res_b, 3)
+    saved = counters.get("fuse.tq.sweeps_saved", 0)
+    sweeps = comp.get("tq.sweeps", 0)
+    if sweeps or saved:
+        comp["fuse.tq.sweeps_saved"] = saved
+        comp["sweeps_saved_share"] = round(saved / max(sweeps + saved, 1), 4)
+    windows = counters.get("fuse.tq.windows", 0)
+    if windows:
+        comp["ops_per_window"] = round(
+            counters.get("fuse.tq.ops", 0) / windows, 3)
+    if comp:
+        for k in ("integrity.replay.repaired", "integrity.replay.giveup"):
+            if counters.get(k):
+                comp[k] = counters[k]
+    out["compression"] = comp
     return out
 
 
@@ -300,6 +333,16 @@ def main(argv=None) -> int:
         print("== routing ==")
         for name, v in sorted(rep["route"].items()):
             print(f"  {name:<40s} {v:>12.3f}")
+    if rep["compression"]:
+        print("== compression ==")
+        for name, v in sorted(rep["compression"].items()):
+            if name.endswith("bytes"):
+                shown = _fmt_bytes(v)
+            elif float(v).is_integer():
+                shown = f"{v:.0f}"
+            else:
+                shown = f"{v:.4f}"
+            print(f"  {name:<40s} {shown:>12s}")
     if rep["checkpoint"]:
         print("== checkpoint ==")
         for name, v in sorted(rep["checkpoint"].items()):

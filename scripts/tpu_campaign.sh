@@ -209,6 +209,15 @@ run_stage turboquant_w28 600 python scripts/turboquant_bench.py 28 8 4 3
 run_stage turboquant_w28_pallas 600 env QRACK_USE_PALLAS=1 \
   python scripts/turboquant_bench.py 28 8 4 3
 run_stage turboquant_w31 600 python scripts/turboquant_bench.py 31 8 2 3
+# single-pass fused-window A/B (per-gate vs window-16 sweep counts +
+# devget walls) and the routed ladder at w30: a dense-shaped QFT must
+# route onto the compressed rung via the memory-axis cost model and
+# finish with chunk-mass drift inside the integrity budget
+run_stage tq_fuse_ab_w28 700 python scripts/turboquant_bench.py \
+  --fuse-ab 28 8 32 3
+run_stage tq_routed_w30 900 python scripts/turboquant_bench.py --routed 30 8
+run_stage tq_routed_w30_pallas 900 env QRACK_USE_PALLAS=1 \
+  python scripts/turboquant_bench.py --routed 30 8
 run_stage qft_w30 620 env QRACK_BENCH=qft QRACK_BENCH_QB=30 \
   QRACK_BENCH_QB_FIRST=30 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
   QRACK_BENCH_BUDGET=580 python bench.py

@@ -123,8 +123,9 @@ def _qv(n):
     (lambda n: trotter_qcircuit(n, steps=2), 16, "dense"),
     # wide + weakly entangled: the tree's bond bound finally pays
     (lambda n: trotter_qcircuit(n, steps=1), 24, "bdt"),
-    # wide + general: the tree is the only runnable representation
-    (qft_qcircuit, 30, "bdt"),
+    # wide + general + fully entangled: past the dense cap the
+    # compressed dense-equivalent tier wins over the host-side tree
+    (qft_qcircuit, 30, "turboquant"),
 ], ids=["ghz100", "ghz20", "qft22", "qv12", "qaoa12", "trotter16",
         "trotter24", "qft30"])
 def test_decide_matrix(make, width, stack, monkeypatch):
@@ -145,17 +146,27 @@ def test_clifford_guard_rail_beats_heuristics(monkeypatch):
     assert scores["stabilizer"] != INFEASIBLE
 
 
-def test_scores_wide_general_circuit_falls_to_bdt():
+def test_scores_wide_general_circuit_falls_to_turboquant():
     # a w30 QFT entangles all 30 qubits with general payloads: dense
     # (width), stabilizer (general), and qunit (block=width) are all
-    # infeasible — the tree is the only runnable representation left
+    # infeasible — the compressed tier takes it over the host-side tree
     f = extract_features(qft_qcircuit(30), 30)
     scores = score_stacks(f, RouteKnobs())
     assert scores["dense"] == INFEASIBLE
     assert scores["stabilizer"] == INFEASIBLE
     assert scores["qunit"] == INFEASIBLE
+    assert scores["turboquant"] != INFEASIBLE
+    assert scores["turboquant"] < scores["bdt"]
     stack, _ = choose_stack(f, RouteKnobs(), mode="auto")
-    assert stack == "bdt"
+    assert stack == "turboquant"
+    # past the compressed cap too (w40), the tree is the only stack left
+    f40 = extract_features(qft_qcircuit(8), 40)
+    f40.width = 40
+    f40.max_component = 40
+    scores40 = score_stacks(f40, RouteKnobs())
+    assert scores40["turboquant"] == INFEASIBLE
+    stack40, _ = choose_stack(f40, RouteKnobs(), mode="auto")
+    assert stack40 == "bdt"
 
 
 def test_route_env_pins_every_decision(monkeypatch):
@@ -326,10 +337,31 @@ def test_misroute_escalates_to_dense_exactly_once(telemetry):
     assert snap["gauges"].get("route.residency.stabilizer", 0) == 0
 
 
-def test_misroute_past_dense_cap_is_refused(telemetry):
-    # w30 > dense cap (26): the general circuit is refused at plan time
-    # with the typed error and the stabilizer state survives untouched
+def test_misroute_past_dense_cap_plans_compressed_rung(telemetry):
+    # w30 > dense cap (26) but within the compressed tier's cap: the
+    # general circuit is no longer refused — the plan records the
+    # turboquant rung of the ladder (realized lazily by apply_plan, so
+    # the stabilizer state is untouched here)
     n = 30
+    r = create_quantum_interface("route", n, rng=QrackRandom(1),
+                                 rand_global_phase=False)
+    ghz_qcircuit(n).Run(r)
+    assert r.current_stack() == "stabilizer"
+    hard = QCircuit()
+    hard.append_1q(0, mat.u3_mtrx(0.3, 0.1, 0.2))
+    d = r.plan(hard)
+    assert d.stack == "turboquant"
+    assert d.reason == "misroute:planned"
+    assert r.current_stack() == "stabilizer"
+    amp = complex(r.GetAmplitude(0))
+    assert abs(abs(amp) - 1 / np.sqrt(2)) < 1e-9
+
+
+def test_misroute_past_every_rung_is_refused(telemetry):
+    # w40 exceeds the dense cap AND the compressed tier's width cap:
+    # refused at plan time with the typed error and the stabilizer
+    # state survives untouched
+    n = 40
     r = create_quantum_interface("route", n, rng=QrackRandom(1),
                                  rand_global_phase=False)
     ghz_qcircuit(n).Run(r)
