@@ -424,14 +424,27 @@ def _passes(width: int) -> int:
     return 2 * width
 
 
-def _implied_hbm(width: int, avg_s: float) -> float:
-    """Implied HBM throughput in GB/s: each pass reads + writes both
-    (2^w float32/bf16) planes.  v5e peak is ~819 GB/s — a wildly
-    higher implied number means the wall-clock did NOT capture real
-    execution (see scripts/tpu_timing_probe.py)."""
-    esize = 2 if DTYPE == "bfloat16" else 4
-    bytes_moved = _passes(width) * 2 * (1 << width) * esize * 2
-    return bytes_moved / max(avg_s, 1e-12) / 1e9
+def _ledger():
+    """The shared roofline ledger + sentinel (one implied-bandwidth
+    formula, one peak table — qrack_tpu/telemetry/sentinel.py)."""
+    from qrack_tpu.telemetry import roofline, sentinel
+
+    return roofline, sentinel
+
+
+_TRAJ: dict | None = None
+
+
+def _trajectory() -> dict:
+    global _TRAJ
+    if _TRAJ is None:
+        try:
+            _, sentinel = _ledger()
+            _TRAJ = sentinel.load_trajectory(HERE)
+        except Exception as exc:  # sentinel must never kill the bench
+            print(f"sentinel trajectory load failed: {exc!r}", file=sys.stderr)
+            _TRAJ = {}
+    return _TRAJ
 
 
 def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
@@ -458,24 +471,40 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
     if base_src:
         line["baseline_source"] = base_src
     if WORKLOAD != "qft_unit":
+        roofline, _ = _ledger()
+        esize = 2 if DTYPE == "bfloat16" else 4
         sweeps = stats.get("hbm_sweeps_per_window")
         if sweeps is not None:
             # fused-window line: the program's real pass count is known
             # (kernel plan or op chain), so both the ratio and the
             # implied bandwidth use it instead of the 2w stage estimate
             line["hbm_sweeps_per_window"] = sweeps
-            esize = 2 if DTYPE == "bfloat16" else 4
-            ghbm = (sweeps * 2 * (1 << width) * esize * 2
-                    / max(stats["avg"], 1e-12) / 1e9)
+            passes = sweeps
         else:
-            ghbm = _implied_hbm(width, stats["avg"])
-        line["implied_hbm_gbps"] = round(ghbm, 1)
+            passes = _passes(width)
         # dense simulation is bandwidth-bound (2-4 flops/byte), so the
-        # roofline fraction IS the MFU analogue: fraction of the v5e's
-        # ~819 GB/s HBM peak the fused program sustains
-        line["hbm_roofline_frac"] = round(ghbm / 819.0, 4)
-        if ghbm > 1600.0:  # ~2x v5e peak: physically impossible
+        # roofline fraction IS the MFU analogue: fraction of the device
+        # class's HBM peak (v5e ~819 GB/s) the program sustains
+        sample = roofline.record(
+            f"bench.{_workload_key()}",
+            passes * roofline.plane_pass_bytes(width, esize),
+            stats["avg"], width=width, platform=stats.get("platform"))
+        line["implied_hbm_gbps"] = sample["implied_hbm_gbps"]
+        line["hbm_roofline_frac"] = sample["hbm_roofline_frac"]
+        line["hbm_peak_gbps"] = sample["hbm_peak_gbps"]
+        if sample["clamped"]:
+            # implied bandwidth above the device-class peak: the wall
+            # did NOT capture real execution (relay-ack signature) —
+            # flagged so replay/evidence filters drop it
             line["suspect_timing"] = True
+            line["roofline_clamped"] = True
+    try:
+        roofline, sentinel = _ledger()
+        line["device_class"] = roofline.device_class(
+            platform_hint=(stats.get("platform") or None))
+        roofline.note_verdict(sentinel.stamp(line, _trajectory()))
+    except Exception as exc:  # sentinel must never kill the bench
+        print(f"sentinel stamp failed: {exc!r}", file=sys.stderr)
     try:
         from qrack_tpu import telemetry as _tele
 
@@ -586,6 +615,10 @@ def _replay_committed_evidence() -> bool:
     d["source"] = "scripts/tpu_campaign.sh healthy-window run (committed)"
     d["measured_at"] = d.pop("ts", "unknown")
     d.pop("stage", None)
+    # replays are committed evidence, not fresh measurements — the
+    # sentinel verdict says so at a glance
+    d["sentinel"] = "replay"
+    d["fresh"] = False
     print(json.dumps(d), flush=True)
     return True
 

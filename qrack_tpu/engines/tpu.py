@@ -356,12 +356,15 @@ class QEngineTPU(QEngine):
                 n, structure, self.dtype, interpret=plan["interpret"],
                 block_pow=plan["block_pow"])
             self._state = prog(self._state, *operands)
-            fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"])
+            fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"],
+                                   width=n,
+                                   esize=jnp.dtype(self.dtype).itemsize)
             return 1
         fu.record_kernel_fallback(why)
         prog = fu.dense_window_program(n, structure, self.dtype)
         self._state = prog(self._state, *operands)
-        fu.record_xla_flush(self._tele_name, len(ops))
+        fu.record_xla_flush(self._tele_name, len(ops), width=n,
+                            esize=jnp.dtype(self.dtype).itemsize)
         return 1
 
     def _k_apply_2x2(self, m2, target, controls, perm) -> None:

@@ -58,6 +58,7 @@ from ..ops import gatekernels as gk
 from ..storage import turboquant as tq
 from .. import matrices as mat
 from .. import telemetry as _tele
+from ..telemetry import roofline as _roofline
 from .tpu import QEngineTPU
 
 
@@ -549,9 +550,17 @@ class QEngineTurboQuant(QEngineTPU):
     def _note_sweeps(self, n: int = 2) -> None:
         """Counted decompress/recompress passes over the resident codes
         (one of each per dispatched program) — the denominator of the
-        single-pass fused-window win."""
+        single-pass fused-window win.  Each pass reads or writes the
+        full compressed residency, so the planned bytes also enter the
+        roofline ledger (`roofline.tq.sweep.*`) — raw arrays again, the
+        public properties would flush the fuser from bookkeeping."""
         if _tele._ENABLED:
             _tele.inc("tq.sweeps", n)
+            codes = getattr(self, "_codes_raw", None)
+            if codes is not None:
+                _roofline.note_bytes(
+                    "tq.sweep",
+                    float(n) * (codes.nbytes + self._scales_raw.nbytes))
 
     def _decompress_planes(self):
         rows = _j_dec_rows(self._codes, self._scales, self._rot_t, self._qmax)

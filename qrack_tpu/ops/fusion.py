@@ -59,6 +59,7 @@ import jax.numpy as jnp
 
 from .. import matrices as mat
 from .. import telemetry as _tele
+from ..telemetry import roofline as _roofline
 from .. import resilience as _res
 from ..utils.bits import control_offset
 from . import gatekernels as gk
@@ -312,22 +313,34 @@ def kernel_window_program(n: int, structure: Tuple, dtype,
     return PROGRAMS.get_or_build(key, build)
 
 
-def record_kernel_flush(name: str, nops: int, sweeps: int) -> None:
+def record_kernel_flush(name: str, nops: int, sweeps: int,
+                        width=None, esize: int = 4) -> None:
     """A window flushed through the Pallas kernel: count it and the HBM
-    sweeps it actually paid (telemetry_report derives sweeps/window)."""
+    sweeps it actually paid (telemetry_report derives sweeps/window).
+    Callers that supply the plane width also feed the sweep's planned
+    bytes into the roofline ledger (`roofline.tpu.fuse.flush.*`)."""
     if _tele._ENABLED:
         _tele.inc("fuse.kernel.windows")
         _tele.inc("fuse.kernel.ops", nops)
         _tele.inc("fuse.kernel.sweeps", sweeps)
+        if width is not None:
+            _roofline.note_bytes(
+                "tpu.fuse.flush",
+                sweeps * _roofline.plane_pass_bytes(width, esize))
 
 
-def record_xla_flush(name: str, nops: int) -> None:
+def record_xla_flush(name: str, nops: int,
+                     width=None, esize: int = 4) -> None:
     """A multi-op window flushed through the XLA op chain (~one sweep
     per op)."""
     if _tele._ENABLED:
         _tele.inc("fuse.xla.windows")
         _tele.inc("fuse.xla.ops", nops)
         _tele.inc("fuse.xla.sweeps", nops)
+        if width is not None:
+            _roofline.note_bytes(
+                "tpu.fuse.flush",
+                nops * _roofline.plane_pass_bytes(width, esize))
 
 
 def record_kernel_fallback(reason: str) -> None:

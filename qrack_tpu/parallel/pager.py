@@ -52,6 +52,7 @@ from .. import matrices as mat
 # ---------------------------------------------------------------------------
 
 from .. import telemetry as _tele
+from ..telemetry import roofline as _roofline
 from .. import resilience as _res
 
 _PROGRAMS = _tele.ProgramCache(
@@ -437,9 +438,13 @@ class QPager(QEngine):
 
     def _tele_exchange(self, op: str, nbytes: float) -> None:
         """Count one ICI exchange dispatch and its payload bytes
-        (host-side accounting of what the collective moves)."""
+        (host-side accounting of what the collective moves).  The same
+        bytes enter the roofline ledger as `roofline.pager.exchange.*`,
+        so the ledger's exchange accounting is the collective byte math
+        by construction."""
         _tele.inc(f"exchange.pager.{op}")
         _tele.inc("exchange.pager.bytes", nbytes)
+        _roofline.note_bytes("pager.exchange", nbytes)
 
     def _p_local_2x2(self, target):
         from ..ops import sharded as shb
@@ -758,10 +763,12 @@ class QPager(QEngine):
         self._state = prog(self._state, *operands)
         self._map_assign(new_qmap)
         if plan is not None:
-            fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"])
+            fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"],
+                                   width=self.qubit_count)
         else:
             fu.record_kernel_fallback(why)
-            fu.record_xla_flush(self._tele_name, len(ops))
+            fu.record_xla_flush(self._tele_name, len(ops),
+                                width=self.qubit_count)
         return 1
 
     def _k_apply_4x4(self, m4, q1, q2) -> None:

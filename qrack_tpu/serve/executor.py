@@ -53,6 +53,7 @@ import time
 from typing import List, Optional
 
 from .. import telemetry as _tele
+from ..telemetry import roofline as _roofline
 from ..resilience.errors import FAILOVER_ERRORS
 from . import batcher as _batcher
 from .errors import QueueBudgetExceeded
@@ -454,6 +455,23 @@ class Executor:
             _tele.observe("serve.overlap.sync_wait", now - t_sync)
             _tele.record_span("serve.stage.sync", t_sync, now - t_sync,
                               trace=inf.jobs[0].trace)
+            if self.sync:
+                # devget-honest wall for the whole dispatch; planned
+                # bytes use the naive per-gate model (one plane pass per
+                # gate per job — see docs/PERFORMANCE.md roofline
+                # methodology), so the fraction is a floor
+                try:
+                    n = int(getattr(inf.engines[0], "qubit_count", 0))
+                    gates = sum(len(getattr(j.circuit, "gates", ()) or ())
+                                for j in inf.jobs)
+                    esize = int(inf.pre_planes[0].dtype.itemsize)
+                    if n and gates:
+                        _roofline.record(
+                            "serve.dispatch",
+                            gates * _roofline.plane_pass_bytes(n, esize),
+                            now - inf.t0, width=n)
+                except Exception:  # bookkeeping must never strand a batch
+                    pass
         for job in inf.jobs:
             self._complete(job, None)
 

@@ -168,6 +168,11 @@ class QrackService:
             self.store = CheckpointStore(
                 checkpoint_dir, max_bytes=int(spill_max_mb * 1024 * 1024))
             enable_warm_start(os.path.join(checkpoint_dir, "xla_cache"))
+            # device-class fingerprint lands next to xla_cache — the
+            # substrate the roofline ledger (and the future autotuner)
+            # reads when no live backend is probeable
+            from ..telemetry import roofline as _roofline
+            _roofline.persist_fingerprint(checkpoint_dir)
             self.program_manifest = ProgramManifest(
                 os.path.join(checkpoint_dir, "programs"))
             _batcher_mod.set_manifest(self.program_manifest)

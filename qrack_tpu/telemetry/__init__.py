@@ -35,6 +35,15 @@ Three surfaces:
   :func:`xplane_bracket` (a ``jax.profiler`` trace bracket whose dumps
   ``scripts/analyze_xplane.py`` consumes).
 
+The hardware-truth profiling plane lives in two sibling modules:
+:mod:`~qrack_tpu.telemetry.roofline` (per-dispatch planned-bytes ledger,
+device-class fingerprints, the implied-bandwidth honesty clamp) and
+:mod:`~qrack_tpu.telemetry.sentinel` (stdlib-only shared formula, peak
+table, and the perf-regression sentinel over committed evidence) —
+import them explicitly (``from qrack_tpu.telemetry import roofline``);
+they are deliberately not re-exported here so this module stays
+importable without touching them.
+
 Compile-cache accounting comes from two helpers:
 :class:`ProgramCache`, the bounded-LRU replacement for the module-level
 ``_PROGRAMS`` dicts (parallel/pager.py, engines/turboquant.py), and

@@ -105,11 +105,20 @@ def chrome_trace() -> dict:
             "name": e["name"], "ph": "i", "cat": "event", "s": "p",
             "ts": ts, "pid": pid, "tid": 0, "args": args,
         })
-    for name, value in sorted(snapshot(include_events=False)["counters"].items()):
+    snap = snapshot(include_events=False)
+    for name, value in sorted(snap["counters"].items()):
         evs.append({
             "name": name, "ph": "C", "ts": end_us, "pid": pid, "tid": 0,
             "args": {"value": value},
         })
+    # roofline gauges ride as counter tracks too: achieved-vs-peak
+    # fractions next to the spans that produced them
+    for name, value in sorted(snap["gauges"].items()):
+        if name.startswith("roofline."):
+            evs.append({
+                "name": name, "ph": "C", "ts": end_us, "pid": pid,
+                "tid": 0, "args": {"value": value},
+            })
     return {"traceEvents": evs, "displayTimeUnit": "ms"}
 
 
@@ -127,13 +136,14 @@ def local_trace_source(name: Optional[str] = None) -> dict:
     """This process's trace rings as a merge source for
     :func:`merged_chrome_trace` (same shape as a flight-recorder black
     box: name/pid/epoch_unix_s/spans/events)."""
-    from . import _EPOCH_WALL, _EVENTS, _LOCK, _TRACE
+    from . import _EPOCH_WALL, _EVENTS, _GAUGES, _LOCK, _TRACE
 
     pid = os.getpid()
     with _LOCK:
         return {"name": name or f"pid{pid}", "pid": pid,
                 "epoch_unix_s": _EPOCH_WALL,
-                "spans": list(_TRACE), "events": list(_EVENTS)}
+                "spans": list(_TRACE), "events": list(_EVENTS),
+                "gauges": dict(_GAUGES)}
 
 
 def merged_chrome_trace(sources) -> dict:
@@ -174,6 +184,7 @@ def merged_chrome_trace(sources) -> dict:
                 "dur": t["dur_s"] * _US,
                 "pid": disp_pid, "tid": t.get("tid", 0), "args": args,
             })
+        src_end = 0.0
         for e in src.get("events") or []:
             args = {k: v for k, v in e.items() if k not in ("name", "t_s")}
             evs.append({
@@ -181,6 +192,19 @@ def merged_chrome_trace(sources) -> dict:
                 "ts": (epoch + e["t_s"] - t0) * _US,
                 "pid": disp_pid, "tid": 0, "args": args,
             })
+        for t in src.get("spans") or []:
+            src_end = max(src_end, (epoch + t["ts_s"] - t0 + t["dur_s"]))
+        for e in src.get("events") or []:
+            src_end = max(src_end, (epoch + e["t_s"] - t0))
+        # roofline gauges (live snapshots and flight-recorder black
+        # boxes both carry them) become per-source Perfetto counter
+        # tracks, sampled at that source's last instant
+        for gname, gval in sorted((src.get("gauges") or {}).items()):
+            if gname.startswith("roofline."):
+                evs.append({
+                    "name": gname, "ph": "C", "ts": src_end * _US,
+                    "pid": disp_pid, "tid": 0, "args": {"value": gval},
+                })
     return {"traceEvents": evs, "displayTimeUnit": "ms"}
 
 

@@ -33,7 +33,9 @@ def main() -> None:
     w = int(sys.argv[1]) if len(sys.argv) > 1 else 22
     chain = int(sys.argv[2]) if len(sys.argv) > 2 else 8
     samples = int(sys.argv[3]) if len(sys.argv) > 3 else 3
-    n_bytes_pass = 2 * (1 << w) * 4 * 2  # read+write both f32 planes
+    from qrack_tpu.telemetry import roofline
+
+    n_bytes_pass = roofline.plane_pass_bytes(w)  # read+write both f32 planes
 
     def g_h(p):
         return gk.apply_2x2(p, gk.mtrx_planes(np.asarray(mat.H2)), w, 3)
@@ -75,14 +77,22 @@ def main() -> None:
         times, planes = timing.time_chain(jfn, planes, chain, samples,
                                           sync_s)
         avg = sum(times) / len(times)
-        print(json.dumps({
+        sample = roofline.record("gate.kernel", n_bytes_pass, avg, width=w,
+                                 platform=jax.default_backend())
+        line = {
             "gate": name, "width": w, "wall_s": round(avg, 8),
             "min_s": round(min(times), 8),
             "std_s": round(statistics.pstdev(times), 8),
             "chain": chain, "samples": samples,
             "sync_overhead_s": round(sync_s, 8),
-            "implied_hbm_gbps": round(n_bytes_pass / max(avg, 1e-12) / 1e9, 1),
-        }), flush=True)
+            "implied_hbm_gbps": sample["implied_hbm_gbps"],
+            "hbm_roofline_frac": sample["hbm_roofline_frac"],
+            "device_class": sample["device_class"],
+        }
+        if sample["clamped"]:
+            line["suspect_timing"] = True
+            line["roofline_clamped"] = True
+        print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

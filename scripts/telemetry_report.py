@@ -148,6 +148,7 @@ def report(snap: dict, top: int) -> dict:
         "serve": {},
         "route": {},
         "compression": {},
+        "roofline": {},
         "checkpoint": {},
         "elastic": {},
         "integrity": {},
@@ -163,6 +164,8 @@ def report(snap: dict, top: int) -> dict:
     # quantiles the gauges publish, recomputed here so --all aggregation
     # (which merges hists) reports merged percentiles too
     for name, d in sorted((snap.get("hists") or {}).items()):
+        if name.startswith("roofline."):
+            continue  # GB/s distributions, not latencies — == roofline ==
         h = Histogram.from_dict(d)
         if not h.count:
             continue
@@ -195,6 +198,8 @@ def report(snap: dict, top: int) -> dict:
             out["integrity"][k] = v
         elif k.startswith("fleet."):
             out["fleet"][k] = v
+        elif k.startswith("roofline."):
+            out["roofline"][k] = v
         elif k.split(".")[0] in ("qunit", "qunitmulti", "stabilizer",
                                  "qbdt", "hybrid", "factory", "engine",
                                  "cluster", "resilience"):
@@ -280,6 +285,26 @@ def report(snap: dict, top: int) -> dict:
             if counters.get(k):
                 comp[k] = counters[k]
     out["compression"] = comp
+    # roofline: achieved bandwidth per guarded dispatch site — GB/s
+    # percentiles from the implied-bandwidth histograms (merged hists
+    # under --all/--fleet report merged percentiles, same as SLO),
+    # peak-fraction gauges, clamped-sample counts and sentinel verdicts
+    # (the roofline.* counters collected above)
+    for name, d in sorted((snap.get("hists") or {}).items()):
+        if not name.startswith("roofline."):
+            continue
+        h = Histogram.from_dict(d)
+        if not h.count:
+            continue
+        out["roofline"][name] = {
+            "count": h.count,
+            "p50_gbps": round(h.percentile(50), 2),
+            "p99_gbps": round(h.percentile(99), 2),
+            "max_gbps": round(h.max, 2),
+        }
+    for name, v in gauges.items():
+        if name.startswith("roofline.") and name not in out["roofline"]:
+            out["roofline"][name] = v
     return out
 
 
@@ -343,6 +368,19 @@ def main(argv=None) -> int:
             else:
                 shown = f"{v:.4f}"
             print(f"  {name:<40s} {shown:>12s}")
+    if rep["roofline"]:
+        print("== roofline ==")
+        for name, v in sorted(rep["roofline"].items()):
+            if isinstance(v, dict):
+                print(f"  {name:<48s} n={v['count']:<6d} "
+                      f"p50={v['p50_gbps']:.2f}GB/s "
+                      f"p99={v['p99_gbps']:.2f}GB/s "
+                      f"max={v['max_gbps']:.2f}GB/s")
+            elif name.endswith("bytes"):
+                print(f"  {name:<48s} {_fmt_bytes(v):>12s}")
+            else:
+                shown = f"{v:.0f}" if float(v).is_integer() else f"{v:.4f}"
+                print(f"  {name:<48s} {shown:>12s}")
     if rep["checkpoint"]:
         print("== checkpoint ==")
         for name, v in sorted(rep["checkpoint"].items()):
@@ -363,6 +401,8 @@ def main(argv=None) -> int:
     if rep["gauges"]:
         print("== gauges ==")
         for name, v in sorted(rep["gauges"].items()):
+            if name.startswith("roofline."):
+                continue  # shown in == roofline ==
             print(f"  {name:<40s} {v:>12.6g}")
     print("== layer events ==")
     for name, v in sorted(rep["layer_events"].items()):

@@ -52,25 +52,16 @@ commit_evidence() {
     || note "commit for $1: nothing new"
 }
 
-append_evidence() {  # stage_name stage_out_file
-  # stamp each bench JSON line with ts+stage and append to the committed
-  # evidence file (plain-python helper; PYTHONPATH stripped so the axon
-  # sitecustomize can never hang a bookkeeping step)
-  env -u PYTHONPATH "$PY" - "$1" "$2" >> "$EVID" <<'EOF'
-import json, sys
-from datetime import datetime, timezone
-name, out = sys.argv[1], sys.argv[2]
-ts = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
-for ln in open(out, errors="replace"):
-    ln = ln.strip()
-    if ln.startswith('{"metric"') or ln.startswith('{"gate"'):
-        try:
-            d = json.loads(ln)
-        except ValueError:
-            continue
-        d["ts"], d["stage"] = ts, name
-        print(json.dumps(d))
-EOF
+append_evidence() {  # stage_name stage_out_file -> rc 3 when clamped
+  # perf_sentinel stamps each evidence line with ts + stage + sentinel
+  # verdict (vs the committed trajectory) + device-class fingerprint,
+  # and DROPS any line whose implied bandwidth exceeds the device peak
+  # (relay-ack signature), exiting 3 so the stage is marked FAILED.
+  # Stdlib-only by construction (loads sentinel.py by file path);
+  # PYTHONPATH stripped so the axon sitecustomize can never hang a
+  # bookkeeping step.
+  env -u PYTHONPATH "$PY" scripts/perf_sentinel.py --stamp --stage "$1" "$2" \
+    >> "$EVID" 2>> "$LOG"
 }
 
 run_stage() {  # name timeout_s command...
@@ -86,6 +77,16 @@ run_stage() {  # name timeout_s command...
     grep -E '^\{"metric"|^\{"gate"|_OK$|^HONEST|^devget_empty|^chain|^one_apply|^total_prob|^k1_|^warm ok|passed|^THRESH|^GATE' "$out"
   } >> "$ELOG"
   append_evidence "$name" "$out"
+  local evrc=$?
+  if [ "$evrc" -eq 3 ]; then
+    # roofline honesty clamp: implied bandwidth above the device-class
+    # peak means the wall never captured real execution — the clamped
+    # lines were dropped from evidence and the stage FAILS outright
+    FAILS=$((FAILS + 1))
+    commit_evidence "$name (roofline honesty clamp, rc=$rc)"
+    note "stage $name FAILED roofline honesty clamp (rc=$rc, fails=$FAILS)"
+    return 1
+  fi
   # success = real evidence lines, or an all-green pytest stage (rc==0
   # guards against 'N failed, M passed' matching on the substring)
   if grep -qE '^\{"metric"|^\{"gate"|_OK$' "$out" \

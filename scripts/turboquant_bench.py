@@ -227,7 +227,14 @@ def main() -> None:
                             "fuse.xla.windows", "fuse.xla.sweeps")
                   if snap1.get(k, 0) != snap0.get(k, 0)}
         avg = sum(times) / len(times)
-        print(json.dumps({
+        # one formula, one peak table: the shared roofline ledger
+        # (decompress + recompress = 2 passes over the compressed
+        # residency per gate)
+        from qrack_tpu.telemetry import roofline
+
+        sample = roofline.record("tq.sweep", 2 * res_bytes, avg, width=w,
+                                 platform=jax.default_backend())
+        line = {
             "gate": name, "width": w, "bits": bits,
             "wall_s": round(avg, 8), "min_s": round(min(times), 8),
             "std_s": round(statistics.pstdev(times), 8),
@@ -235,13 +242,18 @@ def main() -> None:
             "sync_overhead_s": round(s0, 8),
             "resident_bytes": int(res_bytes),
             "n_chunks": eng._n_chunks(),
-            "implied_codes_gbps": round(
-                2 * res_bytes / max(avg, 1e-12) / 1e9, 1),
+            "implied_codes_gbps": sample["implied_hbm_gbps"],
+            "hbm_roofline_frac": sample["hbm_roofline_frac"],
+            "device_class": sample["device_class"],
             "platform": jax.default_backend(),
             "fuse_kernel": fu.kernel_mode(),
             "remap": fu.remap_mode(),
             "sweeps": sweeps,
-        }), flush=True)
+        }
+        if sample["clamped"]:
+            line["suspect_timing"] = True
+            line["roofline_clamped"] = True
+        print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":
