@@ -21,7 +21,8 @@ never runs a fleet pays zero import cost — and the worker subprocess
 only imports what it serves with.  See docs/FLEET.md.
 """
 
-from .frontdoor import FleetFrontDoor, SessionUnroutable
+from .autoscaler import Autoscaler, AutoscaleConfig
+from .frontdoor import AdoptionStalled, FleetFrontDoor, SessionUnroutable
 from .placement import NoHealthyWorkers, Placement, session_cost
 from .rpc import FleetClient, FleetRemoteError, FleetRPCError
 from .supervisor import FleetSupervisor
@@ -30,5 +31,6 @@ __all__ = [
     "FleetSupervisor", "FleetFrontDoor", "FleetClient",
     "Placement", "session_cost",
     "FleetRPCError", "FleetRemoteError", "SessionUnroutable",
-    "NoHealthyWorkers",
+    "AdoptionStalled", "NoHealthyWorkers",
+    "Autoscaler", "AutoscaleConfig",
 ]

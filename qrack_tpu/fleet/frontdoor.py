@@ -40,9 +40,15 @@ import uuid
 from typing import Optional
 
 from .. import telemetry as _tele
+from ..serve.errors import Overloaded
 from .rpc import FleetClient, FleetRemoteError, FleetRPCError
 
 DEFAULT_ROUTE_TIMEOUT_S = 120.0
+# a sid migrating longer than this with NO owner in placement is
+# stranded (its owner was permanently removed and re-placement failed),
+# not mid-adoption — surface the typed error instead of waiting out the
+# full routing timeout
+DEFAULT_MIGRATE_TIMEOUT_S = 30.0
 
 
 class SessionUnroutable(RuntimeError):
@@ -55,6 +61,24 @@ class SessionUnroutable(RuntimeError):
         self.sid = sid
 
 
+class AdoptionStalled(SessionUnroutable):
+    """The session has been in the migrating set past the migrate
+    deadline with no owner in placement — its worker was permanently
+    removed (scale-down, quarantine) and re-placement never landed, so
+    no amount of waiting routes it.  The session state is still durable
+    on the store; re-adoption (a worker coming back healthy) or an
+    operator decision resolves it, not this caller's patience."""
+
+    def __init__(self, sid: str, waited_s: float):
+        RuntimeError.__init__(
+            self,
+            f"session {sid!r}: migrating with no owner for "
+            f"{waited_s:.1f}s — owner permanently removed and "
+            "re-placement did not land (state remains durable on the "
+            "store)")
+        self.sid = sid
+
+
 def _session_not_found(e: FleetRemoteError) -> bool:
     """A worker-side typed refusal that means "not adopted HERE yet",
     not "gone": the fleet owns sid existence (placement), so a routed
@@ -64,9 +88,11 @@ def _session_not_found(e: FleetRemoteError) -> bool:
 
 class FleetFrontDoor:
     def __init__(self, supervisor,
-                 route_timeout_s: float = DEFAULT_ROUTE_TIMEOUT_S):
+                 route_timeout_s: float = DEFAULT_ROUTE_TIMEOUT_S,
+                 migrate_timeout_s: float = DEFAULT_MIGRATE_TIMEOUT_S):
         self.sup = supervisor
         self.route_timeout_s = route_timeout_s
+        self.migrate_timeout_s = migrate_timeout_s
 
     # -- routing core --------------------------------------------------
 
@@ -75,10 +101,33 @@ class FleetFrontDoor:
             c = self.sup.route(sid)
             if c is not None:
                 return c
+            self._check_stranded(sid)
             if time.monotonic() >= deadline:
                 raise SessionUnroutable(
                     sid, self.route_timeout_s)
             time.sleep(0.05)
+
+    def _check_stranded(self, sid: str) -> None:
+        """Bound the migrating wait: a sid migrating past the deadline
+        with NO owner in placement lost its worker permanently (scale-
+        down/quarantine emptied the fleet's re-placement options) — no
+        adoption is coming, so waiting out the full routing timeout
+        only delays the typed answer.  A migrating sid that HAS an
+        owner is mid-adoption; keep waiting."""
+        since = getattr(self.sup, "migrating_since", None)
+        if since is None:
+            return  # stub supervisors (tests) keep the legacy wait
+        t0 = since(sid)
+        if t0 is None:
+            return
+        waited = time.monotonic() - t0
+        if waited < self.migrate_timeout_s:
+            return
+        if self.sup.owner_of(sid) is not None:
+            return
+        if _tele._ENABLED:
+            _tele.inc("fleet.frontdoor.not_adopted_yet")
+        raise AdoptionStalled(sid, waited)
 
     def _retrying(self, sid: str, fn, timeout_s: Optional[float] = None):
         """Run `fn(client)` against the sid's live owner, re-routing on
@@ -156,10 +205,13 @@ class FleetFrontDoor:
     # -- circuit submission (exactly-once) -----------------------------
 
     def apply(self, sid: str, circuit,
-              timeout_s: Optional[float] = None) -> dict:
+              timeout_s: Optional[float] = None,
+              priority: int = 0) -> dict:
         """Apply `circuit` to `sid` exactly once, riding out worker
         death mid-submit.  Returns ``{"resubmits": n, "adopted": bool}``
-        describing how the effect landed.
+        describing how the effect landed.  ``priority`` is the job's
+        dispatch band AND its brownout shed band: under fleet overload
+        the ladder sheds low bands first (`_check_brownout`).
 
         The submit's fresh tag doubles as its distributed-trace id: it
         is already minted per submit, already rides the WAL entry, and
@@ -167,14 +219,17 @@ class FleetFrontDoor:
         ``frontdoor.apply`` span, the worker's journal/result spans and
         the executor's ``serve.execute`` span all correlate on one id
         in the merged fleet trace."""
+        self._check_brownout(priority)
         tag = uuid.uuid4().hex
         if not _tele._ENABLED:
-            return self._apply_loop(sid, circuit, tag, timeout_s)
+            return self._apply_loop(sid, circuit, tag, timeout_s,
+                                    priority)
         prev_trace = _tele.set_trace(tag)
         t0 = time.perf_counter()
         try:
             with _tele.span("frontdoor.apply"):
-                out = self._apply_loop(sid, circuit, tag, timeout_s)
+                out = self._apply_loop(sid, circuit, tag, timeout_s,
+                                       priority)
             # the tenant-observed submit wall (routing + RPC + queue +
             # execution + any mid-submit adoption) — the fleet-level
             # SLO distribution, vs the worker-local serve.latency
@@ -184,14 +239,40 @@ class FleetFrontDoor:
         finally:
             _tele.set_trace(prev_trace)
 
+    def _check_brownout(self, priority: int) -> None:
+        """The brownout ladder's front-door rungs, checked BEFORE any
+        routing or journaling so a refused job provably never executed
+        (retry-after is always safe): level 3 refuses all new work;
+        level 1+ sheds jobs at/below the shed band.  Jobs above the
+        band pass untouched — their only brownout effect is level 2's
+        quantized routing, applied worker-side."""
+        state = None
+        get = getattr(self.sup, "brownout", None)
+        if callable(get):
+            state = get()
+        if not state:
+            return
+        level = int(state.get("level") or 0)
+        retry_in_s = float(state.get("retry_in_s") or 0.5)
+        if level >= 3:
+            if _tele._ENABLED:
+                _tele.inc("serve.brownout.overloaded")
+            raise Overloaded(retry_in_s, level=level)
+        if level >= 1 and priority <= int(state.get("shed_band") or 0):
+            if _tele._ENABLED:
+                _tele.inc("serve.brownout.shed")
+            raise Overloaded(retry_in_s, level=level,
+                             band=int(state.get("shed_band") or 0))
+
     def _apply_loop(self, sid: str, circuit, tag: str,
-                    timeout_s: Optional[float]) -> dict:
+                    timeout_s: Optional[float],
+                    priority: int = 0) -> dict:
         deadline = time.monotonic() + (timeout_s or self.route_timeout_s)
         resubmits = 0
         while True:
             client = self._client(sid, deadline)
             try:
-                client.submit(sid, circuit, tag=tag)
+                client.submit(sid, circuit, tag=tag, priority=priority)
                 return {"resubmits": resubmits, "adopted": False}
             except FleetRemoteError as e:
                 if not _session_not_found(e):
@@ -274,5 +355,5 @@ class FleetFrontDoor:
         return self.sup.stats()
 
 
-__all__ = ["FleetFrontDoor", "SessionUnroutable",
-           "DEFAULT_ROUTE_TIMEOUT_S"]
+__all__ = ["FleetFrontDoor", "SessionUnroutable", "AdoptionStalled",
+           "DEFAULT_ROUTE_TIMEOUT_S", "DEFAULT_MIGRATE_TIMEOUT_S"]

@@ -82,6 +82,21 @@ class Placement:
             "capacity": self.capacity if capacity is None else capacity,
             "state": "healthy", "sessions": {}}
 
+    def remove_worker(self, name: str) -> None:
+        """Forget a retired worker (autoscaler scale-down).  The caller
+        must have evicted + re-placed its sessions first; removing a
+        worker that still owns sessions would orphan their sids, so it
+        is a hard error — the zero-loss protocol bug it would hide is
+        worse than the raise."""
+        w = self._workers.get(name)
+        if w is None:
+            return
+        if w["sessions"]:
+            raise RuntimeError(
+                f"remove_worker({name!r}): {len(w['sessions'])} sessions "
+                "still placed — evict + re-place before retiring")
+        del self._workers[name]
+
     def set_state(self, name: str, state: str) -> None:
         if state not in WORKER_STATES:
             raise ValueError(f"unknown worker state {state!r} "

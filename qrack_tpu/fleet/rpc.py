@@ -176,16 +176,19 @@ class FleetClient:
             s.close()
 
     def submit(self, sid: str, circuit, tag: Optional[str] = None,
-               ) -> Tuple[bool, dict]:
+               priority: int = 0) -> Tuple[bool, dict]:
         """Two-frame submit.  Returns ``(journaled, result_frame)``;
         raises FleetRPCError with ``journaled`` recoverable from the
         exception's ``.journaled`` attribute when the connection dies
         between the frames.  The result frame waits under
         ``result_timeout_s`` (execution time), not ``timeout_s``
-        (transport time) — see ``__init__``."""
+        (transport time) — see ``__init__``.  ``priority`` rides the
+        frame into scheduler admission: it is the job's dispatch band
+        AND its brownout shed band (serve/scheduler.py)."""
         s = self._connect()
         journaled = False
         req = {"op": "submit", "sid": sid, "tag": tag,
+               "priority": int(priority),
                "circuit": encode_circuit(circuit)}
         if _tele._ENABLED:
             tid = _tele.current_trace()
@@ -237,6 +240,15 @@ class FleetClient:
 
     def drain(self, sids=None) -> dict:
         return self.request({"op": "drain", "sids": sids})
+
+    def brownout(self, level: int, shed_band: int = 0,
+                 retry_in_s: float = 0.5) -> dict:
+        """Install (or clear, level 0) brownout state worker-side:
+        scheduler admission sheds at/below the band and the routing
+        ladder prefers the quantized rung while level >= 2."""
+        return self.request({"op": "brownout", "level": int(level),
+                             "shed_band": int(shed_band),
+                             "retry_in_s": float(retry_in_s)})
 
     def adopt(self, sids) -> dict:
         return self.request({"op": "adopt", "sids": list(sids)})

@@ -38,7 +38,19 @@ a death is being handled.
 
 Fault hooks (resilience/faults.py): ``fleet.worker:kill:after_n``
 makes the monitor SIGKILL one healthy worker (the chaos-monkey the
-soak uses); ``fleet.heartbeat:hang`` is acted out worker-side.
+soak uses); ``fleet.heartbeat:hang`` is acted out worker-side;
+``fleet.spawn:hang`` / ``fleet.spawn:raise`` wedge or kill a worker
+boot (the autoscaler's scale-up failure lanes) — a hung spawn is acted
+out by launching a sleeper process in the worker's place, so the boot
+times out, the sleeper is reaped, and the crash charges the new
+worker's restart budget like any other boot failure.
+
+With an :class:`~qrack_tpu.fleet.autoscaler.AutoscaleConfig` passed as
+``autoscale=``, the monitor tick also drives the demand-driven scaler
+(docs/FLEET.md "Autoscaling"): :meth:`pressure` is its sensor,
+:meth:`boot_worker` / :meth:`scale_down` its actuators, and
+:meth:`set_brownout` the graceful-degradation broadcast between
+"overloaded" and "scaled".
 """
 
 from __future__ import annotations
@@ -109,7 +121,8 @@ class FleetSupervisor:
                  tick_s: float = 0.2,
                  ready_timeout_s: float = 180.0,
                  python: Optional[str] = None,
-                 extra_env: Optional[dict] = None):
+                 extra_env: Optional[dict] = None,
+                 autoscale=None):
         self.root = os.path.abspath(root)
         self.store_dir = store_dir or os.path.join(self.root, "store")
         self.layers = layers
@@ -120,6 +133,8 @@ class FleetSupervisor:
         self.stable_s = stable_s
         self.tick_s = tick_s
         self.ready_timeout_s = ready_timeout_s
+        self.restart_threshold = restart_threshold
+        self.restart_cooldown_s = restart_cooldown_s
         self.python = python or sys.executable
         self.extra_env = dict(extra_env or {})
         os.makedirs(self.store_dir, exist_ok=True)
@@ -129,6 +144,10 @@ class FleetSupervisor:
         self._workers: Dict[str, WorkerHandle] = {}
         self._adopted_tags: set = set()
         self._migrating: set = set()               # sids between owners
+        # when each sid entered the migrating set — the front door's
+        # bounded-wait deadline reads this to tell "adoption in flight,
+        # keep waiting" from "owner permanently gone, error out"
+        self._migrating_since: Dict[str, float] = {}
         # adoption batches whose adopter RPC failed: (adopter, sids,
         # not_before) — retried from the monitor tick until the sids
         # either adopt or move (their owner died and eviction re-placed
@@ -142,6 +161,13 @@ class FleetSupervisor:
         # restarts with no delta/sequence bookkeeping — plus the
         # postmortem ring filled from dead workers' black boxes
         self._worker_tele: Dict[Tuple[str, int], dict] = {}
+        # latest heartbeat record per LIVE worker — the autoscaler's
+        # pressure sensor (queue_depth/inflight/staged ride every beat)
+        self._last_beat: Dict[str, dict] = {}
+        # brownout ladder state, written by the autoscaler and read by
+        # the front door on every apply: {"level", "shed_band",
+        # "retry_in_s"} or None when the fleet is healthy
+        self._brownout: Optional[dict] = None
         self._postmortems: List[dict] = []
         self._postmortem_cap = 32
         self.blackbox_dir = os.path.join(self.store_dir, "blackbox")
@@ -156,16 +182,37 @@ class FleetSupervisor:
         # supervisor-side read-only store view (pending-tag scans);
         # built lazily so the checkpoint package only loads on first use
         self._store = None
+        self._next_worker_idx = n_workers
         for i in range(n_workers):
             name = f"w{i}"
-            h = WorkerHandle(
-                name,
-                socket_path=os.path.join(self.root, f"{name}.sock"),
-                hb_path=os.path.join(self.root, f"{name}.hb"),
-                log_path=os.path.join(self.root, "logs", f"{name}.log"),
-                threshold=restart_threshold, cooldown_s=restart_cooldown_s)
-            self._workers[name] = h
+            self._workers[name] = self._new_handle(name)
             self.placement.add_worker(name)
+        # closed-loop capacity: the monitor tick drives the scaler when
+        # a config is supplied (fleet/autoscaler.py)
+        self._autoscaler = None
+        if autoscale is not None:
+            from .autoscaler import Autoscaler, AutoscaleConfig
+
+            cfg = (autoscale if isinstance(autoscale, AutoscaleConfig)
+                   else AutoscaleConfig(**dict(autoscale)))
+            self._autoscaler = Autoscaler(cfg)
+
+    def _new_handle(self, name: str) -> WorkerHandle:
+        return WorkerHandle(
+            name,
+            socket_path=os.path.join(self.root, f"{name}.sock"),
+            hb_path=os.path.join(self.root, f"{name}.hb"),
+            log_path=os.path.join(self.root, "logs", f"{name}.log"),
+            threshold=self.restart_threshold,
+            cooldown_s=self.restart_cooldown_s)
+
+    def next_worker_name(self) -> str:
+        """Mint a fleet-unique worker name (never reused: heartbeat and
+        blackbox files are keyed by name+pid, stats by name)."""
+        with self._lock:
+            name = f"w{self._next_worker_idx}"
+            self._next_worker_idx += 1
+            return name
 
     # -- process plumbing ----------------------------------------------
 
@@ -192,6 +239,17 @@ class FleetSupervisor:
                "--heartbeat", h.hb_path, "--name", h.name,
                "--layers", self.layers, "--beat-s", str(self.beat_s),
                "--engine-kwargs", self.engine_kwargs]
+        # boot-failure chaos (resilience/faults.py): "raise" kills the
+        # spawn at exec time (the InjectedFault propagates to the
+        # caller's boot-failure path); "hang" swaps in a sleeper that
+        # never heartbeats, so the boot wedges until wait_ready's
+        # deadline reaps it — both charge the restart budget exactly
+        # like an organic boot failure
+        from ..resilience import faults as _faults
+
+        directive = _faults.check("fleet.spawn")
+        if directive == "hang":
+            cmd = [self.python, "-c", "import time; time.sleep(3600)"]
         log = open(h.log_path, "ab")
         try:
             h.proc = subprocess.Popen(cmd, stdout=log, stderr=log, env=env)
@@ -295,6 +353,8 @@ class FleetSupervisor:
         for h in probes:
             self._maybe_probe_restart(h)
         self._retry_pending_adoptions()
+        if self._autoscaler is not None:
+            self._autoscaler.tick(self)
         self._maybe_flush_metrics()
 
     def _beat_age(self, h: WorkerHandle) -> Optional[float]:
@@ -305,12 +365,15 @@ class FleetSupervisor:
             # covered by the pid check + wait_ready, not beat age
             return None
         snap = rec.get("telemetry")
-        if snap is not None:
-            # the liveness read doubles as the metrics ingest: no extra
-            # RPC, no extra file — the beat we already parse carries
-            # the worker's cumulative snapshot
-            with self._lock:
+        with self._lock:
+            if snap is not None:
+                # the liveness read doubles as the metrics ingest: no
+                # extra RPC, no extra file — the beat we already parse
+                # carries the worker's cumulative snapshot
                 self._worker_tele[(h.name, int(rec["pid"]))] = snap
+            # ... and as the autoscaler's pressure sensor: the latest
+            # beat carries queue_depth/inflight/staged
+            self._last_beat[h.name] = rec
         return time.time() - float(rec.get("t", 0.0))
 
     def _maybe_inject_kill(self) -> None:
@@ -338,6 +401,30 @@ class FleetSupervisor:
 
     # -- death / adoption / restart ------------------------------------
 
+    def _mark_migrating(self, sids) -> None:
+        """Caller holds the lock.  Stamps entry time so the front door
+        can bound its wait (:meth:`migrating_since`)."""
+        now = time.monotonic()
+        for sid in sids:
+            self._migrating.add(sid)
+            self._migrating_since.setdefault(sid, now)
+
+    def _unmark_migrating(self, sids) -> None:
+        """Caller holds the lock."""
+        for sid in sids:
+            self._migrating.discard(sid)
+            self._migrating_since.pop(sid, None)
+
+    def migrating_since(self, sid: str) -> Optional[float]:
+        """``time.monotonic()`` when `sid` entered the migrating set,
+        or None when it is not migrating.  Direct ``_migrating``
+        mutation (tests) falls back to "just now" so the bounded wait
+        still engages."""
+        with self._lock:
+            if sid not in self._migrating:
+                return None
+            return self._migrating_since.get(sid, time.monotonic())
+
     def _record_crash(self, h: WorkerHandle) -> None:
         """Account one crash against `h`'s restart budget and arm the
         exponential respawn backoff.  Caller holds the lock.  Quarantine
@@ -357,7 +444,7 @@ class FleetSupervisor:
             self._record_crash(h)
             self.placement.set_state(h.name, "dead")
             evicted = self.placement.evict(h.name)
-            self._migrating |= {sid for sid, _ in evicted}
+            self._mark_migrating(sid for sid, _ in evicted)
         if _tele._ENABLED:
             _tele.event("fleet.worker.dead", worker=h.name, reason=reason,
                         crashes=h.crashes)
@@ -407,7 +494,7 @@ class FleetSupervisor:
                             sids=batch)
             return False
         with self._lock:
-            self._migrating -= set(batch)
+            self._unmark_migrating(batch)
         if _tele._ENABLED:
             _tele.inc("fleet.adopt.sessions", len(batch))
             _tele.event("fleet.adopt", adopter=name, source=source,
@@ -491,8 +578,11 @@ class FleetSupervisor:
             with self._lock:
                 # no routing until the new process proves ready
                 self.placement.set_state(h.name, "dead")
-            self._spawn(h)
             try:
+                # _spawn inside the boot-failure net: an injected
+                # fleet.spawn:raise (or a real exec failure) charges
+                # the budget exactly like a boot that never readied
+                self._spawn(h)
                 self.wait_ready([h.name], timeout_s=self.ready_timeout_s)
             except (TimeoutError, RuntimeError):
                 # placement is already "dead" here, so _on_death's
@@ -539,7 +629,7 @@ class FleetSupervisor:
         with self._lock:
             self.placement.set_state(name, "draining")
             moved = self.placement.evict(name)
-            self._migrating |= {sid for sid, _ in moved}
+            self._mark_migrating(sid for sid, _ in moved)
         # worker-side drain persists idle sessions and disowns them;
         # busy ones settle their in-flight jobs under the SIGTERM
         # handler's drain loop, so after reap_child the full set is
@@ -561,6 +651,207 @@ class FleetSupervisor:
             _tele.event("fleet.rolling_restart.worker", worker=name,
                         migrated=len(migrated), killed=reaped.killed)
         return {"migrated": migrated, "needed_kill": reaped.killed}
+
+    # -- elastic capacity (autoscaler actuators) -----------------------
+
+    def boot_worker(self, name: Optional[str] = None,
+                    timeout_s: Optional[float] = None) -> bool:
+        """Grow the pool by one worker: register it (state "dead" — no
+        routing until the new process proves ready), spawn into the
+        warm-artifact path (shared XLA cache + ProgramManifest, same as
+        any restart), wait ready.  Returns True on a ready worker.
+
+        A failed boot (exit, wedge, injected ``fleet.spawn`` fault)
+        charges the NEW worker's restart budget and leaves the handle
+        registered in state "dead" with backoff armed — the monitor's
+        ordinary restart/quarantine ladder owns further attempts, so a
+        worker that fails every boot quarantines instead of spinning.
+        Placement is never stuck either way: a "dead" worker is not
+        placeable, and existing workers keep serving throughout."""
+        if name is None:
+            name = self.next_worker_name()
+        with self._lock:
+            if name in self._workers:
+                raise ValueError(f"worker {name!r} already exists")
+            h = self._new_handle(name)
+            h.restarting = True   # this boot owns the handle, not _tick
+            self._workers[name] = h
+            self.placement.add_worker(name)
+            self.placement.set_state(name, "dead")
+        try:
+            try:
+                self._spawn(h)
+                self.wait_ready([name],
+                                timeout_s=timeout_s or self.ready_timeout_s)
+            except (TimeoutError, RuntimeError):
+                if h.proc is not None and h.proc.poll() is None:
+                    reap_child(h.proc)  # wedged mid-boot: don't leak it
+                with self._lock:
+                    self._record_crash(h)
+                if _tele._ENABLED:
+                    _tele.event("fleet.worker.dead", worker=name,
+                                reason="boot-failure", crashes=h.crashes)
+                self._collect_blackbox(h, "boot-failure")
+                return False
+            with self._lock:
+                self.placement.set_state(name, "healthy")
+            if _tele._ENABLED:
+                _tele.event("fleet.worker.spawned_up", worker=name,
+                            pid=h.pid)
+            return True
+        finally:
+            h.restarting = False
+
+    def scale_down(self, name: Optional[str] = None) -> Optional[dict]:
+        """Shrink the pool by one worker with zero session loss — the
+        rolling-restart migration minus the respawn: drain → evict
+        (sids go migrating; the front door waits) → re-place onto peers
+        → adopt → retire.  Picks the least-loaded healthy worker when
+        `name` is None; refuses (returns None) rather than retire the
+        last healthy worker.  Racing a kill -9 is safe: selection and
+        the draining transition happen under the monitor lock, so the
+        death path either already owns the worker (we re-pick) or finds
+        it draining and leaves it to us; a victim that dies mid-drain
+        just falls through to adoption, which replays its WAL."""
+        with self._lock:
+            healthy = self.placement.workers("healthy")
+            if name is None:
+                if len(healthy) < 2:
+                    return None
+                name = min(healthy,
+                           key=lambda n: (self.placement.load(n),
+                                          len(self.placement.sessions_on(n)),
+                                          n))
+            elif name not in healthy or len(healthy) < 2:
+                return None
+            h = self._workers[name]
+            h.restarting = True   # the retire owns the handle, not _tick
+            self.placement.set_state(name, "draining")
+            moved = self.placement.evict(name)
+            self._mark_migrating(sid for sid, _ in moved)
+        try:
+            h.client.drain()
+        except (FleetRPCError, FleetRemoteError):
+            pass  # SIGTERM's graceful drain covers it
+        reaped = reap_child(h.proc)
+        migrated: Dict[str, str] = {}
+        if moved:
+            try:
+                with self._lock:
+                    migrated = self.placement.place_all(moved,
+                                                        exclude=[name])
+            except Exception:  # noqa: BLE001 — NoHealthyWorkers et al.
+                # nowhere to re-place: the sids STAY migrating and the
+                # front door's bounded wait surfaces the typed error;
+                # the store still holds every session durably
+                if _tele._ENABLED:
+                    _tele.event("fleet.scale_down.orphaned", worker=name,
+                                sids=[sid for sid, _ in moved])
+        by_adopter: Dict[str, List[str]] = {}
+        for sid, adopter in migrated.items():
+            by_adopter.setdefault(adopter, []).append(sid)
+        for adopter, batch in sorted(by_adopter.items()):
+            self._adopt_assigned(adopter, batch, source=name)
+        self._retire_worker(h)
+        if _tele._ENABLED:
+            _tele.event("fleet.worker.retired", worker=name,
+                        migrated=len(migrated), killed=reaped.killed)
+        return {"migrated": migrated, "needed_kill": reaped.killed}
+
+    def _retire_worker(self, h: WorkerHandle) -> None:
+        """Remove a drained worker from the fleet WITHOUT losing its
+        telemetry: counters are cumulative, so a retired incarnation's
+        final heartbeat snapshot must stay folded into the fleet-wide
+        merge (metrics() keys incarnations ``(name, pid)`` and
+        ``_worker_tele`` is never pruned) or every scale-down would
+        deflate fleet totals.  The graceful-exit final beat carries the
+        post-drain snapshot — read it one last time here, because the
+        monitor's periodic ingest may have missed it."""
+        rec = read_heartbeat(h.hb_path)
+        with self._lock:
+            if rec is not None and rec.get("telemetry") is not None \
+                    and rec.get("pid") is not None:
+                self._worker_tele[(h.name, int(rec["pid"]))] = \
+                    rec["telemetry"]
+            self.placement.remove_worker(h.name)
+            self._workers.pop(h.name, None)
+            self._last_beat.pop(h.name, None)
+        for p in (h.hb_path, h.socket_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def pressure(self) -> dict:
+        """The autoscaler's sensor bundle, assembled from state the
+        monitor already maintains (no extra RPC): per-worker pipeline
+        depth from the latest heartbeats, the worst per-incarnation
+        ``serve.queue_wait``/``serve.latency`` p99 SLO gauges from the
+        telemetry ingest, and the placement cost model's load/capacity
+        totals."""
+        with self._lock:
+            live = [n for n in self.placement.workers("healthy")]
+            beats = {n: self._last_beat.get(n) for n in live}
+            load = sum(self.placement.load(n) for n in live)
+            cap = sum(self.placement._workers[n]["capacity"] for n in live)
+            n_total = len(self._workers)
+            snaps = list(self._worker_tele.values())
+        backlog = 0
+        for rec in beats.values():
+            if rec is None:
+                continue
+            backlog += int(rec.get("queue_depth") or 0)
+            backlog += int(rec.get("inflight") or 0)
+            backlog += int(rec.get("staged") or 0)
+        queue_wait_p99 = 0.0
+        latency_p99 = 0.0
+        for snap in snaps:
+            g = snap.get("gauges") or {}
+            queue_wait_p99 = max(queue_wait_p99,
+                                 float(g.get("serve.queue_wait.p99") or 0.0))
+            latency_p99 = max(latency_p99,
+                              float(g.get("serve.latency.p99") or 0.0))
+        return {"n_live": len(live), "n_total": n_total,
+                "backlog": backlog, "load": load, "capacity": cap,
+                "queue_wait_p99_s": queue_wait_p99,
+                "latency_p99_s": latency_p99}
+
+    # -- brownout (graceful degradation between overloaded and scaled) -
+
+    def set_brownout(self, level: int, shed_band: int = 0,
+                     retry_in_s: float = 0.5) -> None:
+        """Install brownout ladder state fleet-wide: the front door
+        reads it synchronously on every apply (level 1 sheds bands <=
+        `shed_band`, level 3 refuses all new work), and every healthy
+        worker is told over RPC so scheduler admission and the routing
+        rung degrade too (level 2 routes borderline dense jobs onto the
+        quantized tier).  Broadcast only on change."""
+        state = None if level <= 0 else {
+            "level": int(level), "shed_band": int(shed_band),
+            "retry_in_s": float(retry_in_s)}
+        with self._lock:
+            if state == self._brownout:
+                return
+            self._brownout = state
+            names = self.placement.workers("healthy")
+        if _tele._ENABLED:
+            _tele.gauge("serve.brownout.level", float(level))
+            _tele.event("fleet.autoscale.brownout", level=level,
+                        shed_band=shed_band)
+        for n in names:
+            try:
+                with self._lock:
+                    h = self._workers.get(n)
+                if h is not None:
+                    h.client.brownout(level, shed_band=shed_band,
+                                      retry_in_s=retry_in_s)
+            except (FleetRPCError, FleetRemoteError):
+                pass  # a dying worker misses the memo; the next
+                #       broadcast (or its respawn at level 0) catches up
+
+    def brownout(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._brownout) if self._brownout else None
 
     # -- front-door surface --------------------------------------------
 
@@ -592,6 +883,7 @@ class FleetSupervisor:
         with self._lock:
             self.placement.release(sid)
             self._session_meta.pop(sid, None)
+            self._unmark_migrating([sid])
 
     def tag_adopted(self, tag: str) -> bool:
         """True when `tag` was pending in a dead worker's journal at
@@ -737,6 +1029,10 @@ class FleetSupervisor:
                                      self._adopt_pending),
                 "adopted_tags": len(self._adopted_tags),
                 "postmortems": list(self._postmortems),
+                "brownout": dict(self._brownout) if self._brownout
+                else None,
+                "autoscale": (self._autoscaler.stats()
+                              if self._autoscaler is not None else None),
             }
 
     # -- lifecycle -----------------------------------------------------
@@ -745,7 +1041,11 @@ class FleetSupervisor:
         self._stop.set()
         if self._monitor is not None and self._monitor.is_alive():
             self._monitor.join(timeout=max(self.tick_s * 10, 5.0))
-        for h in self._workers.values():
+        if self._autoscaler is not None:
+            self._autoscaler.join(timeout_s=10.0)
+        with self._lock:
+            handles = list(self._workers.values())
+        for h in handles:
             if h.proc is not None and h.proc.poll() is None:
                 reap_child(h.proc)
         if _tele._ENABLED or self._worker_tele:

@@ -100,7 +100,8 @@ def _handle_submit(svc, state: _WorkerState, f, req) -> bool:
         # span 1 of the submit's worker-side trace: WAL append +
         # admission (ends the instant the entry is durable)
         with _tele.span("worker.submit.journal"):
-            handle = svc.submit(sid, circuit, tag=tag)
+            handle = svc.submit(sid, circuit, tag=tag,
+                                priority=int(req.get("priority") or 0))
     except Exception as e:  # noqa: BLE001
         _send_err(f, e)
         return True
@@ -152,6 +153,22 @@ def _dispatch(svc, state: _WorkerState, op: str, req: dict) -> dict:
         return {"state": encode_array(svc.get_state(req["sid"]))}
     if op == "drain":
         return svc.drain(sids=req.get("sids"))
+    if op == "brownout":
+        # fleet-wide graceful degradation (supervisor broadcast):
+        # level >= 1 sheds jobs at/below the band in scheduler
+        # admission; level >= 2 points the routing ladder's borderline
+        # dense decisions at the quantized rung; level 0 clears both
+        level = int(req.get("level") or 0)
+        svc.scheduler.set_brownout(level,
+                                   shed_band=int(req.get("shed_band") or 0),
+                                   retry_in_s=float(
+                                       req.get("retry_in_s") or 0.5))
+        from ..route import router as _router
+
+        _router.set_brownout(level >= 2)
+        if _tele._ENABLED:
+            _tele.gauge("serve.brownout.level", float(level))
+        return {"level": level}
     if op == "adopt":
         t0 = time.perf_counter()
         out = svc.recover(sids=req["sids"])
@@ -171,6 +188,7 @@ def _dispatch(svc, state: _WorkerState, op: str, req: dict) -> dict:
             "queue_depth": svc.scheduler.depth(),
             "inflight": svc.executor.inflight_jobs,
             "staged": svc.executor.staged_jobs,
+            "pressure": svc.executor.pressure(),
             "ttfr_s": state.ttfr_s, "boot_s": state.boot_s,
             "telemetry": _tele.snapshot(include_events=False)}}
     if op == "shutdown":
@@ -247,6 +265,7 @@ def main(argv=None) -> int:
                "queue_depth": svc.scheduler.depth(),
                "inflight": svc.executor.inflight_jobs,
                "staged": svc.executor.staged_jobs,
+               "pressure": svc.executor.pressure(),
                "ttfr_s": state.ttfr_s,
                "boot_s": state.boot_s}
         if _tele._ENABLED:

@@ -80,8 +80,11 @@ SITES = (
     # tick and the worker's heartbeat writer, not by call_guarded —
     # ``fleet.worker:kill:after_n`` makes the supervisor SIGKILL its own
     # worker, ``fleet.heartbeat:hang:after_n`` makes a worker stop
-    # beating while it keeps serving (docs/FLEET.md)
-    "fleet.worker", "fleet.heartbeat",
+    # beating while it keeps serving (docs/FLEET.md);
+    # ``fleet.spawn:hang`` wedges a scale-up boot (the spawned process
+    # never becomes ready) and ``fleet.spawn:raise`` kills it at exec —
+    # both charge the new worker's restart budget (supervisor._spawn)
+    "fleet.worker", "fleet.heartbeat", "fleet.spawn",
 )
 # bare last-segment categories that match the site family on any engine
 CATEGORIES = ("discover", "compile", "dispatch", "device_get", "exchange",

@@ -22,14 +22,15 @@ user who never serves pays zero import or dispatch cost.  See
 docs/SERVING.md.
 """
 
-from .errors import (AdmissionRejected, LoadShed, QueueBudgetExceeded,
-                     QueueFull, ServeError, ServiceStopped,
-                     SessionNotFound)
+from .errors import (AdmissionRejected, LoadShed, Overloaded,
+                     QueueBudgetExceeded, QueueFull, ServeError,
+                     ServiceStopped, SessionNotFound)
 from .scheduler import JobHandle
 from .service import QrackService
 
 __all__ = [
     "QrackService", "JobHandle",
     "ServeError", "AdmissionRejected", "QueueFull", "LoadShed",
-    "QueueBudgetExceeded", "ServiceStopped", "SessionNotFound",
+    "Overloaded", "QueueBudgetExceeded", "ServiceStopped",
+    "SessionNotFound",
 ]

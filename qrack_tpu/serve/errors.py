@@ -9,6 +9,8 @@ failures) re-raise from .result() unchanged.
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class ServeError(RuntimeError):
     """Base class for every serving-layer error."""
@@ -50,6 +52,26 @@ class LoadShed(AdmissionRejected):
             f"accelerator (retry in ~{retry_in_s:.1f}s)")
         self.session_id = session_id
         self.retry_in_s = retry_in_s
+
+
+class Overloaded(AdmissionRejected):
+    """The fleet is past capacity and the brownout ladder refused this
+    job at the front door — either its priority band is being shed
+    (level 1+) or the ladder's top rung is refusing all new work while
+    scale-up races the surge (level 3).  Carries the ladder level and a
+    retry-after hint; the job was NOT journaled, executed, or queued —
+    retrying after ``retry_in_s`` is always safe."""
+
+    def __init__(self, retry_in_s: float, level: int = 1,
+                 band: Optional[int] = None):
+        what = ("shedding priority band <= %s" % band if band is not None
+                else "refusing new work")
+        super().__init__(
+            f"overloaded (brownout level {level}, {what}); "
+            f"retry in ~{retry_in_s:.1f}s")
+        self.retry_in_s = retry_in_s
+        self.level = level
+        self.band = band
 
 
 class QueueBudgetExceeded(ServeError):

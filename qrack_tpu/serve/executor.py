@@ -123,6 +123,17 @@ class Executor:
     def thread_ident(self) -> Optional[int]:
         return self._thread.ident if self._thread else None
 
+    def pressure(self) -> int:
+        """Queued + staged + in-flight jobs right now — the worker's
+        heartbeat-visible backpressure signal; the fleet autoscaler
+        sums it across workers into its backlog sensor
+        (fleet/autoscaler.py).  Racy cross-thread read by design, like
+        the plain-int fields it sums."""
+        n = self.scheduler.depth() + self.inflight_jobs + self.staged_jobs
+        if _tele._ENABLED:
+            _tele.gauge("serve.pressure", float(n))
+        return n
+
     # -- main loop -----------------------------------------------------
 
     def _loop(self) -> None:

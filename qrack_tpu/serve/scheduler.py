@@ -42,7 +42,7 @@ from typing import Callable, List, Optional
 
 from .. import telemetry as _tele
 from ..resilience import breaker as _breaker
-from .errors import (LoadShed, QueueBudgetExceeded, QueueFull,
+from .errors import (LoadShed, Overloaded, QueueBudgetExceeded, QueueFull,
                      ServiceStopped)
 from .session import Session
 
@@ -159,6 +159,27 @@ class Scheduler:
         self._cond = threading.Condition()
         self._seq = 0
         self._stopped = False
+        # brownout admission (fleet autoscaler broadcast): while set,
+        # jobs at or below the shed band are refused with the typed
+        # Overloaded — (level, shed_band, retry_in_s) or None
+        self._brownout: Optional[tuple] = None
+
+    # -- brownout (graceful degradation under fleet overload) ----------
+
+    def set_brownout(self, level: int, shed_band: int = 0,
+                     retry_in_s: float = 0.5) -> None:
+        """Install (level >= 1) or clear (level <= 0) brownout shedding
+        at admission.  Worker-side defense in depth behind the front
+        door's synchronous check — direct submitters degrade the same
+        way fleet tenants do."""
+        with self._cond:
+            self._brownout = (None if level <= 0
+                              else (int(level), int(shed_band),
+                                    float(retry_in_s)))
+
+    def brownout_level(self) -> int:
+        with self._cond:
+            return self._brownout[0] if self._brownout else 0
 
     # -- submit side ---------------------------------------------------
 
@@ -172,6 +193,14 @@ class Scheduler:
                 if _tele._ENABLED:
                     _tele.inc("serve.jobs.rejected_full")
                 raise QueueFull(len(self._heap), self.max_depth)
+            if self._brownout is not None:
+                level, shed_band, retry_in_s = self._brownout
+                if level >= 3 or job.priority <= shed_band:
+                    if _tele._ENABLED:
+                        _tele.inc("serve.brownout.shed")
+                    raise Overloaded(retry_in_s, level=level,
+                                     band=None if level >= 3
+                                     else shed_band)
             if job.session is not None:
                 remaining = _breaker.get_breaker().open_remaining_s()
                 if remaining > 0 and job.session.touches_tunnel():

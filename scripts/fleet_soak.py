@@ -25,13 +25,29 @@ bound), resubmit/adoption counts from the exactly-once path, worker
 restart counts, and cold vs post-restart TTFR from the heartbeats
 (warm-artifact shipping makes the restarted number the warm one).
 
+A second trial shape, ``--surge``, drives the AUTOSCALER instead of
+the restart plane: a 2-worker fleet (n_max=6) takes a 10x open-loop
+Poisson traffic step from five tenants in two priority bands.  The
+verdict is the closed loop: the pool must grow past n_min, the
+brownout ladder must fire BEFORE the new capacity lands (shed counters
+strictly precede the first completed scale-up), no tenant above the
+shed band may lose a job (retry-on-Overloaded, oracle fidelity), shed
+low-band tenants must show CLEAN refusals (their oracle replays only
+the applied subset), and the pool must drain back to n_min once the
+surge passes.  Each surge trial also runs one chaos lane: odd trials
+SIGKILL a worker mid-surge, even trials wedge the first scale-up spawn
+(``fleet.spawn:hang``) so a failed boot charges the restart budget
+while the ladder holds.
+
 Usage:
     python scripts/fleet_soak.py [trials] [seed]
+    python scripts/fleet_soak.py --surge [trials] [seed]
 
-Defaults: 8 trials, seed 0 (trials cost ~20-40s each — each one boots
-and restarts a real 4-process fleet).  Exit 0 = all trials zero-loss.
-One JSON line per trial; the slow-marked
-tests/test_fleet.py::test_fleet_soak_smoke runs a 1-trial slice in CI.
+Defaults: 8 trials (4 with --surge), seed 0 (trials cost ~20-40s each
+— each one boots and restarts a real multi-process fleet).  Exit 0 =
+all trials zero-loss.  One JSON line per trial; the slow-marked
+tests/test_fleet.py::test_fleet_soak_smoke and
+::test_fleet_surge_soak_smoke run short slices in CI.
 """
 
 import os
@@ -50,7 +66,10 @@ import numpy as np  # noqa: E402
 
 from qrack_tpu import QEngineCPU  # noqa: E402
 from qrack_tpu import resilience as res  # noqa: E402
-from qrack_tpu.fleet import FleetFrontDoor, FleetSupervisor  # noqa: E402
+from qrack_tpu import telemetry as tele  # noqa: E402
+from qrack_tpu.fleet import (AutoscaleConfig, FleetFrontDoor,  # noqa: E402
+                             FleetRemoteError, FleetSupervisor)
+from qrack_tpu.serve import Overloaded  # noqa: E402
 from qrack_tpu.layers.qcircuit import QCircuit  # noqa: E402
 from qrack_tpu.models.qft import qft_qcircuit  # noqa: E402
 from qrack_tpu.telemetry import Histogram  # noqa: E402
@@ -241,7 +260,254 @@ def run_trial(trial: int, seed: int) -> dict:
     return info
 
 
+# ---------------------------------------------------------------------------
+# --surge: 10x traffic step vs the autoscaler + brownout ladder
+# ---------------------------------------------------------------------------
+
+SURGE_MIN = 2         # n_min: the fleet at rest
+SURGE_MAX = 6         # n_max: headroom the step must actually use
+SURGE_HIGH = 3        # priority-2 tenants: zero loss, retry on Overloaded
+SURGE_LOW = 2         # priority-0 tenants: shed band — clean refusals only
+SURGE_W = 16          # wide enough that a circuit costs real worker time
+SURGE_CIRCUITS = 34   # per high tenant (first SURGE_BASE at the calm rate)
+SURGE_BASE = 4
+
+
+# worker-side admission refusals that mean "the job never executed":
+# safe to resubmit (high band) or count as a clean shed (low band)
+_REFUSALS = ("Overloaded", "QueueBudgetExceeded", "QueueFull", "LoadShed")
+
+
+def _surge_circuit(rng, n: int) -> QCircuit:
+    """Deliberately heavy random circuit: enough gates at SURGE_W that
+    five blocking submitters genuinely outrun two workers (the backlog
+    sensor needs real queueing, not RPC overhead)."""
+    c = QCircuit(n)
+    for _ in range(24):
+        c.append_1q(int(rng.integers(0, n)), _rand_u2(rng))
+        if rng.random() < 0.5:
+            a, b = rng.choice(n, size=2, replace=False)
+            c.append_ctrl([int(a)], int(b), _X, 1)
+    return c
+
+
+def run_surge_trial(trial: int, seed: int) -> dict:
+    """One 10x-step trial: closed-loop scale-up, ladder-ordered
+    brownout, zero loss above the shed band, drain back to n_min."""
+
+    def _mk_rng(tag: int):
+        return np.random.Generator(np.random.PCG64(
+            (seed << 24) ^ (trial << 12) ^ tag))
+
+    rng = _mk_rng(0xFEE7)
+    with_kill = bool(trial % 2)
+    info = {"trial": trial, "surge": True,
+            "chaos": "fleet.worker:kill" if with_kill else
+                     "fleet.spawn:hang"}
+
+    resilience_up()
+    tele.enable()   # before start(): workers inherit QRACK_TPU_TELEMETRY
+    tele.reset()
+    root = tempfile.mkdtemp(prefix=f"fleet-surge-{trial}-")
+    sup = None
+    try:
+        # thresholds scaled to the blocking submitters: 5 threads vs 2
+        # workers puts >1 queued-or-inflight job per live worker the
+        # moment the step lands; ladder_ticks is small so the brownout
+        # rungs are observable inside the seconds a real boot takes
+        sup = FleetSupervisor(
+            SURGE_MIN, root, layers="cpu",
+            beat_s=0.25, deadline_beats=4, tick_s=0.05,
+            restart_threshold=6, restart_cooldown_s=1.0,
+            backoff_base_s=0.05, stable_s=0.5,
+            ready_timeout_s=120.0,
+            autoscale=AutoscaleConfig(
+                n_min=SURGE_MIN, n_max=SURGE_MAX,
+                up_backlog=1.0, up_queue_wait_p99_s=30.0,
+                up_ticks=2, down_ticks=20,
+                cooldown_s=1.0, boot_timeout_s=30.0,
+                ladder_ticks=3, shed_band=0, retry_in_s=0.1)).start()
+        front = FleetFrontDoor(sup)
+
+        hi_sids, hi_oracles, hi_streams = [], [], []
+        for k in range(SURGE_HIGH):
+            s = (trial << 6) + k
+            hi_sids.append(front.create_session(
+                SURGE_W, layers="cpu", seed=s, rand_global_phase=False))
+            hi_oracles.append(QEngineCPU(SURGE_W, rng=QrackRandom(s),
+                                         rand_global_phase=False))
+            hi_streams.append([_surge_circuit(rng, SURGE_W)
+                               for _ in range(SURGE_CIRCUITS)])
+        lo_sids, lo_oracles = [], []
+        for k in range(SURGE_LOW):
+            s = (trial << 6) + 32 + k
+            lo_sids.append(front.create_session(
+                SURGE_W, layers="cpu", seed=s, rand_global_phase=False))
+            lo_oracles.append(QEngineCPU(SURGE_W, rng=QrackRandom(s),
+                                         rand_global_phase=False))
+
+        # chaos AFTER the resting fleet is up, so the lane hits the
+        # surge machinery, not the initial boots
+        if with_kill:
+            res.faults.inject("fleet.worker", "kill",
+                              after_n=int(rng.integers(15, 40)), times=1)
+        else:
+            res.faults.inject("fleet.spawn", "hang", times=1)
+
+        lock = threading.Lock()
+        lat, sheds, retries = [], [0], [0]
+        stop_low = threading.Event()
+
+        def _high(k: int) -> None:
+            r = _mk_rng(1 + k)
+            sid, oracle = hi_sids[k], hi_oracles[k]
+            for i, circ in enumerate(hi_streams[k]):
+                gap = 0.4 if i < SURGE_BASE else 0.04   # the 10x step
+                time.sleep(gap * float(r.exponential()))
+                t0 = time.perf_counter()
+                while True:   # zero loss: a refusal is a delay, never a drop
+                    try:
+                        front.apply(sid, circ, priority=2)
+                        break
+                    except Overloaded as e:
+                        with lock:
+                            retries[0] += 1
+                        time.sleep(max(e.retry_in_s, 0.05))
+                    except FleetRemoteError as e:
+                        if e.etype not in _REFUSALS:
+                            raise   # admission refusal: never executed
+                        with lock:
+                            retries[0] += 1
+                        time.sleep(0.1)
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+                circ.Run(oracle)
+
+        def _low(k: int) -> None:
+            r = _mk_rng(101 + k)
+            sid, oracle = lo_sids[k], lo_oracles[k]
+            shed = 0
+            while not stop_low.is_set():
+                circ = _surge_circuit(r, SURGE_W)
+                time.sleep(0.04 * float(r.exponential()))
+                t0 = time.perf_counter()
+                try:
+                    front.apply(sid, circ, priority=0)
+                except Overloaded:
+                    shed += 1       # clean refusal: circuit ran NOWHERE
+                    continue
+                except FleetRemoteError as e:
+                    if e.etype not in _REFUSALS:
+                        raise
+                    shed += 1       # expired in queue: never executed
+                    continue
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+                circ.Run(oracle)    # oracle replays the applied subset only
+            with lock:
+                sheds[0] += shed
+
+        highs = [threading.Thread(target=_high, args=(k,), daemon=True)
+                 for k in range(SURGE_HIGH)]
+        lows = [threading.Thread(target=_low, args=(k,), daemon=True)
+                for k in range(SURGE_LOW)]
+        for t in highs + lows:
+            t.start()
+        for t in highs:
+            t.join(timeout=600)
+        stuck = any(t.is_alive() for t in highs)
+        stop_low.set()
+        for t in lows:
+            t.join(timeout=120)
+        if stuck or any(t.is_alive() for t in lows):
+            raise TimeoutError("surge submitters did not finish")
+
+        # drain back: pressure gone, the ladder must clear and the pool
+        # shrink to n_min through the zero-loss migration path
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (len(sup.worker_names()) == SURGE_MIN
+                    and sup.stats()["autoscale"]["level"] == 0):
+                break
+            time.sleep(0.2)
+
+        fids_hi, fids_lo = [], []
+        for sid, oracle in zip(hi_sids, hi_oracles):
+            b = np.asarray(front.get_state(sid))
+            with res.faults.suspended():
+                a = np.asarray(oracle.GetQuantumState())
+            fids_hi.append(fidelity(a, b))
+        for sid, oracle in zip(lo_sids, lo_oracles):
+            b = np.asarray(front.get_state(sid))
+            with res.faults.suspended():
+                a = np.asarray(oracle.GetQuantumState())
+            fids_lo.append(fidelity(a, b))
+        for sid in hi_sids + lo_sids:
+            front.destroy_session(sid)
+
+        auto = sup.stats()["autoscale"]
+        d = auto["decisions"]
+        ctr = tele.snapshot(include_events=False)["counters"]
+        hist = Histogram.of(lat) if lat else None
+        b_t, c_t = auto["first_brownout_t"], auto["first_scale_up_done_t"]
+
+        lvl = [d.get(f"brownout.level{i}", 0) for i in range(4)]
+        ladder_ordered = ((lvl[2] == 0 or lvl[1] > 0)
+                          and (lvl[3] == 0 or lvl[2] > 0))
+        info["n_peak"] = auto["n_peak"]
+        info["n_final"] = len(sup.worker_names())
+        info["level_final"] = auto["level"]
+        info["decisions"] = d
+        info["retries"] = retries[0]
+        info["sheds"] = sheds[0]
+        info["shed_ctr"] = int(ctr.get("serve.brownout.shed", 0))
+        info["overloaded_ctr"] = int(
+            ctr.get("serve.brownout.overloaded", 0))
+        info["scale_ups"] = int(ctr.get("fleet.autoscale.scale_up", 0))
+        info["scale_up_failed"] = int(
+            ctr.get("fleet.autoscale.scale_up_failed", 0))
+        info["crashes"] = sum(
+            w["crashes"] for w in sup.stats()["workers"].values())
+        info["fired"] = sum(sp.fired for sp in res.faults.specs())
+        if hist is not None:
+            info["lat_p50_ms"] = round(hist.percentile(50) * 1e3, 3)
+            info["lat_p99_ms"] = round(hist.percentile(99) * 1e3, 3)
+            info["lat_max_ms"] = round(hist.max * 1e3, 3)
+        info["fidelity_min_high"] = min(fids_hi)
+        info["fidelity_min_low"] = min(fids_lo)
+        # brownout BEFORE capacity: the first rung strictly precedes the
+        # first completed scale-up (if a wedged spawn kept the scaler's
+        # own boot from ever completing, brownout alone suffices)
+        browned_first = b_t is not None and (c_t is None or b_t < c_t)
+        info["browned_before_capacity"] = browned_first
+        info["ok"] = bool(
+            auto["n_peak"] > SURGE_MIN            # the pool actually grew
+            and info["n_final"] == SURGE_MIN      # ...and drained back
+            and auto["level"] == 0
+            and browned_first
+            and ladder_ordered
+            and sheds[0] >= 1                     # the band was exercised
+            and min(fids_hi) > 1 - 1e-6           # zero loss above the band
+            and min(fids_lo) > 1 - 1e-6           # clean refusals below it
+            and (hist is None or hist.percentile(99) < 120.0))
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if sup is not None:
+            sup.stop()
+        tele.disable()
+        tele.reset()
+        resilience_down()
+        shutil.rmtree(root, ignore_errors=True)
+    return info
+
+
 def main(argv) -> int:
+    argv = list(argv)
+    if "--surge" in argv:
+        argv.remove("--surge")
+        return soak_main(argv, run_surge_trial, default_trials=4)
     return soak_main(argv, run_trial, default_trials=8)
 
 

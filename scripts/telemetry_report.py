@@ -18,6 +18,11 @@ sum; spans merge).  Sections:
   * exchange traffic: pager/ICI event counts and bytes
   * remap: placement-planner traffic — windows planned, swap pairs
     issued by kind, windows that needed no remap (docs/PERFORMANCE.md)
+  * autoscale: the fleet control loop — decisions by reason
+    (fleet.autoscale.decision.*), scale-up/down/failed counts, boot
+    latency percentiles (fleet.autoscale.spawn_s), the brownout
+    ladder's refusal counters and their share of admissions
+    (serve.brownout.*), current/peak pool size — docs/FLEET.md
   * serving: jobs admitted/shed/expired/completed, batch occupancy
     (batched jobs per dispatch), queue-depth / latency gauges, and
     pipeline health — overlap_ratio (staged batches per dispatch) and
@@ -153,6 +158,7 @@ def report(snap: dict, top: int) -> dict:
         "elastic": {},
         "integrity": {},
         "fleet": {},
+        "autoscale": {},
         "gauges": snap.get("gauges", {}),
         "layer_events": {},
         "spans": snap.get("spans", {}),
@@ -305,6 +311,36 @@ def report(snap: dict, top: int) -> dict:
     for name, v in gauges.items():
         if name.startswith("roofline.") and name not in out["roofline"]:
             out["roofline"][name] = v
+    # autoscale: the fleet control loop's decision mix, the brownout
+    # ladder's refusal counters (+ their share of everything that asked
+    # for admission), boot latency percentiles, and pool size
+    asc = {}
+    for k in list(out["fleet"]):
+        if k.startswith("fleet.autoscale."):
+            asc[k[len("fleet.autoscale."):]] = out["fleet"].pop(k)
+    shed = counters.get("serve.brownout.shed", 0)
+    refused = counters.get("serve.brownout.overloaded", 0)
+    quantized = counters.get("serve.brownout.quantized", 0)
+    if shed or refused or quantized:
+        asc["brownout.shed"] = shed
+        asc["brownout.overloaded"] = refused
+        asc["brownout.quantized"] = quantized
+        denom = shed + refused + counters.get("serve.jobs.admitted", 0)
+        if denom:
+            asc["brownout_share"] = round((shed + refused) / denom, 4)
+    spawn = (snap.get("hists") or {}).get("fleet.autoscale.spawn_s")
+    if spawn:
+        h = Histogram.from_dict(spawn)
+        if h.count:
+            asc["spawn_s"] = {
+                "count": h.count, "p50_s": round(h.percentile(50), 3),
+                "p99_s": round(h.percentile(99), 3),
+                "max_s": round(h.max, 3)}
+    for g in ("fleet.autoscale.n_workers", "fleet.autoscale.n_peak",
+              "fleet.autoscale.backlog"):
+        if g in gauges:
+            asc[g[len("fleet.autoscale."):]] = gauges[g]
+    out["autoscale"] = asc
     return out
 
 
@@ -398,6 +434,16 @@ def main(argv=None) -> int:
         print("== fleet ==")
         for name, v in sorted(rep["fleet"].items()):
             print(f"  {name:<40s} {v:>12.0f}")
+    if rep["autoscale"]:
+        print("== autoscale ==")
+        for name, v in sorted(rep["autoscale"].items()):
+            if isinstance(v, dict):
+                print(f"  {name:<40s} n={v['count']:<5d} "
+                      f"p50={v['p50_s']:.3f}s p99={v['p99_s']:.3f}s "
+                      f"max={v['max_s']:.3f}s")
+            else:
+                shown = f"{v:.0f}" if float(v).is_integer() else f"{v:.4f}"
+                print(f"  {name:<40s} {shown:>12s}")
     if rep["gauges"]:
         print("== gauges ==")
         for name, v in sorted(rep["gauges"].items()):

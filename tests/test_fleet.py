@@ -21,7 +21,8 @@ import pytest
 from qrack_tpu import QEngineCPU
 from qrack_tpu import matrices as mat
 from qrack_tpu import telemetry as tele
-from qrack_tpu.fleet import (FleetFrontDoor, FleetSupervisor,
+from qrack_tpu.fleet import (AdoptionStalled, AutoscaleConfig, Autoscaler,
+                             FleetFrontDoor, FleetSupervisor,
                              NoHealthyWorkers, Placement, session_cost)
 from qrack_tpu.fleet import heartbeat as hb
 from qrack_tpu.fleet import rpc
@@ -333,7 +334,7 @@ def test_frontdoor_apply_retries_session_not_found():
     calls = {"n": 0}
 
     class _Adopting:
-        def submit(self, sid, circuit, tag=None):
+        def submit(self, sid, circuit, tag=None, priority=0):
             calls["n"] += 1
             if calls["n"] < 2:
                 raise rpc.FleetRemoteError("SessionNotFound", sid)
@@ -664,3 +665,330 @@ def test_fleet_observability_acceptance(tmp_path):
         assert {"fleet", "postmortem"} <= kinds
         for sid in sids:
             front.destroy_session(sid)
+
+
+# ---------------------------------------------------------------------------
+# autoscaling: spawn faults, elastic capacity, brownout ladder
+# ---------------------------------------------------------------------------
+
+def test_fleet_spawn_fault_specs_parse():
+    assert faults.parse_spec("fleet.spawn:hang:0").site == "fleet.spawn"
+    assert faults.parse_spec("fleet.spawn:raise:1").kind == "raise"
+    with pytest.raises(ValueError):
+        faults.load_env("fleet.spawner:hang:0")     # unknown site
+    with pytest.raises(ValueError):
+        faults.parse_spec("fleet.spawn:explode:0")  # unknown kind
+
+
+def test_spawn_faults_charge_budget_placement_unstuck(tmp_path):
+    """A hung boot (sleeper in the worker's place, never heartbeats)
+    must time out, reap the sleeper, and charge the NEW worker's
+    restart budget exactly like an organic boot failure — and a raise-
+    kind fault (exec dies instantly) the same — while placement keeps
+    serving on the existing workers throughout."""
+    sup = _mini_fleet(tmp_path, n=1, restart_threshold=2,
+                      ready_timeout_s=1.0)
+    # hang: boot_worker spawns the sleeper, wait_ready deadlines
+    faults.inject("fleet.spawn", "hang", times=2)
+    t0 = time.monotonic()
+    assert sup.boot_worker("wx", timeout_s=1.0) is False
+    h = sup._workers["wx"]
+    assert h.crashes == 1
+    assert h.next_restart_at > t0                  # backoff armed
+    assert h.breaker.snapshot()["consecutive_failures"] == 1
+    assert h.proc is not None and h.proc.poll() is not None  # reaped
+    assert sup.placement.state("wx") == "dead"
+    # placement is NOT stuck: the dead boot is unplaceable, w0 serves
+    assert sup.placement.place("s1", "cpu", 4) == "w0"
+    # second hung boot exhausts the threshold-2 budget ...
+    sup._respawn(h)
+    assert h.crashes == 2
+    # ... so the monitor's next restart attempt quarantines instead
+    sup._maybe_restart(h)
+    assert sup.placement.state("wx") == "quarantined"
+
+    # raise: the InjectedFault fires before Popen — no process at all
+    faults.clear()
+    faults.inject("fleet.spawn", "raise")
+    assert sup.boot_worker("wy", timeout_s=1.0) is False
+    hy = sup._workers["wy"]
+    assert hy.crashes == 1 and hy.proc is None
+    assert sup.placement.place("s2", "cpu", 4) == "w0"
+
+
+def test_scale_down_zero_loss_and_metrics_retention(tmp_path):
+    """Scale-down = drain → evict → re-place → adopt → retire.  Two
+    invariants pinned here: (a) the retired worker's session survives
+    on a peer with exact state, and (b) the retired incarnation's final
+    telemetry snapshot stays folded into the fleet merge keyed
+    (name, pid) — fleet counters must be monotonic across the retire,
+    never deflate."""
+    tele.enable()
+    tele.reset()
+    with _mini_fleet(tmp_path, n=2) as sup:
+        sup.start()
+        front = FleetFrontDoor(sup)
+        sids, oracles = [], []
+        for k in range(2):
+            sids.append(front.create_session(2, seed=20 + k,
+                                             rand_global_phase=False))
+            oracles.append(QEngineCPU(2, rng=QrackRandom(20 + k),
+                                      rand_global_phase=False))
+        # equal-cost sessions spread least-loaded: one per worker
+        assert {sup.owner_of(s) for s in sids} == {"w0", "w1"}
+        for sid, oracle in zip(sids, oracles):
+            for _ in range(2):
+                front.apply(sid, _bell())
+                _bell().Run(oracle)
+        # wait for the heartbeat ingest to carry all 4 completions
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            before = sup.metrics()["counters"].get(
+                "serve.jobs.completed", 0)
+            if before >= 4:
+                break
+            time.sleep(0.05)
+        assert before >= 4, sup.metrics()["counters"]
+
+        victim = sup.worker_names()[0]          # least-loaded tie -> w0
+        vpid = sup.stats()["workers"][victim]["pid"]
+        moved_sid = [s for s in sids if sup.owner_of(s) == victim][0]
+        out = sup.scale_down()
+        assert out is not None
+        assert out["migrated"] == {moved_sid: "w1"}
+        assert sup.worker_names() == ["w1"]
+
+        # (b) monotonic fleet counters + the incarnation still merged
+        m = sup.metrics()
+        assert m["counters"].get("serve.jobs.completed", 0) >= before
+        assert f"{victim}:{vpid}" in m["workers"]
+
+        # (a) the migrated session keeps serving with exact state
+        k = sids.index(moved_sid)
+        front.apply(moved_sid, _bell())
+        _bell().Run(oracles[k])
+        assert _fidelity(oracles[k].GetQuantumState(),
+                         front.get_state(moved_sid)) > 1 - 1e-12
+        # refuses to retire the last healthy worker
+        assert sup.scale_down() is None
+        for sid in sids:
+            front.destroy_session(sid)
+
+
+def test_scale_down_orphan_hits_bounded_wait_typed_error(
+        tmp_path, monkeypatch):
+    """A session evicted during scale-down whose re-placement fails is
+    STRANDED: migrating forever, no owner.  The front door must not
+    wait out the full routing timeout — the migrate deadline surfaces
+    the typed AdoptionStalled (with the not_adopted_yet counter), and
+    the state stays durable on the store."""
+    tele.enable()
+    tele.reset()
+    with _mini_fleet(tmp_path, n=2) as sup:
+        sup.start()
+        front = FleetFrontDoor(sup, route_timeout_s=60.0,
+                               migrate_timeout_s=0.3)
+        sid = front.create_session(2, seed=7, rand_global_phase=False)
+        front.apply(sid, _bell())
+        owner = sup.owner_of(sid)
+
+        def no_room(moved, exclude=None):
+            raise NoHealthyWorkers("injected: nowhere to re-place")
+
+        monkeypatch.setattr(sup.placement, "place_all", no_room)
+        out = sup.scale_down(owner)
+        assert out is not None and out["migrated"] == {}
+        assert owner not in sup.worker_names()
+        assert sup.owner_of(sid) is None
+        assert sid in sup.stats()["migrating"]
+
+        t0 = time.monotonic()
+        with pytest.raises(AdoptionStalled):
+            front.prob(sid, 0)
+        assert time.monotonic() - t0 < 10.0      # deadline, not timeout
+        assert tele.snapshot()["counters"].get(
+            "fleet.frontdoor.not_adopted_yet", 0) >= 1
+
+
+def test_scheduler_brownout_sheds_by_band():
+    from qrack_tpu.serve import Overloaded
+    from qrack_tpu.serve.scheduler import Job, Scheduler
+
+    s = Scheduler(max_depth=8, queue_budget_s=10.0,
+                  batch_window_s=0.0, max_batch=1)
+    s.set_brownout(1, shed_band=0, retry_in_s=0.25)
+    assert s.brownout_level() == 1
+    with pytest.raises(Overloaded) as ei:
+        s.submit(Job(None, "admin", priority=0))
+    assert ei.value.retry_in_s == 0.25
+    assert ei.value.level == 1 and ei.value.band == 0
+    s.submit(Job(None, "admin", priority=1))     # above the band: admitted
+    s.set_brownout(3)
+    with pytest.raises(Overloaded) as ei:
+        s.submit(Job(None, "admin", priority=5))  # level 3 refuses all
+    assert ei.value.level == 3 and ei.value.band is None
+    s.set_brownout(0)
+    s.submit(Job(None, "admin", priority=0))
+    assert s.depth() == 2
+
+
+def test_router_brownout_quantizes_borderline_dense(monkeypatch):
+    """Level 2's rung: an auto-routed circuit that would take the full
+    f32 dense stack lands on the compressed turboquant tier instead
+    while brownout is active — pinned modes are never overridden."""
+    from qrack_tpu.models.algorithms import quantum_volume_qcircuit
+    from qrack_tpu.route import router as router_mod
+
+    monkeypatch.delenv("QRACK_ROUTE", raising=False)
+    circ = quantum_volume_qcircuit(12, rng=QrackRandom(11))
+    base = router_mod.decide(circ, 12)
+    assert base.stack == "dense" and base.reason == "cost"
+    router_mod.set_brownout(True)
+    try:
+        d = router_mod.decide(circ, 12)
+        assert d.stack == "turboquant" and d.reason == "brownout"
+        monkeypatch.setenv("QRACK_ROUTE", "dense")   # tenant's explicit pin
+        assert router_mod.decide(circ, 12).stack == "dense"
+    finally:
+        router_mod.set_brownout(False)
+    assert router_mod.brownout_active() is False
+
+
+def test_frontdoor_brownout_ladder_order():
+    """The ladder's front-door rungs, strictly ordered: level 1 sheds
+    only at/below the band, level 2 adds nothing at the front door
+    (quantized routing is worker-side), level 3 refuses everything —
+    always BEFORE tag mint/routing, so a refusal provably never
+    executed."""
+    from qrack_tpu.serve import Overloaded
+
+    submitted = []
+
+    class _Client:
+        def submit(self, sid, circuit, tag=None, priority=0):
+            submitted.append(priority)
+            return True, {"ok": True}
+
+    class _BrownoutSup(_StubSup):
+        state = None
+
+        def brownout(self):
+            return self.state
+
+    sup = _BrownoutSup(_Client())
+    front = FleetFrontDoor(sup, route_timeout_s=5.0)
+
+    sup.state = {"level": 1, "shed_band": 0, "retry_in_s": 0.5}
+    with pytest.raises(Overloaded) as ei:
+        front.apply("s1", _bell(), priority=0)
+    assert ei.value.level == 1 and ei.value.band == 0
+    front.apply("s1", _bell(), priority=1)       # above the band
+    sup.state = {"level": 2, "shed_band": 0, "retry_in_s": 0.5}
+    front.apply("s1", _bell(), priority=1)       # level 2: still admitted
+    sup.state = {"level": 3, "shed_band": 0, "retry_in_s": 1.0}
+    with pytest.raises(Overloaded) as ei:
+        front.apply("s1", _bell(), priority=1)   # level 3 refuses all
+    assert ei.value.level == 3 and ei.value.retry_in_s == 1.0
+    sup.state = None
+    front.apply("s1", _bell(), priority=0)
+    assert submitted == [1, 1, 0]
+
+
+class _FakeScaleSup:
+    """Synthetic pressure source for ladder-ordering units — a fleet
+    pinned at n_max so capacity can never arrive."""
+
+    def __init__(self, n=2):
+        self.n = n
+        self.backlog = 0.0
+        self.levels = []
+
+    def pressure(self):
+        return {"n_live": self.n, "n_total": self.n,
+                "backlog": self.backlog, "load": 0.0,
+                "capacity": float(self.n),
+                "queue_wait_p99_s": 0.0, "latency_p99_s": 0.0}
+
+    def set_brownout(self, level, shed_band=0, retry_in_s=0.5):
+        self.levels.append(level)
+
+    def boot_worker(self, timeout_s=None):  # pragma: no cover — n_max
+        raise AssertionError("scale-up attempted at n_max")
+
+
+def test_autoscaler_ladder_escalates_and_calms_one_rung_at_a_time():
+    cfg = AutoscaleConfig(n_min=1, n_max=2, up_ticks=2, ladder_ticks=2,
+                          cooldown_s=0.0)
+    a = Autoscaler(cfg)
+    sup = _FakeScaleSup(n=2)
+    sup.backlog = 100.0                  # way past up_backlog per worker
+    for _ in range(10):
+        a.tick(sup)
+    assert a.level == 3
+    assert sup.levels[:3] == [1, 2, 3]   # strictly ordered, no skips
+    sup.backlog = 0.0
+    for _ in range(10):
+        a.tick(sup)
+    assert a.level == 0
+    assert sup.levels == [1, 2, 3, 2, 1, 0]  # symmetric de-escalation
+    d = a.stats()["decisions"]
+    for lv in (1, 2, 3):
+        assert d.get(f"brownout.level{lv}", 0) >= 1
+
+
+def test_autoscaler_closed_loop_scale_up_then_down(tmp_path, monkeypatch):
+    """The tentpole end-to-end on a real fleet: synthetic backlog
+    pressure drives the monitor-tick scaler to boot a real worker into
+    the warm path; pressure clearing drains the pool back down through
+    the zero-loss retire — both visible in the decision counters."""
+    box = {"backlog": 0.0}
+    with _mini_fleet(tmp_path, n=1, autoscale=AutoscaleConfig(
+            n_min=1, n_max=2, up_ticks=2, down_ticks=3,
+            cooldown_s=0.1, ladder_ticks=10_000,
+            boot_timeout_s=120.0)) as sup:
+        real_pressure = sup.pressure
+
+        def fake_pressure():
+            p = real_pressure()
+            p["backlog"] = box["backlog"]
+            p["queue_wait_p99_s"] = 0.0
+            return p
+
+        monkeypatch.setattr(sup, "pressure", fake_pressure)
+        sup.start()
+        assert sup.worker_names() == ["w0"]
+        box["backlog"] = 50.0
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if sup.worker_names() == ["w0", "w1"]:
+                break
+            time.sleep(0.1)
+        assert sup.worker_names() == ["w0", "w1"], sup.stats()
+        _wait_states(sup, {"healthy"}, timeout_s=60.0)
+
+        box["backlog"] = 0.0
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if len(sup.worker_names()) == 1:
+                break
+            time.sleep(0.1)
+        assert len(sup.worker_names()) == 1, sup.stats()
+
+        auto = sup.stats()["autoscale"]
+        assert auto["n_peak"] == 2
+        assert auto["decisions"].get("scale_up.backlog", 0) >= 1
+        assert auto["decisions"].get("scale_down.idle", 0) >= 1
+
+
+@pytest.mark.slow
+def test_fleet_surge_soak_smoke():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_soak", os.path.join(os.path.dirname(__file__),
+                                   "..", "scripts", "fleet_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    results = [soak.run_surge_trial(t, seed=321) for t in range(2)]
+    bad = [r for r in results if not r["ok"]]
+    assert not bad, bad

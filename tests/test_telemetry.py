@@ -350,3 +350,44 @@ def test_telemetry_report_smoke(tmp_path, capsys):
     for section in ("top gates", "compile caches", "exchange",
                     "layer events", "spans"):
         assert section in text
+
+
+def test_telemetry_report_autoscale_section(tmp_path, capsys):
+    tele.enable()
+    tele.inc("fleet.autoscale.decision.scale_up.backlog", 2)
+    tele.inc("fleet.autoscale.decision.brownout.level1")
+    tele.inc("fleet.autoscale.scale_up")
+    tele.inc("fleet.autoscale.scale_up_failed")
+    tele.inc("fleet.adopt.sessions", 3)       # stays in == fleet ==
+    tele.inc("serve.brownout.shed", 30)
+    tele.inc("serve.brownout.overloaded", 10)
+    tele.inc("serve.brownout.quantized", 5)
+    tele.inc("serve.jobs.admitted", 160)
+    tele.observe("fleet.autoscale.spawn_s", 2.0)
+    tele.observe("fleet.autoscale.spawn_s", 6.0)
+    tele.gauge("fleet.autoscale.n_workers", 3.0)
+    tele.gauge("fleet.autoscale.n_peak", 5.0)
+    out = tmp_path / "t.jsonl"
+    tele.write_jsonl(str(out))
+
+    mod = _load_report_module()
+    rep = mod.report(mod.load(str(out), aggregate=False), top=5)
+    asc = rep["autoscale"]
+    assert asc["decision.scale_up.backlog"] == 2
+    assert asc["decision.brownout.level1"] == 1
+    assert asc["scale_up"] == 1 and asc["scale_up_failed"] == 1
+    # brownout share counts front-door refusals over everything that
+    # asked for admission: (30+10) / (30+10+160)
+    assert asc["brownout_share"] == 0.2
+    assert asc["brownout.quantized"] == 5
+    assert asc["spawn_s"]["count"] == 2
+    assert asc["spawn_s"]["p50_s"] <= asc["spawn_s"]["p99_s"]
+    assert asc["n_workers"] == 3.0 and asc["n_peak"] == 5.0
+    # autoscale names move OUT of == fleet == (no double reporting)
+    assert not any(k.startswith("fleet.autoscale.") for k in rep["fleet"])
+    assert rep["fleet"]["fleet.adopt.sessions"] == 3
+
+    assert mod.main([str(out)]) == 0
+    text = capsys.readouterr().out
+    assert "== autoscale ==" in text
+    assert "brownout_share" in text
