@@ -62,7 +62,7 @@ def _remaining() -> float:
 
 
 def _workload_key() -> str:
-    if WORKLOAD in ("rcs", "xeb"):
+    if WORKLOAD in ("rcs", "xeb", "noise_traj"):
         return f"{WORKLOAD}_d{DEPTH}"   # depth only matters for these
     return WORKLOAD
 
@@ -137,12 +137,54 @@ def _make_fused_qft_fn(width: int, dtype):
     return fn
 
 
+def _make_noise_traj_fn(width: int, dtype):
+    """One batched Monte-Carlo trajectory window program: the noisy-RCS
+    circuit lowered under a depolarizing model, branch choices
+    pre-sampled host-side into runtime operands, ONE vmapped dispatch
+    over the whole B-trajectory axis (qrack_tpu/noise/trajectories.py).
+    Chained applies re-dispatch the SAME compiled program, so the wall
+    is the batched per-window dispatch cost and the honest HBM traffic
+    is window_ops passes of B stacked plane pairs (docs/NOISE.md)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from qrack_tpu.models import rcs as rcsm
+    from qrack_tpu.noise import NoiseModel, depolarizing
+    from qrack_tpu.noise import trajectories as traj
+
+    B = int(os.environ.get("QRACK_BENCH_TRAJ", "256"))
+    lam = float(os.environ.get("QRACK_BENCH_NOISE", "0.02"))
+    circuit = rcsm.rcs_qcircuit(width, DEPTH, seed=7)
+    model = NoiseModel(default=depolarizing(lam))
+    ops = traj.lower_noisy(circuit, model)
+    structure = traj.structure_of(ops)
+    operands = traj._sample_operands(ops, 7, list(range(B)), dtype)
+    prog = traj._program(width, structure, B, dtype, final=False)
+    state = {"weight": jnp.ones((B,), dtype=jnp.float32)}
+
+    def fn(planes):
+        planes, state["weight"] = prog(planes, state["weight"], *operands)
+        return planes
+
+    fn.already_compiled = True  # the trajectory program is jitted+donating
+    fn.traj_batch = B
+    fn.window_ops = len(ops)
+    fn.hbm_sweeps = len(ops)
+    planes_np = np.zeros((B, 2, 1 << width), dtype=np.float32)
+    planes_np[:, 0, 0] = 1.0
+    return fn, jnp.asarray(planes_np, dtype=dtype)
+
+
 def _make_fn(width: int):
     from qrack_tpu.models import qft as qftm
 
-    if WORKLOAD not in ("qft", "rcs", "xeb", "qft_unit", "grover"):
+    if WORKLOAD not in ("qft", "rcs", "xeb", "qft_unit", "grover",
+                        "noise_traj"):
         raise ValueError(f"unknown QRACK_BENCH workload {WORKLOAD!r}")
     dt = _bench_dtype()
+    if WORKLOAD == "noise_traj":
+        return _make_noise_traj_fn(width, dt)
     if WORKLOAD in ("rcs", "xeb"):
         from qrack_tpu.models import rcs as rcsm
 
@@ -375,6 +417,14 @@ def _measure(width: int, samples: int):
             st["fuse_lowering"] = body.fuse_lowering
             st["window_ops"] = body.window_ops
             st["hbm_sweeps_per_window"] = body.hbm_sweeps
+    if WORKLOAD == "noise_traj":
+        # per-sweep traffic is B stacked plane pairs: _emit multiplies
+        # the shared plane_pass_bytes formula by traj_batch
+        st["traj_batch"] = body.traj_batch
+        st["window_ops"] = body.window_ops
+        st["hbm_sweeps_per_window"] = body.hbm_sweeps
+        if st["avg"] > 0:
+            st["traj_per_s"] = round(body.traj_batch / st["avg"], 3)
     if WORKLOAD == "xeb":
         st["xeb_fidelity"] = round(_xeb_from_planes(planes, width), 6)
     return st
@@ -485,9 +535,13 @@ def _emit(width: int, stats: dict, label_suffix: str = "") -> None:
         # dense simulation is bandwidth-bound (2-4 flops/byte), so the
         # roofline fraction IS the MFU analogue: fraction of the device
         # class's HBM peak (v5e ~819 GB/s) the program sustains
+        # trajectory batches keep B kets resident and move all of them
+        # every sweep: B · plane bytes per pass (shared formula, so the
+        # implied bandwidth stays comparable across workloads)
+        batch = int(stats.get("traj_batch") or 1)
         sample = roofline.record(
             f"bench.{_workload_key()}",
-            passes * roofline.plane_pass_bytes(width, esize),
+            passes * batch * roofline.plane_pass_bytes(width, esize),
             stats["avg"], width=width, platform=stats.get("platform"))
         line["implied_hbm_gbps"] = sample["implied_hbm_gbps"]
         line["hbm_roofline_frac"] = sample["hbm_roofline_frac"]

@@ -615,23 +615,28 @@ class CheckpointStore:
             return False
         return str(tag).encode() in data.split(b"\n")
 
-    def wal_entries(self, sids: Optional[Iterable[str]] = None
-                    ) -> List[Tuple[str, int, object]]:
+    def wal_entries(self, sids: Optional[Iterable[str]] = None,
+                    with_meta: bool = False
+                    ) -> List[Tuple]:
         """[(sid, seq, circuit)] in submit order; damaged entries (torn
         writes at crash time) are skipped and removed.  With `sids`,
         only those sessions' entries are returned — scoped adoption
-        (fleet re-placement) must not read a live peer's journal."""
+        (fleet re-placement) must not read a live peer's journal.
+        With `with_meta`, 4-tuples (sid, seq, circuit, meta) — the
+        serve recovery path reads the entry tag to distinguish circuit
+        replays from journaled trajectory jobs (docs/NOISE.md)."""
         want = None if sids is None else set(sids)
         out = []
         for path, seq, sid in self._wal_files():
             if want is not None and sid not in want:
                 continue
             try:
-                circ, _ = load_circuit(path)
+                circ, meta = load_circuit(path)
             except (CheckpointCorrupt, CheckpointError):
                 self._unlink(path)
                 continue
-            out.append((sid, seq, circ))
+            out.append((sid, seq, circ, meta) if with_meta
+                       else (sid, seq, circ))
         return out
 
     def clear_wal(self, sids: Optional[Iterable[str]] = None) -> None:

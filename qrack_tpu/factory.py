@@ -192,9 +192,19 @@ def build_factory(layers: Sequence[str], **opts) -> Callable:
 
         return _counted(head, lambda n, **kw: QBdtHybrid(n, engine_factory=below, **kw))
     if head == "noisy":
+        noise = opts.get("noise")
+        if below is None:
+            # terminal form: the trajectory-rng QNoisy engine over a CPU
+            # oracle — branch choices come from (key, trajectory_id,
+            # app_seq) counters, not the engine's sequential rng stream
+            # (noise/channels.py, docs/NOISE.md)
+            from .noise.channels import QNoisy
+
+            model = opts.get("model")
+            return _counted(head, lambda n, **kw: QNoisy(
+                n, model=model, noise=noise, **kw))
         from .layers.noisy import QInterfaceNoisy
 
-        noise = opts.get("noise")
         return _counted(head, lambda n, **kw: QInterfaceNoisy(
             n, inner_factory=below, noise=noise, **kw))
     raise ValueError(f"unknown layer {head!r}")
@@ -215,7 +225,8 @@ def create_quantum_interface(layers: Union[str, Sequence[str]], qubit_count: int
             layers = OPTIMAL_MULTI
         else:
             layers = (layers,)
-    opts = {k: kwargs.pop(k) for k in ("noise", "devices", "n_pages", "dtype")
+    opts = {k: kwargs.pop(k) for k in ("noise", "model", "devices",
+                                       "n_pages", "dtype")
             if k in kwargs}
     if _tele._ENABLED:
         _tele.inc("factory.create_interface")

@@ -39,6 +39,24 @@ def rcs_layers(n: int, depth: int, seed: int):
     return plan
 
 
+def rcs_qcircuit(n: int, depth: int, seed: int):
+    """The RCS gate plan as a ``QCircuit`` gate list — the form the
+    noisy trajectory engine lowers (qrack_tpu/noise/trajectories.py).
+    ``QCircuitGate`` is a controlled-1q payload model, so the brick-wall
+    couplers are CZ instead of ISwap: same entangling topology,
+    payload-representable."""
+    from ..layers.qcircuit import QCircuit
+
+    cz = mat.phase_mtrx(1.0, -1.0)
+    c = QCircuit(n)
+    for roots, pairs in rcs_layers(n, depth, seed):
+        for q, g in enumerate(roots):
+            c.append_1q(q, _ROOTS[g])
+        for a, b in pairs:
+            c.append_ctrl((a,), b, cz, 1)
+    return c
+
+
 def _iswap_layer(planes, n: int, pairs):
     """A whole brick-wall ISwap layer as ONE transpose + ONE phase pass.
 

@@ -1,0 +1,37 @@
+"""Monte-Carlo noisy trajectories (docs/NOISE.md).
+
+Two halves of one contract:
+
+* :mod:`channels` — single-qubit Kraus channel algebra, the
+  :class:`NoiseModel` attachment policy, the counter-based
+  per-trajectory rng, and the sequential :class:`QNoisy` oracle engine
+  (factory terminal ``"noisy"``).
+* :mod:`trajectories` — the batched engine: (circuit, NoiseModel, B)
+  lowers into ONE window program with a leading trajectory axis, branch
+  choices pre-sampled host-side into runtime operands, dispatched
+  vmapped through the ``tpu.fuse.flush`` guarded site.
+
+The load-bearing property: a trajectory is a pure function of
+``(key, trajectory_id)`` — the batch engine and the sequential oracle
+draw the same uniforms at the same channel-application counters, so any
+single trajectory is reproducible in isolation (parity tests, soak
+oracle, checkpoint resume all lean on this).
+"""
+
+from .channels import (  # noqa: F401
+    ChannelError,
+    KrausChannel,
+    NoiseModel,
+    QNoisy,
+    amplitude_damping,
+    dephasing,
+    depolarizing,
+    kraus_channel,
+    traj_uniform,
+)
+from .trajectories import (  # noqa: F401
+    TrajectoryJob,
+    TrajectoryResult,
+    run_trajectories,
+    traj_chunk,
+)

@@ -75,6 +75,10 @@ SITES = (
     "pager.dispatch", "pager.exchange", "pager.device_get",
     "turboquant.dispatch", "turboquant_pager.exchange",
     "serve.dispatch", "serve.device_get",
+    # host-side branch pre-sampling for trajectory batches
+    # (noise/trajectories.py _sample_operands; docs/NOISE.md) — checked
+    # directly, the sampler is host numpy with no watchdog wrapper
+    "noise.sample",
     "checkpoint.save", "checkpoint.restore",
     # process-plane sites (fleet/): checked by the supervisor's monitor
     # tick and the worker's heartbeat writer, not by call_guarded —

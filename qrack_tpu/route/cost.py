@@ -201,8 +201,12 @@ def hbm_bytes(stack: str, f: CircuitFeatures,
     the PER-DEVICE number the budget is compared against."""
     k = knobs or RouteKnobs.from_env()
     w = max(f.width, 1)
+    # trajectory batches keep `shots` dense kets resident at once
+    # (noise/trajectories.py): the memory axis prices the BATCH, not
+    # one ket — B·16·2^w against the budget decides chunking
+    shots = max(int(getattr(f, "shots", 1)), 1)
     if stack == "dense":
-        return float(DENSE_BYTES_PER_AMP) * float(2 ** w)
+        return float(shots) * float(DENSE_BYTES_PER_AMP) * float(2 ** w)
     if stack == "qunit":
         blk = min(f.max_component, w)
         return float(DENSE_BYTES_PER_AMP) * float(2 ** blk)

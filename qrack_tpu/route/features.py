@@ -96,6 +96,9 @@ class CircuitFeatures:
     nn_fraction: float = 1.0   # |t - c| == 1 fraction of entangling gates
     max_component: int = 1     # largest entangled qubit block (QUnit bound)
     max_cut_crossings: int = 0  # QBdt bond-growth heuristic
+    shots: int = 1             # trajectory batch size: resident kets the
+    #                            job holds AT ONCE (noise/trajectories.py);
+    #                            dense HBM pricing scales by this
 
     @property
     def clifford_fraction(self) -> float:
@@ -124,12 +127,16 @@ class CircuitFeatures:
             "max_component": self.max_component,
             "max_cut_crossings": self.max_cut_crossings,
             "clifford_fraction": round(self.clifford_fraction, 4),
+            "shots": self.shots,
         }
 
 
-def extract_features(circuit, width: int) -> CircuitFeatures:
-    """One host-side pass over ``circuit.gates`` (layers/qcircuit.py)."""
-    f = CircuitFeatures(width=int(width))
+def extract_features(circuit, width: int,
+                     shots: int = 1) -> CircuitFeatures:
+    """One host-side pass over ``circuit.gates`` (layers/qcircuit.py).
+    `shots` > 1 marks a trajectory batch: the job keeps that many dense
+    kets resident at once, so memory-axis scoring multiplies by it."""
+    f = CircuitFeatures(width=int(width), shots=max(1, int(shots)))
     uf = _UnionFind(max(int(width), 1))
     pairs = set()
     degree: Dict[int, int] = {}

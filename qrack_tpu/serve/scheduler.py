@@ -114,7 +114,7 @@ class Job:
                  shape_key=None, priority: int = 0,
                  mutates: bool = True):
         self.session = session
-        self.kind = kind          # "circuit" | "call" | "admin"
+        self.kind = kind          # "circuit" | "call" | "trajectories" | "admin"
         self.circuit = circuit
         self.fn = fn
         self.shape_key = shape_key  # non-None => vmap-batchable
@@ -137,6 +137,10 @@ class Job:
 
     @property
     def batchable(self) -> bool:
+        # "trajectories" jobs are structurally non-batchable: their
+        # batch axis is pre-stacked (B trajectories of ONE tenant), so
+        # the batcher must never join two tenants into one trajectory
+        # dispatch (docs/NOISE.md)
         return self.kind == "circuit" and self.shape_key is not None
 
 

@@ -98,6 +98,12 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+# WAL-entry tag prefix marking a journaled trajectory job; the suffix is
+# the JSON spec (B, key, NoiseModel) recover() re-runs deterministically
+# (qrack_tpu/noise/, docs/NOISE.md)
+TRAJ_TAG = "::traj::"
+
+
 class QrackService:
     def __init__(self, engine_layers: Union[str, Sequence[str]] = "tpu",
                  *, max_depth: Optional[int] = None,
@@ -317,6 +323,79 @@ class QrackService:
             sess.end_job(ok=False)
             raise
 
+    def submit_trajectories(self, sid: str, circuit, model,
+                            trajectories: int, *, key: int = 0,
+                            priority: int = 0,
+                            tag: Optional[str] = None) -> JobHandle:
+        """Queue a Monte-Carlo trajectory batch: B noisy unravelings of
+        `circuit` under NoiseModel `model`, vmapped into one (chunked)
+        dispatch (qrack_tpu/noise/, docs/NOISE.md).  The handle resolves
+        to a :class:`~qrack_tpu.noise.TrajectoryResult` — per-trajectory
+        samples/expectations plus the channel-averaged aggregate.
+
+        Pricing is per-trajectory-batch, not per-ket: the router
+        features carry ``shots=B``, so B·16·2^w is compared against the
+        HBM budget and the batch is CHUNKED down to fit rather than
+        admitted at full resident size (route.traj.* gauges).  The
+        trajectory axis is pre-stacked: the job is structurally
+        non-batchable, so the batcher can never join two tenants into
+        one trajectory batch.
+
+        Journal + recovery: the WAL entry carries the circuit plus a
+        trajectory spec tag (B, key, model).  Because every trajectory's
+        randomness is the (key, trajectory_id, app_seq) counters, a
+        crash-interrupted job replays bit-identically at recover() —
+        the "rng position" IS the counter coordinate, nothing else to
+        persist."""
+        sess = self.sessions.get(sid)
+        B = int(trajectories)
+        from ..noise import trajectories as _traj
+        from ..route import cost as _cost
+        from ..route import features as _feat
+
+        width = sess.width
+        knobs = _cost.RouteKnobs.from_env()
+        if width > knobs.dense_max_qb:
+            from ..route.router import MisrouteError
+
+            raise MisrouteError(
+                f"trajectory batch needs dense planes: width {width} > "
+                f"dense cap {knobs.dense_max_qb}")
+        f = _feat.extract_features(circuit, width, shots=B)
+        batch_bytes = _cost.hbm_bytes("dense", f, knobs)
+        budget = _cost.hbm_budget_bytes(knobs)
+        chunk = _traj.traj_chunk(width, B)
+        if _tele._ENABLED:
+            _tele.gauge("route.traj.hbm_bytes", batch_bytes)
+            _tele.gauge("route.traj.chunk", chunk)
+            if batch_bytes > budget:
+                _tele.inc("route.traj.chunked")
+
+        def run(engine):
+            return _traj.run_trajectories(circuit, model, B, width=width,
+                                          key=key)
+
+        job = Job(sess, "trajectories", fn=run, priority=priority,
+                  mutates=False)
+        job.tag = tag
+        if self.store is not None:
+            import json as _json
+
+            spec = _json.dumps({"B": B, "key": int(key),
+                                "model": model.to_dict(), "tag": tag},
+                               sort_keys=True)
+            job.wal_path = self.store.wal_append(sid, circuit,
+                                                 tag=TRAJ_TAG + spec)
+        sess.begin_job()
+        try:
+            return self.scheduler.submit(job)
+        except BaseException:
+            sess.end_job(ok=False)
+            if job.wal_path is not None:
+                self.store.wal_remove(job.wal_path)
+                job.wal_path = None
+            raise
+
     def apply(self, sid: str, circuit, priority: int = 0,
               timeout: Optional[float] = 120.0):
         return self.submit(sid, circuit, priority=priority).result(timeout)
@@ -474,10 +553,32 @@ class QrackService:
                 recovered.append(sid)
             stale_set = set(stale)
             scope = None if sids is None else recovered
-            for sid, seq, circuit in self.store.wal_entries(sids=scope):
+            trajectories = {}
+            for sid, seq, circuit, meta in self.store.wal_entries(
+                    sids=scope, with_meta=True):
                 try:
                     sess = self.sessions.get(sid)
                 except SessionNotFound:
+                    continue
+                entry_tag = str(meta.get("tag") or "")
+                if entry_tag.startswith(TRAJ_TAG):
+                    # journaled trajectory job: session state is not its
+                    # base (trajectories run on fresh batch kets), so it
+                    # replays even for stale sessions, and its rng
+                    # positions are the (key, trajectory_id, app_seq)
+                    # counters in the spec — bit-identical re-run
+                    import json as _json
+
+                    from ..noise import run_trajectories
+                    from ..noise.channels import NoiseModel
+
+                    spec = _json.loads(entry_tag[len(TRAJ_TAG):])
+                    res = run_trajectories(
+                        circuit, NoiseModel.from_dict(spec["model"]),
+                        int(spec["B"]), width=sess.width,
+                        key=int(spec["key"]))
+                    trajectories.setdefault(sid, []).append(res)
+                    replayed += 1
                     continue
                 if sid in stale_set:
                     skipped += 1  # base is wrong — replay would be too
@@ -494,7 +595,8 @@ class QrackService:
             self.store.clear_wal(sids=scope)
             return {"sessions": recovered, "wal_replayed": replayed,
                     "wal_skipped": skipped, "wal_deduped": deduped,
-                    "recovered_stale": stale}
+                    "recovered_stale": stale,
+                    "trajectories": trajectories}
 
         job = Job(None, "admin", fn=do)
         try:

@@ -42,8 +42,9 @@ if _TESTS not in sys.path:
 from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
 
 __all__ = ["REPO", "N", "_ops", "STACKS", "ROUTED_TQ_LANE",
-           "ROUTED_TQ_FLOOR", "routed_tq_env", "fidelity", "submit_retry",
-           "resilience_up", "resilience_down", "soak_main"]
+           "ROUTED_TQ_FLOOR", "TRAJECTORY_LANES", "routed_tq_env",
+           "fidelity", "submit_retry", "resilience_up", "resilience_down",
+           "soak_main"]
 
 # stacks that exercise each guarded dispatch family; the second pager
 # lane forces the placement planner on so remapped windows soak too,
@@ -67,6 +68,20 @@ STACKS = [
 # the quantized floor — 16-bit requantization is legitimate loss.
 ROUTED_TQ_LANE = ("route", {"bits": 16, "chunk_qb": 3, "block_pow": 2})
 ROUTED_TQ_FLOOR = 1 - 1e-5
+
+
+# trajectory-batch lanes (noise_soak.py): the batched Monte-Carlo
+# engine vs the per-trajectory sequential QNoisy CPU oracle at fixed
+# keys, with window/chunk geometry varied so the parity claim covers
+# whole-stream, per-op, and chunked dispatch shapes (docs/NOISE.md).
+# Each entry is (label, env) where env sets the trajectory knobs for
+# the trial and is removed afterwards.
+TRAJECTORY_LANES = [
+    ("traj", {}),
+    ("traj-window1", {"QRACK_NOISE_TRAJ_WINDOW": "1"}),
+    ("traj-window16", {"QRACK_NOISE_TRAJ_WINDOW": "16"}),
+    ("traj-chunk2", {"QRACK_NOISE_TRAJ_CHUNK": "2"}),
+]
 
 
 def routed_tq_env(on: bool = True) -> None:

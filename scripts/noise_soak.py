@@ -1,0 +1,161 @@
+"""Randomized trajectory-parity soak: the batched Monte-Carlo channel
+engine (qrack_tpu.noise) vs the per-trajectory sequential QNoisy CPU
+oracle at fixed counter-based keys.
+
+Each trial builds a seeded random circuit over the fusable 1q +
+controlled vocabulary, attaches a random NoiseModel (depolarizing /
+dephasing / amplitude-damping, sometimes per-qubit), and runs ONE
+batched ``run_trajectories`` call with ``keep_planes=True``.  The
+oracle is B independent sequential ``QNoisy`` engines at the SAME
+``(key, trajectory_id)`` pairs — the rng determinism contract
+(docs/NOISE.md) says every trajectory in the batch must be
+bit-reproducible from its counter coordinates alone, so the verdict is
+per-trajectory fidelity ~1.0 against the oracle ket AND matching
+importance weights (the amplitude-damping lane exercises the
+weighted non-unitary path; unitary channels keep weight == 1).
+
+Trials cycle through ``_soak_common.TRAJECTORY_LANES`` so the parity
+claim covers whole-stream, window-1, window-16, and chunked dispatch
+geometry — the same program-structure axes tests/test_noise_trajectories.py
+pins, but under a randomized circuit/model distribution.
+
+Every third trial additionally arms the ``noise.sample`` fault site
+(resilience/faults.py) with a one-shot ``raise`` spec: the host-side
+branch pre-sampler must surface the typed ``InjectedFault`` BEFORE any
+device dispatch, and the healed retry must still match the oracle —
+injection may cost a batch, never corrupt one.
+
+Usage:
+    python scripts/noise_soak.py [trials] [seed]
+
+Defaults: 24 trials, seed 0.  Exit 0 = all trials oracle-equivalent.
+One JSON line per trial; rerun with ``1 <seed>`` after editing the
+range to reproduce a failure.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _soak_common import (TRAJECTORY_LANES, fidelity,  # noqa: E402
+                          resilience_down, resilience_up, soak_main)
+
+import numpy as np  # noqa: E402
+
+from qrack_tpu import resilience as res  # noqa: E402
+from qrack_tpu import telemetry as tele  # noqa: E402
+from qrack_tpu.layers.qcircuit import QCircuit  # noqa: E402
+from qrack_tpu.noise import (NoiseModel, QNoisy,  # noqa: E402
+                             amplitude_damping, dephasing, depolarizing,
+                             run_trajectories)
+from qrack_tpu.resilience.errors import InjectedFault  # noqa: E402
+
+W = 4    # trajectory soak width: 2^W dense kets x B stay CPU-cheap
+B = 6    # trajectories per batch
+
+_SQ2 = 1.0 / np.sqrt(2.0)
+_H = np.array([[_SQ2, _SQ2], [_SQ2, -_SQ2]], dtype=np.complex128)
+_X = np.array([[0, 1], [1, 0]], dtype=np.complex128)
+_Z = np.array([[1, 0], [0, -1]], dtype=np.complex128)
+_S = np.array([[1, 0], [0, 1j]], dtype=np.complex128)
+_T = np.array([[1, 0], [0, np.exp(1j * np.pi / 4)]], dtype=np.complex128)
+
+
+def _ry(theta: float) -> np.ndarray:
+    c, s = np.cos(theta / 2), np.sin(theta / 2)
+    return np.array([[c, -s], [s, c]], dtype=np.complex128)
+
+
+def _random_circuit(rng) -> QCircuit:
+    c = QCircuit(W)
+    for _ in range(int(rng.integers(6, 14))):
+        r = float(rng.random())
+        t = int(rng.integers(0, W))
+        if r < 0.55:
+            m = (_H, _X, _Z, _S, _T)[int(rng.integers(0, 5))]
+            c.append_1q(t, m)
+        elif r < 0.8:
+            c.append_1q(t, _ry(float(rng.uniform(0, 2 * np.pi))))
+        else:
+            ctrl = (t + 1 + int(rng.integers(0, W - 1))) % W
+            c.append_ctrl((ctrl,), t, _X, 1)
+    return c
+
+
+def _random_model(rng) -> NoiseModel:
+    mk = (lambda: depolarizing(float(rng.uniform(0.02, 0.25))),
+          lambda: dephasing(float(rng.uniform(0.05, 0.4))),
+          lambda: amplitude_damping(float(rng.uniform(0.05, 0.35))))
+    default = mk[int(rng.integers(0, 3))]()
+    per_qubit = {}
+    if rng.integers(0, 2):  # sometimes a per-qubit override channel
+        per_qubit[int(rng.integers(0, W))] = [mk[int(rng.integers(0, 3))]()]
+    return NoiseModel(default=default, per_qubit=per_qubit)
+
+
+def run_trial(trial: int, seed: int) -> dict:
+    rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
+    lane, env = TRAJECTORY_LANES[trial % len(TRAJECTORY_LANES)]
+    inject = trial % 3 == 2
+    key = (seed << 16) + trial + 1
+    info = {"trial": trial, "lane": lane, "inject": inject, "key": key}
+
+    for k, v in env.items():
+        os.environ[k] = v
+    resilience_up()
+    tele.enable()
+    tele.reset()
+    try:
+        circuit = _random_circuit(rng)
+        model = _random_model(rng)
+        if inject:
+            # one-shot typed failure from the host-side pre-sampler:
+            # must fire BEFORE dispatch, heal after one batch
+            res.faults.inject("noise.sample", "raise", times=1)
+            try:
+                run_trajectories(circuit, model, B, width=W, key=key)
+                info["injected_fired"] = False
+            except InjectedFault:
+                info["injected_fired"] = True
+        result = run_trajectories(circuit, model, B, width=W, key=key,
+                                  keep_planes=True)
+        worst = 1.0
+        wdiff = 0.0
+        for i, tid in enumerate(result.trajectory_ids):
+            oracle = QNoisy(W, model=model, key=key, trajectory_id=int(tid),
+                            inner_layers="cpu")
+            oracle.run_circuit(circuit)
+            ket = np.asarray(oracle.GetQuantumState())
+            batch = result.planes[i][0] + 1j * result.planes[i][1]
+            worst = min(worst, fidelity(batch, ket))
+            wdiff = max(wdiff, abs(float(result.weights[i])
+                                   - float(oracle.weight)))
+        snap = tele.snapshot()["counters"]
+        info["worst_fidelity"] = worst
+        info["max_weight_diff"] = wdiff
+        info["chunks"] = result.chunks
+        info["fault_counter"] = snap.get("resilience.fault.noise.sample.raise",
+                                         0)
+        ok = worst > 1 - 1e-9 and wdiff < 1e-5
+        if inject:
+            ok = ok and info["injected_fired"] and info["fault_counter"] >= 1
+        info["ok"] = bool(ok)
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        for k in env:
+            os.environ.pop(k, None)
+        resilience_down()
+        tele.disable()
+        tele.reset()
+    return info
+
+
+def main(argv) -> int:
+    return soak_main(argv, run_trial, default_trials=24)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

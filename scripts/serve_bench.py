@@ -51,9 +51,20 @@ Clifford tenant additionally rides the routed phase only: past the
 dense cap there IS no forced baseline — that impossibility is the
 routing subsystem's reason to exist.
 
+NOISY mode (--noisy, docs/NOISE.md): one noisy-trajectory tenant —
+noisy-RCS circuits under a depolarizing model, B=256 trajectories per
+submission through QrackService.submit_trajectories (ONE vmapped
+dispatch per window, exactly one trace across all rounds) — plus an
+automatic child process measuring the sequential per-trajectory QNoisy
+fallback at identical (key, trajectory_id) counters.  The headline is
+the trajectories/s ratio (acceptance: >= 5x batched); docs/SERVING.md
+and docs/NOISE.md record the measured ratio.
+
 Usage:
     python scripts/serve_bench.py [--width 16] [--jobs 8] [--rounds 4]
                                   [--layers tpu] [--window-ms 50] [--json]
+    python scripts/serve_bench.py --noisy [--noisy-width 14]
+                                  [--noisy-traj 256] [--noisy-depth 4]
     python scripts/serve_bench.py --mixed [--clifford-width 20]
                                   [--qaoa-width 12] [--wide-width 100]
     python scripts/serve_bench.py --loadgen [--tenants 1000]
@@ -494,6 +505,112 @@ def run_mixed(args) -> dict:
     return res
 
 
+def measure_noisy_sequential(args) -> dict:
+    """The sequential-trajectory fallback: the SAME trajectory engine,
+    the SAME (key, trajectory_id) counters, but one trajectory per
+    dispatch — what a caller gets without the batched axis.  Runs in
+    the A/B child process.  Completion stays devget-honest (every
+    ``run_trajectories`` call devgets its outputs in
+    TrajectoryJob.step); trajectory 0 runs once untimed first so the
+    single batch-1 trace lands outside the wall, mirroring the batched
+    side's steady-round measurement."""
+    from qrack_tpu.models.rcs import rcs_qcircuit
+    from qrack_tpu.noise import NoiseModel, depolarizing, run_trajectories
+
+    circuit = rcs_qcircuit(args.noisy_width, args.noisy_depth, seed=7)
+    model = NoiseModel(default=depolarizing(args.noisy_lam))
+    run_trajectories(circuit, model, 1, width=args.noisy_width, key=7,
+                     trajectory_ids=[0])  # warm the batch-1 program
+    t0 = time.perf_counter()
+    for tid in range(args.noisy_traj):
+        run_trajectories(circuit, model, 1, width=args.noisy_width,
+                         key=7, trajectory_ids=[tid])
+    wall = time.perf_counter() - t0
+    return {"sequential": True, "wall_s": round(wall, 6),
+            "traj_per_s": round(args.noisy_traj / wall, 3) if wall else 0}
+
+
+def run_noisy(args) -> dict:
+    """Noisy-trajectory tenant class (docs/NOISE.md): noisy-RCS circuits
+    under a depolarizing model, B trajectories per submission, through
+    QrackService.submit_trajectories — ONE vmapped dispatch per window,
+    devget-honest completion inside TrajectoryJob.step.  Round 0 pays
+    the single structure-keyed trace; steady rounds must be compile
+    hits (the JSON records compile.noise counters so "exactly 1 trace"
+    is checkable from the output).  An automatic child process then
+    measures the sequential per-trajectory fallback at identical
+    (key, trajectory_id) counters; the headline is the trajectories/s
+    ratio (acceptance: >= 5x batched)."""
+    from qrack_tpu.models.rcs import rcs_qcircuit
+    from qrack_tpu.noise import NoiseModel, depolarizing
+
+    tele.enable()
+    tele.reset()
+    model = NoiseModel(default=depolarizing(args.noisy_lam))
+    svc = QrackService(engine_layers=args.layers,
+                       queue_budget_ms=600_000.0)
+    walls = []
+    try:
+        sid = svc.create_session(args.noisy_width, seed=0)
+        for _ in range(args.noisy_rounds):
+            # fresh circuit OBJECT per round (tenants build their own);
+            # the trajectory ProgramCache keys on structure, not object
+            circ = rcs_qcircuit(args.noisy_width, args.noisy_depth, seed=7)
+            t0 = time.perf_counter()
+            h = svc.submit_trajectories(sid, circ, model, args.noisy_traj,
+                                        key=7)
+            h.result(timeout=600)
+            walls.append(time.perf_counter() - t0)
+    finally:
+        svc.close()
+    snap = tele.snapshot()["counters"]
+    steady = float(np.median(walls[1:] or walls))
+    batched_rate = args.noisy_traj / steady if steady else 0.0
+
+    # sequential A/B child: fresh process, CPU-pinned like _run_child's
+    # cpu children (the axon sitecustomize can hang plugin init)
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--noisy",
+         "--seq-child", "--json",
+         "--noisy-width", str(args.noisy_width),
+         "--noisy-traj", str(args.noisy_traj),
+         "--noisy-depth", str(args.noisy_depth),
+         "--noisy-lam", str(args.noisy_lam)],
+        capture_output=True, text=True, env=env, timeout=3600)
+    if proc.returncode != 0:
+        raise RuntimeError("sequential A/B child failed:\n"
+                           + proc.stderr[-2000:])
+    out = proc.stdout
+    seq = json.loads(out[out.index("{"):])
+    speedup = batched_rate / max(seq["traj_per_s"], 1e-9)
+    window_misses = snap.get("compile.noise.window.miss", 0)
+    res = {
+        "mode": "noisy",
+        "width": args.noisy_width, "trajectories": args.noisy_traj,
+        "depth": args.noisy_depth, "lam": args.noisy_lam,
+        "rounds": args.noisy_rounds, "layers": args.layers,
+        "batched_cold_wall_s": round(walls[0], 6),
+        "batched_steady_wall_s": round(steady, 6),
+        "traj_per_s_batched": round(batched_rate, 3),
+        "sequential_wall_s": seq["wall_s"],
+        "traj_per_s_sequential": seq["traj_per_s"],
+        "speedup_trajectories": round(speedup, 3),
+        "compile_noise_misses": snap.get("compile.noise.miss", 0),
+        "compile_noise_hits": snap.get("compile.noise.hit", 0),
+        "compile_noise_window_misses": window_misses,
+        "chunks": snap.get("noise.traj.chunks", 0),
+        # all rounds, all windows, ONE trace of the vmapped program
+        "single_trace": bool(window_misses == 1),
+        "pass_5x": bool(speedup >= 5.0),
+    }
+    tele.gauge("serve.bench.noisy_traj_per_s", res["traj_per_s_batched"])
+    tele.gauge("serve.bench.noisy_speedup", res["speedup_trajectories"])
+    return res
+
+
 def run(args) -> dict:
     tele.enable()
     tele.reset()
@@ -570,6 +687,22 @@ def main(argv=None) -> int:
     ap.add_argument("--wide-width", type=int, default=100,
                     help="extra routed-only Clifford tenant width (no "
                          "forced baseline possible; 0 disables)")
+    ap.add_argument("--noisy", action="store_true",
+                    help="noisy-trajectory tenant class: noisy-RCS "
+                         "under a depolarizing model, B trajectories "
+                         "per submission via submit_trajectories, with "
+                         "an automatic sequential per-trajectory A/B "
+                         "child (docs/NOISE.md, docs/SERVING.md)")
+    ap.add_argument("--seq-child", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: sequential A/B
+    ap.add_argument("--noisy-width", type=int, default=14)
+    ap.add_argument("--noisy-traj", type=int, default=256,
+                    help="trajectories per batch (default 256)")
+    ap.add_argument("--noisy-depth", type=int, default=4)
+    ap.add_argument("--noisy-lam", type=float, default=0.02,
+                    help="depolarizing parameter")
+    ap.add_argument("--noisy-rounds", type=int, default=3,
+                    help="batched rounds; round 0 pays the one trace")
     ap.add_argument("--loadgen", action="store_true",
                     help="open/closed-loop load generator over O(1000) "
                          "tenants with an automatic QRACK_SERVE_"
@@ -606,6 +739,30 @@ def main(argv=None) -> int:
     ap.add_argument("--lg-seed", type=int, default=42)
     args = ap.parse_args(argv)
 
+    if args.seq_child:
+        print(json.dumps(measure_noisy_sequential(args), sort_keys=True))
+        return 0
+    if args.noisy:
+        res = run_noisy(args)
+        if args.json:
+            print(json.dumps(res, indent=1, sort_keys=True))
+        else:
+            print(f"noisy trajectories w={res['width']} "
+                  f"B={res['trajectories']} depth={res['depth']} "
+                  f"lam={res['lam']} (devget-honest)")
+            print(f"  batched : cold {res['batched_cold_wall_s'] * 1e3:9.1f}"
+                  f" ms, steady {res['batched_steady_wall_s'] * 1e3:9.1f} ms"
+                  f" -> {res['traj_per_s_batched']:9.1f} traj/s")
+            print(f"  sequential fallback: {res['sequential_wall_s'] * 1e3:9.1f}"
+                  f" ms -> {res['traj_per_s_sequential']:9.1f} traj/s")
+            print(f"  speedup {res['speedup_trajectories']:.2f}x | "
+                  f"compile miss={res['compile_noise_misses']:.0f} "
+                  f"hit={res['compile_noise_hits']:.0f} "
+                  f"traces={res['compile_noise_window_misses']:.0f} "
+                  f"(single_trace={res['single_trace']})")
+            print(f"  acceptance (>=5x trajectories/s): "
+                  f"{'PASS' if res['pass_5x'] else 'FAIL'}")
+        return 0 if res["pass_5x"] else 1
     if args.ab_child:
         res = measure_loadgen(args, pipeline=args.lg_pipeline != 0)
         print(json.dumps(res, sort_keys=True))

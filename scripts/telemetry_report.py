@@ -153,6 +153,7 @@ def report(snap: dict, top: int) -> dict:
         "serve": {},
         "route": {},
         "compression": {},
+        "noise": {},
         "roofline": {},
         "checkpoint": {},
         "elastic": {},
@@ -196,6 +197,8 @@ def report(snap: dict, top: int) -> dict:
             out["serve"][k] = v
         elif k.startswith("route."):
             out["route"][k] = v
+        elif k.startswith("noise."):
+            out["noise"][k] = v
         elif k.startswith("checkpoint."):
             out["checkpoint"][k] = v
         elif k.startswith("elastic."):
@@ -291,6 +294,20 @@ def report(snap: dict, top: int) -> dict:
             if counters.get(k):
                 comp[k] = counters[k]
     out["compression"] = comp
+    # noise: the Monte-Carlo trajectory engine (docs/NOISE.md) — batch
+    # geometry (trajectories per batch, HBM chunk rate), the devget-
+    # honest trajectories/s gauge, and the single-trace proof
+    # (compile.noise.window miss_ratio lives in == compile caches ==)
+    nz = out["noise"]
+    batches = nz.get("noise.traj.batches", 0)
+    if batches:
+        nz["trajectories_per_batch"] = round(
+            nz.get("noise.traj.trajectories", 0) / batches, 2)
+        nz["chunk_rate"] = round(
+            nz.get("noise.traj.chunked", 0) / batches, 4)
+    for g in ("noise.traj.rate", "noise.traj.chunk_size"):
+        if g in gauges:
+            nz[g] = gauges[g]
     # roofline: achieved bandwidth per guarded dispatch site — GB/s
     # percentiles from the implied-bandwidth histograms (merged hists
     # under --all/--fleet report merged percentiles, same as SLO),
@@ -394,6 +411,11 @@ def main(argv=None) -> int:
         print("== routing ==")
         for name, v in sorted(rep["route"].items()):
             print(f"  {name:<40s} {v:>12.3f}")
+    if rep["noise"]:
+        print("== noise ==")
+        for name, v in sorted(rep["noise"].items()):
+            shown = f"{v:.0f}" if float(v).is_integer() else f"{v:.3f}"
+            print(f"  {name:<40s} {shown:>12s}")
     if rep["compression"]:
         print("== compression ==")
         for name, v in sorted(rep["compression"].items()):

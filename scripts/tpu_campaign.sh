@@ -204,6 +204,20 @@ run_stage xeb_w22 300 env QRACK_BENCH=xeb QRACK_BENCH_QB=22 \
   QRACK_BENCH_QB_FIRST=22 QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
   QRACK_BENCH_BUDGET=280 python bench.py
 
+# ---- noisy Monte-Carlo trajectories: ONE vmapped batch (B=256) vs the
+#      same window program without the trajectory axis (B=1, _seq
+#      suffix) — the pair's traj_per_s fields are the on-chip
+#      batched-vs-sequential ratio (docs/NOISE.md) and both lines get
+#      sentinel verdicts + the B-scaled roofline honesty clamp.
+run_stage noise_traj_w16 420 env QRACK_BENCH=noise_traj \
+  QRACK_BENCH_QB=16 QRACK_BENCH_QB_FIRST=16 QRACK_BENCH_TRAJ=256 \
+  QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 QRACK_BENCH_BUDGET=390 \
+  python bench.py
+run_stage noise_traj_w16_seq 420 env QRACK_BENCH=noise_traj \
+  QRACK_BENCH_QB=16 QRACK_BENCH_QB_FIRST=16 QRACK_BENCH_TRAJ=1 \
+  QRACK_BENCH_SUFFIX=_seq QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
+  QRACK_BENCH_BUDGET=390 python bench.py
+
 # ---- per-gate microbench + hbm-limit width ------------------------------
 run_stage microbench_w22 480 python scripts/microbench.py 22 8
 run_stage turboquant_w28 600 python scripts/turboquant_bench.py 28 8 4 3

@@ -1,0 +1,148 @@
+"""Channel algebra units (qrack_tpu/noise/channels.py): CPTP
+completeness, sampling rule, branch semantics, serialization, and the
+counter-based rng determinism contract (docs/NOISE.md)."""
+
+import numpy as np
+import pytest
+
+from qrack_tpu.noise import (ChannelError, KrausChannel, NoiseModel,
+                             QNoisy, amplitude_damping, dephasing,
+                             depolarizing, kraus_channel, traj_uniform)
+from qrack_tpu.noise.channels import BRANCH_DOMAIN, MEASURE_DOMAIN
+
+_I2 = np.eye(2, dtype=np.complex128)
+
+
+def _completeness(ch: KrausChannel) -> np.ndarray:
+    return sum(k.conj().T @ k for k in ch.kraus)
+
+
+@pytest.mark.parametrize("ch", [
+    depolarizing(0.1), depolarizing(0.75),
+    dephasing(0.3), amplitude_damping(0.4),
+])
+def test_builtin_channels_are_cptp(ch):
+    assert np.allclose(_completeness(ch), _I2, atol=1e-12)
+    assert abs(sum(ch.priors) - 1.0) < 1e-12
+    assert all(p >= 0 for p in ch.priors)
+
+
+def test_non_cptp_kraus_rejected():
+    with pytest.raises(ChannelError):
+        kraus_channel("bad", [np.array([[1, 0], [0, 0.5]])])
+    # scaling a valid set breaks sum K+K = I too
+    with pytest.raises(ChannelError):
+        kraus_channel("bad2", [1.1 * k for k in dephasing(0.2).kraus])
+
+
+def test_depolarizing_branch_order_and_priors():
+    """Branch order (X, Y, Z, I) with priors (l/4, l/4, l/4, 1-3l/4):
+    inverse-CDF sampling then reproduces the reference's
+    ``Rand() < 0.75*lam -> uniform Pauli`` rule
+    (QInterfaceNoisy::DepolarizingChannelWeak1Qb)."""
+    lam = 0.2
+    ch = depolarizing(lam)
+    assert ch.unitary
+    X = np.array([[0, 1], [1, 0]], dtype=complex)
+    Y = np.array([[0, -1j], [1j, 0]], dtype=complex)
+    Z = np.array([[1, 0], [0, -1]], dtype=complex)
+    for i, pauli in enumerate((X, Y, Z, _I2)):
+        m = ch.branch_matrix(i)
+        # branch matrices are the NORMALIZED unitaries K_i / sqrt(q_i)
+        assert np.allclose(m.conj().T @ m, _I2, atol=1e-12)
+        assert np.allclose(m @ pauli.conj().T, np.eye(2) * (m @ pauli.conj().T)[0, 0])
+    assert np.allclose(ch.priors[:3], [lam / 4] * 3)
+    assert abs(ch.priors[3] - (1 - 3 * lam / 4)) < 1e-12
+    # inverse CDF: u below 0.75*lam picks a Pauli, above picks identity
+    assert ch.sample(0.75 * lam - 1e-9) in (0, 1, 2)
+    assert ch.sample(0.75 * lam + 1e-9) == 3
+    assert ch.sample(0.0) == 0
+    assert ch.sample(1.0 - 1e-12) == 3
+
+
+def test_sample_is_inverse_cdf():
+    ch = dephasing(0.3)  # branches [sqrt(p) Z, sqrt(1-p) I]
+    assert ch.sample(0.0) == 0
+    assert ch.sample(0.3 - 1e-9) == 0
+    assert ch.sample(0.3 + 1e-9) == 1
+    # u == 1.0 (closed upper edge) must stay in range
+    assert ch.sample(1.0) == len(ch.kraus) - 1
+
+
+def test_amplitude_damping_is_general_kraus():
+    ch = amplitude_damping(0.3)
+    assert not ch.unitary
+    k0, k1 = ch.kraus
+    assert np.allclose(k0, np.diag([1.0, np.sqrt(0.7)]))
+    assert np.allclose(k1, [[0, np.sqrt(0.3)], [0, 0]])
+
+
+def test_channel_serialization_round_trip():
+    for ch in (depolarizing(0.15), amplitude_damping(0.25)):
+        back = KrausChannel.from_dict(ch.to_dict())
+        assert back.name == ch.name
+        assert back.unitary == ch.unitary
+        assert np.allclose(np.asarray(back.kraus), np.asarray(ch.kraus))
+        assert np.allclose(back.priors, ch.priors)
+
+
+def test_noise_model_slots_and_round_trip():
+    m = NoiseModel(default=depolarizing(0.1),
+                   per_qubit={1: [dephasing(0.2), amplitude_damping(0.3)]})
+    assert not m.trivial
+    # default applies everywhere; per-qubit channels are EXTRAS,
+    # attached after the default in schedule order
+    assert [ch.name for _, ch in m.slots_for((0,))] == [m.default.name]
+    names1 = [ch.name for q, ch in m.slots_for((1,)) if q == 1]
+    assert len(names1) == 3 and names1[0] == m.default.name
+    # slots are sorted + deduped over the touched set
+    qs = [q for q, _ in m.slots_for((2, 0, 2))]
+    assert qs == sorted(set(qs))
+    back = NoiseModel.from_dict(m.to_dict())
+    assert [ch.name for _, ch in back.slots_for((1,))] == \
+        [ch.name for _, ch in m.slots_for((1,))]
+    assert NoiseModel(default=None).trivial
+
+
+def test_traj_uniform_counter_determinism():
+    """The rng contract: u = f(key, trajectory_id, app_seq, domain),
+    pure and collision-separated on every coordinate."""
+    u = traj_uniform(7, 3, 5)
+    assert u == traj_uniform(7, 3, 5)  # pure
+    assert 0.0 <= u < 1.0
+    others = {traj_uniform(8, 3, 5), traj_uniform(7, 4, 5),
+              traj_uniform(7, 3, 6),
+              traj_uniform(7, 3, 5, domain=MEASURE_DOMAIN)}
+    assert u not in others
+    assert len(others) == 4
+    assert BRANCH_DOMAIN != MEASURE_DOMAIN
+
+
+def test_qnoisy_unitary_channel_keeps_weight_one():
+    eng = QNoisy(2, noise=0.2, key=11, trajectory_id=0, inner_layers="cpu")
+    X = np.array([[0, 1], [1, 0]], dtype=complex)
+    eng.Mtrx(X, 0)
+    eng.MCMtrx((0,), X, 1)
+    assert eng.weight == 1.0
+    psi = np.asarray(eng.GetQuantumState())
+    assert abs(np.vdot(psi, psi).real - 1.0) < 1e-9
+
+
+def test_qnoisy_dead_branch_is_weight_zero_reset():
+    """Amplitude damping's K1 on a qubit with no |1> amplitude
+    annihilates the state: the trajectory dies with weight 0 and a
+    well-defined |0...0> ket (the batch body mirrors this exactly)."""
+    model = NoiseModel(default=amplitude_damping(0.5))
+    hit = None
+    for tid in range(64):
+        eng = QNoisy(1, model=model, key=3, trajectory_id=tid,
+                     inner_layers="cpu")
+        # state is |0>: K1 = sqrt(g)|0><1| annihilates it whenever the
+        # prior draw picks branch 1
+        eng.Mtrx(np.eye(2, dtype=complex), 0)
+        if eng.weight == 0.0:
+            hit = eng
+            break
+    assert hit is not None, "no trajectory drew the annihilating branch"
+    psi = np.asarray(hit.GetQuantumState())
+    assert np.allclose(psi, [1.0, 0.0])

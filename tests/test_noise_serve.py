@@ -1,0 +1,147 @@
+"""Trajectory jobs through the serving plane: submit_trajectories
+parity, route-aware HBM chunking, structural non-batchability, the
+dense-cap misroute guard, and WAL journal + bit-identical recovery."""
+
+import json
+
+import numpy as np
+import pytest
+
+from qrack_tpu import resilience as res
+from qrack_tpu import telemetry as tele
+from qrack_tpu.layers.qcircuit import QCircuit
+from qrack_tpu.noise import NoiseModel, amplitude_damping, depolarizing
+from qrack_tpu.noise.trajectories import run_trajectories
+from qrack_tpu.resilience import faults
+from qrack_tpu.serve import QrackService, batcher
+from qrack_tpu.serve.scheduler import Job
+from qrack_tpu.serve.service import TRAJ_TAG
+
+W = 5  # session width — every trajectory ket is (2, 2^W)
+
+_H = np.array([[1, 1], [1, -1]], dtype=complex) / np.sqrt(2)
+_X = np.array([[0, 1], [1, 0]], dtype=complex)
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve(monkeypatch):
+    for k in ("QRACK_NOISE_TRAJ_WINDOW", "QRACK_NOISE_TRAJ_CHUNK",
+              "QRACK_ROUTE_HBM_BYTES", "QRACK_ROUTE_DENSE_MAX_QB"):
+        monkeypatch.delenv(k, raising=False)
+    faults.clear()
+    res.reset_breaker()
+    batcher.clear_programs()
+    yield
+    faults.clear()
+    res.reset_breaker()
+    res.disable()
+    tele.disable()
+    tele.reset()
+    batcher.clear_programs()
+
+
+def _svc(**kw) -> QrackService:
+    kw.setdefault("engine_layers", "cpu")
+    kw.setdefault("batch_window_ms", 5.0)
+    kw.setdefault("queue_budget_ms", 60_000.0)
+    kw.setdefault("tick_s", 0.02)
+    return QrackService(**kw)
+
+
+def _circ() -> QCircuit:
+    c = QCircuit(W)
+    c.append_1q(0, _H)
+    c.append_ctrl((0,), 1, _X, 1)
+    c.append_1q(2, _H)
+    return c
+
+
+def _model() -> NoiseModel:
+    return NoiseModel(default=depolarizing(0.1),
+                      per_qubit={1: [amplitude_damping(0.2)]})
+
+
+def test_submit_trajectories_matches_direct():
+    """The serving path adds queueing and journaling, never randomness:
+    a submitted batch is bit-identical to a direct engine run."""
+    direct = run_trajectories(_circ(), _model(), 6, width=W, key=7)
+    with _svc() as svc:
+        sid = svc.create_session(W)
+        res_ = svc.submit_trajectories(sid, _circ(), _model(), 6,
+                                       key=7).result(timeout=60)
+    assert np.array_equal(res_.samples, direct.samples)
+    assert np.array_equal(res_.p1, direct.p1)
+    assert np.array_equal(res_.weights, direct.weights)
+
+
+def test_trajectory_jobs_are_not_batchable():
+    """The trajectory axis is pre-stacked: the batcher must never join
+    two tenants into one trajectory dispatch."""
+    tj = Job(None, "trajectories", fn=lambda eng: None)
+    assert not tj.batchable
+    cj = Job(None, "circuit", circuit=object(), shape_key=("w", W))
+    assert cj.batchable
+
+
+def test_routed_hbm_chunking_parity(monkeypatch):
+    """A batch priced over the HBM budget is chunked down to fit
+    (route.traj.* telemetry) and still lands bit-identical."""
+    whole = run_trajectories(_circ(), _model(), 6, width=W, key=13)
+    # width 5: 16 B/amp * 32 amps = 512 B per resident trajectory;
+    # a 1 KiB budget admits 2 at a time -> 3 dispatch rounds
+    monkeypatch.setenv("QRACK_ROUTE_HBM_BYTES", "1024")
+    tele.enable()
+    tele.reset()
+    with _svc() as svc:
+        sid = svc.create_session(W)
+        res_ = svc.submit_trajectories(sid, _circ(), _model(), 6,
+                                       key=13).result(timeout=60)
+    assert res_.chunks == 3
+    assert np.array_equal(res_.samples, whole.samples)
+    assert np.allclose(res_.p1, whole.p1, atol=1e-6)
+    snap = tele.snapshot(include_events=False)
+    assert snap["counters"].get("route.traj.chunked", 0) >= 1
+    assert snap["counters"].get("noise.traj.chunked", 0) >= 1
+    assert snap["gauges"].get("route.traj.chunk") == 2
+
+
+def test_trajectory_misroute_past_dense_cap(monkeypatch):
+    """Trajectories need dense batch kets: a session wider than the
+    dense cap must be refused with the router's typed error."""
+    from qrack_tpu.route.router import MisrouteError
+
+    monkeypatch.setenv("QRACK_ROUTE_DENSE_MAX_QB", str(W - 1))
+    with _svc() as svc:
+        sid = svc.create_session(W)
+        with pytest.raises(MisrouteError):
+            svc.submit_trajectories(sid, _circ(), _model(), 4)
+
+
+def test_trajectory_wal_recovery_bit_identical(tmp_path):
+    """A journaled-but-unsettled trajectory job (crash between WAL
+    append and settle) replays at recover() bit-identically: the rng
+    position IS the (key, trajectory_id, app_seq) counters in the
+    spec — nothing else to persist."""
+    ck = str(tmp_path / "ck")
+    spec = json.dumps({"B": 4, "key": 7, "model": _model().to_dict(),
+                       "tag": None}, sort_keys=True)
+    a = _svc(checkpoint_dir=ck)
+    try:
+        sid = a.create_session(W)
+        # simulate the crash window: entry journaled, job never settled
+        a.store.wal_append(sid, _circ(), tag=TRAJ_TAG + spec)
+        out = a.drain()
+        assert out == {"drained": [sid], "busy": []}
+    finally:
+        a.close()
+
+    with _svc(checkpoint_dir=ck) as b:
+        summary = b.recover()
+        assert summary["sessions"] == [sid]
+        assert summary["wal_replayed"] == 1
+        got = summary["trajectories"][sid]
+        assert len(got) == 1
+    oracle = run_trajectories(_circ(), _model(), 4, width=W, key=7)
+    assert np.array_equal(got[0].samples, oracle.samples)
+    assert np.array_equal(got[0].p1, oracle.p1)
+    assert np.array_equal(got[0].weights, oracle.weights)

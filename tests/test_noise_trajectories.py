@@ -1,0 +1,266 @@
+"""Trajectory engine (qrack_tpu/noise/trajectories.py): Monte-Carlo
+convergence against the analytic channel, batch-vs-sequential bit
+parity across fuse windows, mid-batch checkpoint round-trip, HBM
+chunking regressions, and the single-trace compile contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from qrack_tpu import telemetry as tele
+from qrack_tpu.layers.qcircuit import QCircuit
+from qrack_tpu.noise import (NoiseModel, QNoisy, amplitude_damping,
+                             dephasing, depolarizing)
+from qrack_tpu.noise import trajectories as traj
+from qrack_tpu.noise.trajectories import (TrajectoryJob, run_trajectories,
+                                          traj_chunk)
+
+_H = np.array([[1, 1], [1, -1]], dtype=complex) / np.sqrt(2)
+_X = np.array([[0, 1], [1, 0]], dtype=complex)
+_Z = np.array([[1, 0], [0, -1]], dtype=complex)
+_S = np.array([[1, 0], [0, 1j]], dtype=complex)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("QRACK_NOISE_TRAJ_WINDOW", "QRACK_NOISE_TRAJ_CHUNK",
+              "QRACK_ROUTE_HBM_BYTES"):
+        monkeypatch.delenv(k, raising=False)
+    yield
+    tele.disable()
+    tele.reset()
+
+
+def _bell_circuit() -> QCircuit:
+    c = QCircuit(2)
+    c.append_1q(0, _H)
+    c.append_ctrl((0,), 1, _X, 1)
+    return c
+
+
+def _mixed_circuit(n: int = 3) -> QCircuit:
+    """A small circuit exercising 1q payloads and a controlled gate."""
+    c = QCircuit(n)
+    c.append_1q(0, _H)
+    c.append_1q(1, _S)
+    c.append_ctrl((0,), 1, _X, 1)
+    c.append_1q(2, _H)
+    c.append_ctrl((2,), 0, _Z, 1)
+    return c
+
+
+def _op_on(n: int, q: int, m: np.ndarray) -> np.ndarray:
+    """Full 2^n matrix for a 1q operator with qubit 0 least significant
+    (np.kron(high, low) index convention)."""
+    full = np.eye(1)
+    for k in range(n):
+        full = np.kron(m if k == q else np.eye(2), full)
+    return full
+
+
+def _apply_channel(rho: np.ndarray, n: int, q: int, ch) -> np.ndarray:
+    out = np.zeros_like(rho)
+    for k in ch.kraus:
+        kf = _op_on(n, q, np.asarray(k))
+        out += kf @ rho @ kf.conj().T
+    return out
+
+
+def test_trajectory_average_converges_to_analytic():
+    """B=2000 depolarized Bell prep: the trajectory-averaged per-qubit
+    P(1) must sit within a 5-sigma binomial bound of the exact Kraus-sum
+    density matrix (the ISSUE's convergence acceptance)."""
+    lam = 0.1
+    B = 2000
+    ch = depolarizing(lam)
+    model = NoiseModel(default=ch)
+    circ = _bell_circuit()
+
+    # analytic: H0, channel(q0); CNOT(0->1), channel(q0), channel(q1) --
+    # the exact schedule lower_noisy emits (slots sorted per gate)
+    rho = np.zeros((4, 4), dtype=complex)
+    rho[0, 0] = 1.0
+    h0 = _op_on(2, 0, _H)
+    rho = h0 @ rho @ h0.conj().T
+    rho = _apply_channel(rho, 2, 0, ch)
+    cnot = np.array([[1, 0, 0, 0], [0, 0, 0, 1],
+                     [0, 0, 1, 0], [0, 1, 0, 0]], dtype=complex)
+    rho = cnot @ rho @ cnot.conj().T
+    rho = _apply_channel(rho, 2, 0, ch)
+    rho = _apply_channel(rho, 2, 1, ch)
+    diag = np.real(np.diag(rho))
+    p1_exact = np.array([diag[1] + diag[3], diag[2] + diag[3]])
+
+    res = run_trajectories(circ, model, B, key=17)
+    assert res.trajectories == B
+    # mixed-unitary model: every importance weight is exactly 1
+    assert np.all(res.weights == 1.0)
+    assert np.all((res.samples >= 0) & (res.samples < 4))
+    for q in range(2):
+        p = p1_exact[q]
+        sigma = np.sqrt(p * (1 - p) / B)
+        assert abs(res.aggregate_p1[q] - p) < 5 * sigma + 1e-9, \
+            (q, res.aggregate_p1[q], p, sigma)
+        assert res.expectation_z(q) == pytest.approx(
+            1.0 - 2.0 * res.aggregate_p1[q])
+
+
+def test_bit_reproducible_from_key_and_trajectory_id():
+    """Trajectories are pure functions of (key, trajectory_id): the same
+    coordinates replay bit-identically, disjoint ids differ."""
+    circ = _mixed_circuit()
+    model = NoiseModel(default=depolarizing(0.2),
+                       per_qubit={1: [amplitude_damping(0.3)]})
+    a = run_trajectories(circ, model, 5, key=7)
+    b = run_trajectories(circ, model, 5, key=7)
+    assert np.array_equal(a.samples, b.samples)
+    assert np.array_equal(a.p1, b.p1)
+    assert np.array_equal(a.weights, b.weights)
+    # the id list, not its order in the batch, decides the randomness
+    c = run_trajectories(circ, model, 2, key=7, trajectory_ids=[3, 1])
+    assert np.array_equal(c.p1[0], a.p1[3])
+    assert np.array_equal(c.p1[1], a.p1[1])
+
+
+@pytest.mark.parametrize("window", ["1", "16"])
+def test_batch_matches_sequential_per_window(monkeypatch, window):
+    """Batch-vs-sequential bit parity at fuse windows 1 AND 16: the
+    B-batch and B separate single-trajectory runs draw identical
+    branches and identical measurement bits, and their kets agree."""
+    monkeypatch.setenv("QRACK_NOISE_TRAJ_WINDOW", window)
+    circ = _mixed_circuit()
+    model = NoiseModel(default=depolarizing(0.15),
+                       per_qubit={0: [dephasing(0.2)],
+                                  2: [amplitude_damping(0.25)]})
+    B = 5
+    batch = run_trajectories(circ, model, B, key=11, keep_planes=True)
+    for i in range(B):
+        one = run_trajectories(circ, model, 1, key=11,
+                               trajectory_ids=[i], keep_planes=True)
+        assert one.samples[0] == batch.samples[i], i
+        assert one.weights[0] == pytest.approx(batch.weights[i],
+                                               rel=1e-5, abs=1e-6)
+        assert np.allclose(one.p1[0], batch.p1[i], atol=1e-5)
+        assert np.allclose(one.planes[0], batch.planes[i], atol=1e-5)
+
+
+def test_window_split_matches_whole_stream(monkeypatch):
+    """QRACK_NOISE_TRAJ_WINDOW only changes program granularity, never
+    the trajectory: 1-op and 16-op windows reproduce the whole-stream
+    bits and kets."""
+    circ = _mixed_circuit()
+    model = NoiseModel(default=depolarizing(0.1),
+                       per_qubit={1: [amplitude_damping(0.2)]})
+    whole = run_trajectories(circ, model, 6, key=5, keep_planes=True)
+    for w in ("1", "16"):
+        monkeypatch.setenv("QRACK_NOISE_TRAJ_WINDOW", w)
+        split = run_trajectories(circ, model, 6, key=5, keep_planes=True)
+        assert np.array_equal(split.samples, whole.samples), w
+        assert np.allclose(split.weights, whole.weights, atol=1e-6), w
+        assert np.allclose(split.planes, whole.planes, atol=1e-5), w
+
+
+def test_snapshot_resume_round_trip(monkeypatch):
+    """A trajectory job checkpointed mid-batch (after 1 of 3 chunks),
+    serialized through JSON, and resumed must land bit-identical to an
+    uninterrupted run."""
+    monkeypatch.setenv("QRACK_NOISE_TRAJ_CHUNK", "2")
+    circ = _mixed_circuit()
+    model = NoiseModel(default=depolarizing(0.1))
+    full = TrajectoryJob(circ, model, 6, width=3, key=9).run().result()
+    assert full.chunks == 3
+
+    job = TrajectoryJob(circ, model, 6, width=3, key=9)
+    job.step()
+    assert not job.finished
+    snap = json.loads(json.dumps(job.snapshot()))
+    assert snap["kind"] == "noise.trajectories"
+    assert snap["next"] == 1
+    resumed = TrajectoryJob.resume(circ, model, snap).run().result()
+    assert resumed.chunks == 3
+    assert list(resumed.trajectory_ids) == list(full.trajectory_ids)
+    assert np.array_equal(resumed.samples, full.samples)
+    assert np.array_equal(resumed.p1, full.p1)
+    assert np.array_equal(resumed.weights, full.weights)
+
+
+def test_chunked_matches_unchunked(monkeypatch):
+    """HBM chunking regression: forcing 2-trajectory chunks (3
+    dispatch rounds) reproduces the single-dispatch batch exactly."""
+    circ = _mixed_circuit()
+    model = NoiseModel(default=depolarizing(0.1),
+                       per_qubit={2: [amplitude_damping(0.2)]})
+    whole = run_trajectories(circ, model, 6, key=13, keep_planes=True)
+    assert whole.chunks == 1
+    monkeypatch.setenv("QRACK_NOISE_TRAJ_CHUNK", "2")
+    chunked = run_trajectories(circ, model, 6, key=13, keep_planes=True)
+    assert chunked.chunks == 3
+    assert np.array_equal(chunked.samples, whole.samples)
+    assert np.allclose(chunked.weights, whole.weights, atol=1e-6)
+    assert np.allclose(chunked.planes, whole.planes, atol=1e-5)
+
+
+def test_hbm_budget_drives_chunk(monkeypatch):
+    """Without an explicit chunk override the route HBM budget sizes the
+    chunk: budget // (16 * 2^w) resident dense kets per dispatch."""
+    # width 3: 16 B/amp * 8 amps = 128 bytes per trajectory
+    monkeypatch.setenv("QRACK_ROUTE_HBM_BYTES", "256")
+    assert traj_chunk(3, 100) == 2
+    monkeypatch.setenv("QRACK_ROUTE_HBM_BYTES", "100")
+    assert traj_chunk(3, 100) == 1        # never below 1
+    monkeypatch.delenv("QRACK_ROUTE_HBM_BYTES")
+    monkeypatch.setenv("QRACK_NOISE_TRAJ_CHUNK", "7")
+    assert traj_chunk(3, 100) == 7        # explicit override wins
+    assert traj_chunk(3, 4) == 4          # clamped to the batch
+
+
+def test_single_trace_for_same_structure(monkeypatch):
+    """The acceptance's compile contract: B trajectories of one circuit
+    structure trace exactly ONCE (branch choices are runtime operands),
+    and a second batch with different randomness is a pure cache hit."""
+    traj.PROGRAMS.clear()
+    tele.enable()
+    tele.reset()
+    circ = _mixed_circuit()
+    model = NoiseModel(default=depolarizing(0.05),
+                       per_qubit={1: [amplitude_damping(0.1)]})
+    run_trajectories(circ, model, 4, key=3)
+    run_trajectories(circ, model, 4, key=21)   # new branches, same shape
+    c = tele.snapshot(include_events=False)["counters"]
+    assert c.get("compile.noise.window.miss", 0) == 1, c
+    assert c.get("compile.noise.window.hit", 0) >= 1, c
+    assert c.get("compile.noise.miss", 0) == 1, c
+    assert c.get("compile.noise.hit", 0) >= 1, c
+    assert c.get("noise.traj.batches", 0) == 2
+    assert c.get("noise.traj.trajectories", 0) == 8
+
+
+def test_dead_trajectory_matches_oracle():
+    """Importance sampling can draw a branch that annihilates the state
+    (amplitude damping's K1 with no |1> amplitude).  The batch body and
+    the QNoisy oracle must agree bit-for-bit on the outcome: weight 0
+    and a |0...0> reset ket."""
+    circ = QCircuit(1)
+    circ.append_1q(0, _Z)          # Z|0> = |0>: no |1> amplitude
+    model = NoiseModel(default=amplitude_damping(0.5))
+    B = 64
+    res = run_trajectories(circ, model, B, key=3, keep_planes=True)
+    dead = res.weights == 0.0
+    assert dead.any(), "no trajectory drew the annihilating branch"
+    assert not dead.all()
+    for i in range(B):
+        eng = QNoisy(1, model=model, key=3, trajectory_id=i,
+                     inner_layers="cpu")
+        eng.run_circuit(circ)
+        assert eng.weight == pytest.approx(res.weights[i], rel=1e-5), i
+        psi = np.asarray(eng.GetQuantumState())
+        got = res.planes[i][0] + 1j * res.planes[i][1]
+        assert abs(abs(np.vdot(psi, got)) - 1.0) < 1e-6 or \
+            (res.weights[i] == 0.0 and np.allclose(got, [1.0, 0.0])), i
+    # dead trajectories drop out of the channel average entirely
+    live = res.weights > 0
+    assert np.allclose(
+        res.aggregate_p1,
+        (res.weights[live, None] * res.p1[live]).sum(0)
+        / res.weights[live].sum())

@@ -462,6 +462,38 @@ def test_telemetry_report_fleet_mode(tmp_path, capsys):
     assert "postmortem" in out and "worker.ready" in out
 
 
+def test_telemetry_report_noise_section(tmp_path, capsys):
+    """Pinned: the `== noise ==` section reports trajectory-batch
+    geometry (trajectories per batch, HBM chunk rate) and the
+    devget-honest trajectories/s gauge (docs/NOISE.md)."""
+    snap = {"counters": {"noise.traj.batches": 2,
+                         "noise.traj.trajectories": 512,
+                         "noise.traj.chunks": 4,
+                         "noise.traj.chunked": 1,
+                         "noise.traj.windows": 4,
+                         "noise.traj.slots": 1024},
+            "gauges": {"noise.traj.rate": 104.67,
+                       "noise.traj.chunk_size": 128},
+            "hists": {"noise.traj.wall_s":
+                      Histogram.of([2.4, 2.5]).to_dict()},
+            "spans": {}}
+    mod = _load_report_module()
+    rep = mod.report(snap, top=5)
+    assert rep["noise"]["trajectories_per_batch"] == 256.0
+    assert rep["noise"]["chunk_rate"] == 0.5
+    assert rep["noise"]["noise.traj.rate"] == 104.67
+    path = tmp_path / "telemetry.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(snap) + "\n")
+    rc = mod.main([str(path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== noise ==" in out
+    assert "noise.traj.rate" in out
+    # the trajectory wall histogram reports through the SLO section
+    assert "noise.traj.wall_s" in out
+
+
 def test_telemetry_docs_lint_is_clean():
     """Satellite: every telemetry name in qrack_tpu/ is documented and
     no documented pattern is dead — enforced in tier 1."""
