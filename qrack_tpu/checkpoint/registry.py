@@ -210,6 +210,12 @@ def build(snap: dict):
         # the wrapper's _ckpt_restore rebuilds the recorded stack from
         # the snapshot's layer list; a fresh QRouted carries no engine
         obj = QRouted(n)
+    elif kind == "lightcone":
+        from ..lightcone.engine import QLightCone
+
+        # the engine's _ckpt_restore rebuilds the buffered circuit from
+        # the snapshot's gate arrays and rehydrates cone/base children
+        obj = QLightCone(n)
     else:
         raise CheckpointError(f"unknown snapshot kind {kind!r}")
     return restore_into(obj, snap)

@@ -27,6 +27,9 @@ QInterfaceEngine include/qinterface.hpp:37-132, QINTERFACE_OPTIMAL
   "route"              QRouted lazy per-job stack selection: the first
                        submitted QCircuit picks the representation
                        (route/, docs/ROUTING.md; QRACK_ROUTE pins it)
+  "lightcone"          QLightCone circuit buffering: reads build
+                       cone-width kets through the routed ladder, never
+                       the full-width ket (lightcone/, docs/LIGHTCONE.md)
 
 create_quantum_interface(layers, n) composes them top-down; OPTIMAL is
 ["unit", "stabilizer_hybrid", "hybrid"] — the reference's production
@@ -44,7 +47,7 @@ OPTIMAL_MULTI = ("unit_multi", "stabilizer_hybrid", "hybrid")
 
 _TERMINAL = {"cpu", "tpu", "pager", "hybrid", "stabilizer", "bdt",
              "bdt_attached", "unit_clifford", "sparse", "turboquant",
-             "turboquant_pager", "route"}
+             "turboquant_pager", "route", "lightcone"}
 
 
 def _counted(name: str, fn: Callable) -> Callable:
@@ -156,6 +159,14 @@ def _terminal_factory(name: str, **opts) -> Callable:
         from .route.router import QRouted
 
         return lambda n, **kw: QRouted(n, **{**opts, **kw})
+    if name == "lightcone":
+        # pseudo-terminal like "route": gates buffer host-side and the
+        # cone-width stacks built at read time come back through this
+        # factory (via the "route" spec), so resilience wrapping and
+        # creation counters apply to whatever each cone builds
+        from .lightcone.engine import QLightCone
+
+        return lambda n, **kw: QLightCone(n, **{**opts, **kw})
     raise ValueError(f"unknown terminal layer {name!r}")
 
 

@@ -362,6 +362,33 @@ def quantum_volume_qcircuit(n: int, depth: Optional[int] = None,
     return circ
 
 
+def brickwork_theta(q: int) -> float:
+    """The per-qubit RY angle :func:`brickwork_qcircuit` uses — exposed
+    so callers can check the analytic marginal Prob(q) = sin^2(theta/2)
+    (CZ bricks are diagonal, so computational marginals are untouched)."""
+    return 0.3 + 0.04 * q
+
+
+def brickwork_qcircuit(n: int, layers: int = 3) -> "QCircuit":
+    """Shallow local brickwork as IR (the lightcone tenant's workload,
+    docs/LIGHTCONE.md): one RY(theta_q) root per qubit, then `layers`
+    alternating nearest-neighbor CZ brick layers.  Depth is layers+1
+    regardless of width, so any local observable's past cone is O(layers)
+    qubits — at the default depth the router prices a w50+ circuit at
+    max_cone_width 6 and takes the lightcone rung instead of refusing.
+    Deterministic: fixed (n, layers) always emits the same circuit."""
+    from .. import matrices as mat
+    from ..layers.qcircuit import QCircuit
+
+    circ = QCircuit(n)
+    for q in range(n):
+        circ.append_1q(q, mat.u3_mtrx(brickwork_theta(q), 0.0, 0.0))
+    for d in range(layers):
+        for a in range(d & 1, n - 1, 2):
+            circ.append_ctrl((a,), a + 1, mat.Z2, 1)
+    return circ
+
+
 def trotter_qcircuit(n: int, steps: int = 1, dt: float = 0.1,
                      j: float = 1.0, h: float = 1.0) -> "QCircuit":
     """First-order Trotterized transverse-field Ising evolution as IR:

@@ -79,6 +79,10 @@ SITES = (
     # (noise/trajectories.py _sample_operands; docs/NOISE.md) — checked
     # directly, the sampler is host numpy with no watchdog wrapper
     "noise.sample",
+    # light-cone slicing before every buffered-circuit read
+    # (lightcone/engine.py _slice; docs/LIGHTCONE.md) — checked
+    # directly, the cone walk is host-side with no watchdog wrapper
+    "lightcone.slice",
     "checkpoint.save", "checkpoint.restore",
     # process-plane sites (fleet/): checked by the supervisor's monitor
     # tick and the worker's heartbeat writer, not by call_guarded —

@@ -30,7 +30,7 @@ regardless of its work score.  Every knob is an env var so deployments
 can re-weight without code changes:
 
   QRACK_ROUTE                auto | dense | stabilizer | bdt | qunit
-                             | turboquant
+                             | turboquant | lightcone
   QRACK_ROUTE_DENSE_MAX_QB   dense-representable width cap (default 26)
   QRACK_ROUTE_HBM_BYTES      device HBM budget for the memory axis
                              (default: probed from an already-live jax
@@ -42,6 +42,7 @@ can re-weight without code changes:
   QRACK_ROUTE_QUNIT_WEIGHT
   QRACK_ROUTE_DENSE_WEIGHT
   QRACK_ROUTE_TQ_WEIGHT
+  QRACK_ROUTE_LC_WEIGHT      lightcone per-cone-gate weight (default 4)
   QRACK_ROUTE_TQ_PAGES       device count for the turboquant-on-pager
                              rung of the ladder (default 1: single chip)
 
@@ -64,7 +65,13 @@ INFEASIBLE = float("inf")
 
 STACKS = ("stabilizer", "bdt", "qunit", "dense", "turboquant")
 
-_MODES = ("auto",) + STACKS
+# the lightcone rung scores alongside STACKS but is not a ket
+# representation: it buffers the circuit and builds cone-width kets at
+# read time (lightcone/engine.py), so it lives outside the STACKS tuple
+# that sizes residency/HBM tables yet is a first-class routing outcome
+_ORDER = STACKS + ("lightcone",)
+
+_MODES = ("auto",) + _ORDER
 
 # dense resident bytes per amplitude: two f32 planes (re/im) times the
 # donation double-buffer every jitted kernel needs in flight
@@ -121,6 +128,11 @@ class RouteKnobs:
     # dense cap it is ~2^7 cheaper per gate than the tree's host-side
     # node constant, which is the whole point of the tier
     tq_weight: float = 8.0
+    # lightcone reads re-slice + re-run the cone sub-circuit per
+    # distinct observable (no shared full ket), so its per-gate unit is
+    # a few dense sweeps of the CONE width — cheap when the cone is
+    # narrow, never competitive when dense can hold the full width
+    lc_weight: float = 4.0
     # 0 = probe the live backend (falling back to one v5e's 16 GiB)
     hbm_bytes: int = 0
     # devices available to the turboquant-on-pager ladder rung
@@ -137,6 +149,7 @@ class RouteKnobs:
             qunit_weight=_env_float("QRACK_ROUTE_QUNIT_WEIGHT", 2.0),
             dense_weight=_env_float("QRACK_ROUTE_DENSE_WEIGHT", 1.0),
             tq_weight=_env_float("QRACK_ROUTE_TQ_WEIGHT", 8.0),
+            lc_weight=_env_float("QRACK_ROUTE_LC_WEIGHT", 4.0),
             hbm_bytes=_env_int("QRACK_ROUTE_HBM_BYTES", 0),
             tq_pages=_env_int("QRACK_ROUTE_TQ_PAGES", 1),
         )
@@ -218,6 +231,11 @@ def hbm_bytes(stack: str, f: CircuitFeatures,
         scales = 4.0 * float(2 ** max(w - block_pow, 0))
         per_device = 2.0 * (codes + scales)
         return per_device / max(k.tq_pages, 1)
+    if stack == "lightcone":
+        # resident footprint is the widest cone ket a single-qubit read
+        # can build, never the declared width
+        cone = min(max(int(getattr(f, "max_cone_width", w)), 1), w)
+        return float(DENSE_BYTES_PER_AMP) * float(2 ** cone)
     return 0.0  # stabilizer / bdt: host-side state
 
 
@@ -277,6 +295,20 @@ def score_stacks(f: CircuitFeatures,
         scores["turboquant"] = g * float(2 ** w) * k.tq_weight
     else:
         scores["turboquant"] = INFEASIBLE
+
+    # lightcone: buffer the circuit, build cone-width kets at read time
+    # (lightcone/engine.py).  Deliberately a LAST-RESORT rung: feasible
+    # only when no full-width dense-equivalent ket fits (dense
+    # infeasible) AND the cone genuinely beats the width AND the cone
+    # itself clears a dense/turboquant rung — it replaces refusals, it
+    # does not steal jobs a resident ket would serve better (repeated
+    # reads amortize on a ket; cones re-run per observable)
+    cone = min(max(int(getattr(f, "max_cone_width", w)), 1), w)
+    if (scores["dense"] == INFEASIBLE and cone < w
+            and ladder_stack(cone, k) is not None):
+        scores["lightcone"] = g * float(2 ** cone) * k.lc_weight
+    else:
+        scores["lightcone"] = INFEASIBLE
     return scores
 
 
@@ -295,7 +327,7 @@ def choose_stack(f: CircuitFeatures,
     # the QBdt estimate is never infeasible (the tree always represents
     # the state; the node-budget probe escalates it if it blows up), so
     # min() always lands on a runnable stack
-    best = min(scores, key=lambda s: (scores[s], STACKS.index(s)))
+    best = min(scores, key=lambda s: (scores[s], _ORDER.index(s)))
     return best, scores
 
 
@@ -329,6 +361,8 @@ def layers_for(stack: str, width: int,
                 <= hbm_budget_bytes(k)):
             return ("turboquant",)
         return ("turboquant_pager",)
+    if stack == "lightcone":
+        return ("lightcone",)
     raise ValueError(f"unknown route stack {stack!r}")
 
 
@@ -347,14 +381,19 @@ def _single_page(k: RouteKnobs) -> RouteKnobs:
 
 
 def ladder_stack(width: int,
-                 knobs: Optional[RouteKnobs] = None) -> Optional[str]:
+                 knobs: Optional[RouteKnobs] = None,
+                 features: Optional[CircuitFeatures] = None) -> Optional[str]:
     """The escalation ladder, bottom-up: the cheapest dense-equivalent
     stack that can HOLD `width` on this device budget.  "dense" when
     both the width knob and the memory axis allow it, else the
-    compressed rung, else None (nothing on the ladder fits — the caller
-    refuses rather than serving garbage).  Used both by plan() when a
-    stabilizer-resident circuit goes general past the dense cap and by
-    escalation paths deciding where a quantized session lands."""
+    compressed rung, else — only when the caller passes `features`
+    carrying a cone bound — the lightcone rung, else None (nothing on
+    the ladder fits — the caller refuses rather than serving garbage).
+    Used both by plan() when a stabilizer-resident circuit goes general
+    past the dense cap and by escalation paths deciding where a
+    quantized session lands.  plan()'s mid-flight escalation does NOT
+    pass features (a half-executed eager session cannot be re-sliced),
+    so the lightcone rung is only offered at circuit admission time."""
     k = knobs or RouteKnobs.from_env()
     f = _WidthOnly(width)
     budget = hbm_budget_bytes(k)
@@ -362,6 +401,11 @@ def ladder_stack(width: int,
         return "dense"
     if width <= _tq_width_cap(k) and hbm_bytes("turboquant", f, k) <= budget:
         return "turboquant"
+    if features is not None:
+        cone = min(max(int(getattr(features, "max_cone_width", width)), 1),
+                   width)
+        if cone < width and ladder_stack(cone, k) is not None:
+            return "lightcone"
     return None
 
 

@@ -42,6 +42,10 @@ def _i_power(v: complex, tol: float = 1e-9):
     return None
 
 
+def _is_unitary(m: np.ndarray, tol: float = 1e-9) -> bool:
+    return bool(np.allclose(m @ m.conj().T, np.eye(2), atol=tol))
+
+
 def _ctrl_clifford(m: np.ndarray) -> bool:
     """Single-control Clifford test (layers/stabilizer.py:MCMtrxPerm):
     monomial payload, entries i^k, entry-ratio parity even."""
@@ -99,6 +103,10 @@ class CircuitFeatures:
     shots: int = 1             # trajectory batch size: resident kets the
     #                            job holds AT ONCE (noise/trajectories.py);
     #                            dense HBM pricing scales by this
+    max_cone_width: int = 1    # widest past light cone over single-qubit
+    #                            observables at circuit end (lightcone rung)
+    cone_width_by_depth: tuple = ()  # max cone width among gates at each
+    #                                  depth level (1-indexed levels)
 
     @property
     def clifford_fraction(self) -> float:
@@ -128,6 +136,8 @@ class CircuitFeatures:
             "max_cut_crossings": self.max_cut_crossings,
             "clifford_fraction": round(self.clifford_fraction, 4),
             "shots": self.shots,
+            "max_cone_width": self.max_cone_width,
+            "cone_width_by_depth": tuple(self.cone_width_by_depth),
         }
 
 
@@ -142,6 +152,11 @@ def extract_features(circuit, width: int,
     degree: Dict[int, int] = {}
     nn = 0
     crossings = [0] * max(int(width), 1)  # cut between q and q+1
+    # forward-influence sets: fc[q] = original qubits whose state can
+    # influence q so far == the past light cone of a Prob(q) read here
+    fc: Dict[int, frozenset] = {}
+    lvl: Dict[int, int] = {}
+    cone_by_depth: list = []
     for gate in circuit.gates:
         ctrls = tuple(gate.controls)
         # Run dispatches one MCMtrxPerm per payload (merged gates hold
@@ -150,7 +165,11 @@ def extract_features(circuit, width: int,
             f.gate_count += 1
             m = np.asarray(m, dtype=np.complex128)
             if not ctrls:
-                if clifford_sequence(m) is not None:
+                if not _is_unitary(m):
+                    # recorded measurement projectors (lightcone
+                    # engine) are phase-shaped but NOT tableau-safe
+                    f.general_count += 1
+                elif clifford_sequence(m) is not None:
                     f.clifford_count += 1
                 elif mat.is_phase(m) or mat.is_invert(m):
                     f.magic_count += 1
@@ -165,9 +184,21 @@ def extract_features(circuit, width: int,
                 f.clifford_count += 1
             else:
                 f.general_count += 1
+        span = set(ctrls) | {gate.target}
+        cone = set(span)
+        for q in span:
+            cone |= fc.get(q, frozenset((q,)))
+        frozen = frozenset(cone)
+        level = 1 + max((lvl.get(q, 0) for q in span), default=0)
+        for q in span:
+            fc[q] = frozen
+            lvl[q] = level
+        while len(cone_by_depth) < level:
+            cone_by_depth.append(0)
+        cone_by_depth[level - 1] = max(cone_by_depth[level - 1], len(frozen))
         if not ctrls:
             continue
-        qubits = sorted(set(ctrls) | {gate.target})
+        qubits = sorted(span)
         for c in ctrls:
             pair = (min(c, gate.target), max(c, gate.target))
             pairs.add(pair)
@@ -187,6 +218,10 @@ def extract_features(circuit, width: int,
     f.nn_fraction = (nn / f.entangling_count) if f.entangling_count else 1.0
     f.max_component = uf.max_component() if f.entangling_count else 1
     f.max_cut_crossings = max(crossings, default=0)
+    f.max_cone_width = max(
+        (len(fc.get(q, frozenset((q,)))) for q in range(max(int(width), 1))),
+        default=1)
+    f.cone_width_by_depth = tuple(cone_by_depth)
     return f
 
 

@@ -61,7 +61,8 @@ class MisrouteError(RuntimeError):
 # escalations are monotone UP this ladder: cheap host-side stacks, then
 # the compressed dense-equivalent tier, then full f32 planes.  A plan
 # may upgrade a pending plan's rung; it never downgrades one.
-_RANK = {"stabilizer": 0, "bdt": 0, "qunit": 0, "turboquant": 1, "dense": 2}
+_RANK = {"stabilizer": 0, "bdt": 0, "qunit": 0, "lightcone": 0,
+         "turboquant": 1, "dense": 2}
 
 _QUANT_STACKS = ("turboquant", "turboquant_pager")
 
@@ -138,7 +139,7 @@ _LIVE: "weakref.WeakSet[QRouted]" = weakref.WeakSet()
 def update_residency() -> None:
     if not _tele._ENABLED:
         return
-    counts = {s: 0 for s in _cost.STACKS}
+    counts = {s: 0 for s in _cost.STACKS + ("lightcone",)}
     unrouted = 0
     for eng in list(_LIVE):
         stack = eng.current_stack()
@@ -173,6 +174,10 @@ class QRouted:
         self.qubit_count = int(qubit_count)
         self.rng = rng if rng is not None else QrackRandom()
         self._init_state = int(init_state)
+        # explicit mode override (None: QRACK_ROUTE).  The lightcone
+        # engine builds its cone stacks with route_mode="auto" so a
+        # pinned QRACK_ROUTE=lightcone cannot recurse into the cones.
+        self._route_mode = kwargs.pop("route_mode", None)
         self._kwargs = dict(kwargs)       # forwarded to the chosen stack
         self._decision: Optional[RouteDecision] = None
         self._pending: Optional[RouteDecision] = None
@@ -198,6 +203,11 @@ class QRouted:
             d = self._pending or self._decision
         return d is not None and d.stack == "dense"
 
+    def plans_lightcone(self) -> bool:
+        with self._lock:
+            d = self._pending or self._decision
+        return d is not None and d.stack == "lightcone"
+
     # -- admission: plan (caller thread) / apply (dispatch thread) -----
 
     def plan(self, circuit) -> RouteDecision:
@@ -213,7 +223,20 @@ class QRouted:
                 if (self._pending is not None
                         and self._pending.stack == "dense"):
                     return self._pending
-                d = decide(circuit, self.qubit_count)
+                d = decide(circuit, self.qubit_count,
+                           mode=self._route_mode)
+                if (d.reason == "pinned" and d.stack == "dense"
+                        and self.qubit_count
+                        > max(knobs.dense_max_qb, _cost._TQ_BASE_CAP)):
+                    # a forced-dense pin past every plane-representable
+                    # width would build a hybrid that cannot hold the
+                    # ket; refuse at admission (the lightcone rung is
+                    # what serves these jobs under auto routing)
+                    raise MisrouteError(
+                        f"QRACK_ROUTE=dense pinned but width "
+                        f"{self.qubit_count} exceeds the dense ladder "
+                        f"(cap {knobs.dense_max_qb}); unpin to let the "
+                        "lightcone/compressed rungs take it")
                 if (self._pending is None
                         or _RANK.get(d.stack, 0)
                         > _RANK.get(self._pending.stack, 0)):
@@ -295,7 +318,8 @@ class QRouted:
             return
         if pending is None:
             knobs = _cost.RouteKnobs.from_env()
-            stack = _cost.default_stack(self.qubit_count, knobs)
+            stack = _cost.default_stack(self.qubit_count, knobs,
+                                        mode=self._route_mode)
             pending = RouteDecision(
                 stack=stack,
                 layers=_cost.layers_for(stack, self.qubit_count, knobs),

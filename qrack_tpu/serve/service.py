@@ -280,6 +280,16 @@ class QrackService:
                 # dense-routed but not built yet: key the job anyway so
                 # routed jobs still bucket+batch by stack+shape
                 shape_key = circuit.shape_key(sess.width)
+            elif routed and sess.engine.plans_lightcone():
+                # lightcone-routed: key on the SLICED sub-circuit digest
+                # at cone width, not the declared width — two w50+
+                # tenants running the same local structure at different
+                # offsets share a bucket (they never co-batch — no
+                # planes engine — but admission telemetry and scheduler
+                # affinity see the shape that actually executes)
+                from ..lightcone.engine import sliced_shape_key
+
+                shape_key = sliced_shape_key(circuit)
         job = Job(sess, "circuit", circuit=circuit, shape_key=shape_key,
                   priority=priority)
         job.tag = tag

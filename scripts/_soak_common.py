@@ -42,9 +42,9 @@ if _TESTS not in sys.path:
 from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
 
 __all__ = ["REPO", "N", "_ops", "STACKS", "ROUTED_TQ_LANE",
-           "ROUTED_TQ_FLOOR", "TRAJECTORY_LANES", "routed_tq_env",
-           "fidelity", "submit_retry", "resilience_up", "resilience_down",
-           "soak_main"]
+           "ROUTED_TQ_FLOOR", "LIGHTCONE_LANE", "TRAJECTORY_LANES",
+           "routed_tq_env", "fidelity", "submit_retry", "resilience_up",
+           "resilience_down", "soak_main"]
 
 # stacks that exercise each guarded dispatch family; the second pager
 # lane forces the placement planner on so remapped windows soak too,
@@ -68,6 +68,16 @@ STACKS = [
 # the quantized floor — 16-bit requantization is legitimate loss.
 ROUTED_TQ_LANE = ("route", {"bits": 16, "chunk_qb": 3, "block_pow": 2})
 ROUTED_TQ_FLOOR = 1 - 1e-5
+
+
+# the lightcone rung (docs/LIGHTCONE.md): gates buffer host-side and
+# every read routes a cone-width sub-circuit through the ladder, so
+# corruption armed on the dense dispatch sites strikes INSIDE the cone
+# engines the reads build — the integrity guard must catch it there,
+# one indirection below the session engine (integrity_soak.py consumes
+# this lane; the `lightcone.slice` site itself is pinned by
+# tests/test_lightcone.py's typed-error checks)
+LIGHTCONE_LANE = ("lightcone", {})
 
 
 # trajectory-batch lanes (noise_soak.py): the batched Monte-Carlo

@@ -21,6 +21,10 @@ site that actually carries state commits for the trial's
                          flushes each gate through the per-gate chunk
                          programs inside the guarded envelope)
     route @ window 16 -> tpu.fuse.flush  (single-pass fused window)
+    lightcone         -> same sites as tpu, but the corruption strikes
+                         inside the cone-width engines each READ builds
+                         (gates only buffer; docs/LIGHTCONE.md) — the
+                         guard must catch it one indirection down
 
 The ``route`` lane (the _soak_common.ROUTED_TQ_LANE rung of the
 precision ladder) pins QRACK_ROUTE=turboquant so the quantized chunk-
@@ -63,9 +67,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _soak_common import (N, ROUTED_TQ_FLOOR, ROUTED_TQ_LANE,  # noqa: E402
-                          fidelity, resilience_down, resilience_up,
-                          routed_tq_env, soak_main)
+from _soak_common import (LIGHTCONE_LANE, N, ROUTED_TQ_FLOOR,  # noqa: E402
+                          ROUTED_TQ_LANE, fidelity, resilience_down,
+                          resilience_up, routed_tq_env, soak_main)
 
 import numpy as np  # noqa: E402
 
@@ -77,7 +81,7 @@ from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
 STACKS = [("tpu", {}), ("pager", {"n_pages": 4, "remap": "off"}),
           ("pager", {"n_pages": 4, "remap": "on"}),
-          ROUTED_TQ_LANE]
+          ROUTED_TQ_LANE, LIGHTCONE_LANE]
 
 GATES1 = ("H", "X", "Y", "Z", "S", "T")
 _DIAG1 = ("Z", "S", "T")   # phase gates: window-admissible at ANY target
@@ -113,7 +117,10 @@ def _fusable_op(rng, ndt: int = N):
 
 
 def _site_for(stack_name: str, kw: dict, window: int) -> str:
-    if stack_name == "tpu":
+    if stack_name in ("tpu", "lightcone"):
+        # lightcone: gates buffer host-side; the read-time cone engines
+        # route to dense at these widths and dispatch through the same
+        # tpu sites, one indirection below the session engine
         return "tpu.compile" if window == 1 else "tpu.fuse.flush"
     if stack_name == "route":
         # window 1: the forced fuser flushes single-op windows through

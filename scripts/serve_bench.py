@@ -51,6 +51,21 @@ Clifford tenant additionally rides the routed phase only: past the
 dense cap there IS no forced baseline — that impossibility is the
 routing subsystem's reason to exist.
 
+SHALLOW mode (--shallow, docs/LIGHTCONE.md): a w50+ depth-4 local-
+observable tenant class (shallow RY+CZ brickwork, models/algorithms.py
+brickwork_qcircuit) rides ONE routed service next to dense w22 QFT
+tenants.  The wide tenants' width is past every state-holding rung, but
+their observables' past cones are ~6 qubits, so the router takes the
+lightcone rung: gates buffer host-side and the completion read executes
+a cone-width sub-circuit through the dense ladder.  After the timed
+rounds a probe session checks the served expectation against the
+analytic marginal sin^2(theta_q/2) — oracle-exact, not approximate.
+The same wide submission then replays with QRACK_ROUTE=dense forced:
+admission refuses it with the typed MisrouteError at submit() — there
+is no forced-dense baseline wall for this class, and that refusal IS
+the baseline the lightcone rung replaces (the dense w22 tenants still
+serve under the same pin, so the refusal is width-specific).
+
 NOISY mode (--noisy, docs/NOISE.md): one noisy-trajectory tenant —
 noisy-RCS circuits under a depolarizing model, B=256 trajectories per
 submission through QrackService.submit_trajectories (ONE vmapped
@@ -67,19 +82,23 @@ Usage:
                                   [--noisy-traj 256] [--noisy-depth 4]
     python scripts/serve_bench.py --mixed [--clifford-width 20]
                                   [--qaoa-width 12] [--wide-width 100]
+    python scripts/serve_bench.py --shallow [--shallow-width 50]
+                                  [--shallow-jobs 4] [--shallow-dense-width 22]
     python scripts/serve_bench.py --loadgen [--tenants 1000]
                                   [--lg-requests 2000] [--lg-mode closed]
                                   [--lg-concurrency 40] [--lg-rate 400]
 
 Exit 0 when the acceptance bar holds (default: cold AND steady-state
 serve rounds < 0.6x the sequential library wall; --mixed: routed
-Clifford class >= 10x faster than dense-forced; --loadgen: pipelined
-throughput >= 1.5x the serial A/B child with p99 no worse), 1
-otherwise.
+Clifford class >= 10x faster than dense-forced; --shallow: wide tenant
+auto-routes to lightcone, probe expectations analytic-exact, forced
+dense refuses with MisrouteError; --loadgen: pipelined throughput >=
+1.5x the serial A/B child with p99 no worse), 1 otherwise.
 """
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -505,6 +524,138 @@ def run_mixed(args) -> dict:
     return res
 
 
+def _measure_shallow_routed(args):
+    """The routed phase of --shallow: wide brickwork tenants and dense
+    QFT tenants share one routed service.  Per-class walls are timed
+    class-phased like --mixed; every completion is devget-honest (for
+    the lightcone-routed sessions the executor's sync read IS a local
+    observable driven through a cone-width engine).  After the timed
+    rounds a FRESH probe session submits one brickwork circuit and
+    reads Prob(q) at sampled qubits through svc.call — those must match
+    the analytic marginal sin^2(theta_q/2) exactly (the probe is fresh
+    because the timed tenants stack one circuit per round, so only the
+    first round's state has the single-circuit analytic form)."""
+    from qrack_tpu.models.algorithms import (brickwork_qcircuit,
+                                             brickwork_theta)
+
+    walls = {"shallow": [], "dense": []}
+    svc = QrackService(engine_layers="route",
+                       max_depth=8 * args.shallow_jobs + 16,
+                       batch_window_ms=args.window_ms,
+                       max_batch=args.shallow_jobs,
+                       queue_budget_ms=600_000.0)
+    try:
+        tenants = {
+            "shallow": ([svc.create_session(args.shallow_width, seed=i)
+                         for i in range(args.shallow_jobs)],
+                        lambda: brickwork_qcircuit(args.shallow_width)),
+            "dense": ([svc.create_session(args.shallow_dense_width,
+                                          seed=100 + i)
+                       for i in range(args.shallow_jobs)],
+                      lambda: qft_qcircuit(args.shallow_dense_width)),
+        }
+        for _ in range(args.rounds):
+            for cls, (sids, make) in tenants.items():
+                circs = [make() for _ in sids]
+                t0 = time.perf_counter()
+                handles = [svc.submit(sid, c)
+                           for sid, c in zip(sids, circs)]
+                for h in handles:
+                    h.result(timeout=600)
+                walls[cls].append(time.perf_counter() - t0)
+
+        # analytic-exactness probe: local expectations served through
+        # the shared dispatch owner, checked against sin^2(theta_q/2)
+        psid = svc.create_session(args.shallow_width, seed=999)
+        svc.submit(psid, brickwork_qcircuit(args.shallow_width)).result(600)
+        qs = sorted({0, 1, args.shallow_width // 2,
+                     args.shallow_width - 1})
+        probe = []
+        for q in qs:
+            served = svc.call(
+                psid, lambda e, q=q: e.Prob(q), mutates=False).result(600)
+            exact = math.sin(brickwork_theta(q) / 2.0) ** 2
+            probe.append({"qubit": q, "served": served, "analytic": exact,
+                          "abs_err": abs(served - exact)})
+    finally:
+        svc.close()
+    return walls, probe
+
+
+def _measure_shallow_refusal(args) -> dict:
+    """The forced-dense baseline for the wide tenant: there isn't one.
+    With QRACK_ROUTE=dense pinned, admission must refuse the SAME
+    brickwork submission with the typed MisrouteError at submit(),
+    while a dense-feasible w22 tenant still serves under the pin —
+    the refusal is the width's, not the deployment's."""
+    from qrack_tpu.models.algorithms import brickwork_qcircuit
+    from qrack_tpu.route import MisrouteError
+
+    prev = os.environ.get("QRACK_ROUTE")
+    os.environ["QRACK_ROUTE"] = "dense"
+    out = {"refused": False, "error": None, "dense_w22_served": False}
+    try:
+        svc = QrackService(engine_layers="route",
+                           queue_budget_ms=600_000.0)
+        try:
+            wsid = svc.create_session(args.shallow_width, seed=0)
+            try:
+                svc.submit(wsid, brickwork_qcircuit(args.shallow_width))
+            except MisrouteError as e:
+                out["refused"] = True
+                out["error"] = f"{type(e).__name__}: {e}"
+            dsid = svc.create_session(args.shallow_dense_width, seed=1)
+            h = svc.submit(dsid, qft_qcircuit(args.shallow_dense_width))
+            h.result(timeout=600)
+            out["dense_w22_served"] = True
+        finally:
+            svc.close()
+    finally:
+        if prev is None:
+            os.environ.pop("QRACK_ROUTE", None)
+        else:
+            os.environ["QRACK_ROUTE"] = prev
+    return out
+
+
+def run_shallow(args) -> dict:
+    tele.enable()
+    tele.reset()
+    routed, probe = _measure_shallow_routed(args)
+    snap = tele.snapshot()
+    cnt = snap["counters"]
+    route_jobs = {k[len("route.jobs."):]: v
+                  for k, v in cnt.items() if k.startswith("route.jobs.")}
+    refusal = _measure_shallow_refusal(args)
+
+    def steady(ws):
+        tail = ws[1:] or ws
+        return float(np.median(tail)) if tail else None
+
+    max_err = max(p["abs_err"] for p in probe)
+    res = {
+        "mode": "shallow",
+        "shallow_width": args.shallow_width,
+        "dense_width": args.shallow_dense_width,
+        "jobs_per_class": args.shallow_jobs, "rounds": args.rounds,
+        "routed_jobs_by_stack": route_jobs,
+        "lightcone_reads": cnt.get("lightcone.reads", 0),
+        "probe": probe, "probe_max_abs_err": max_err,
+        "forced_dense": refusal,
+    }
+    for cls in ("shallow", "dense"):
+        w = steady(routed[cls])
+        res[f"routed_{cls}_steady_wall_s"] = round(w, 6)
+        res[f"{cls}_jobs_per_s"] = round(args.shallow_jobs / w, 2)
+    tele.gauge("serve.bench.shallow_jobs_per_s", res["shallow_jobs_per_s"])
+    tele.gauge("serve.bench.shallow_probe_err", max_err)
+    res["pass_shallow"] = bool(
+        route_jobs.get("lightcone", 0) >= args.shallow_jobs
+        and max_err < 1e-6
+        and refusal["refused"] and refusal["dense_w22_served"])
+    return res
+
+
 def measure_noisy_sequential(args) -> dict:
     """The sequential-trajectory fallback: the SAME trajectory engine,
     the SAME (key, trajectory_id) counters, but one trajectory per
@@ -687,6 +838,22 @@ def main(argv=None) -> int:
     ap.add_argument("--wide-width", type=int, default=100,
                     help="extra routed-only Clifford tenant width (no "
                          "forced baseline possible; 0 disables)")
+    ap.add_argument("--shallow", action="store_true",
+                    help="lightcone tenant bench: w50+ depth-4 local-"
+                         "observable brickwork tenants next to dense "
+                         "w22 QFT tenants in ONE routed service, with "
+                         "an analytic-exactness probe and the forced-"
+                         "dense MisrouteError refusal baseline "
+                         "(docs/LIGHTCONE.md)")
+    ap.add_argument("--shallow-width", type=int, default=50,
+                    help="wide tenant width — past every state-holding "
+                         "rung, so only the lightcone rung serves it "
+                         "(default 50)")
+    ap.add_argument("--shallow-jobs", type=int, default=4,
+                    help="sessions per class in --shallow (default 4)")
+    ap.add_argument("--shallow-dense-width", type=int, default=22,
+                    help="dense-feasible neighbor tenant width "
+                         "(default 22)")
     ap.add_argument("--noisy", action="store_true",
                     help="noisy-trajectory tenant class: noisy-RCS "
                          "under a depolarizing model, B trajectories "
@@ -793,6 +960,45 @@ def main(argv=None) -> int:
             print(f"  acceptance (>=1.5x, p99 no worse): "
                   f"{'PASS' if res['pass_1p5x'] else 'FAIL'}")
         return 0 if res["pass_1p5x"] else 1
+
+    if args.shallow:
+        res = run_shallow(args)
+        if args.json:
+            print(json.dumps(res, indent=1, sort_keys=True))
+        else:
+            print(f"shallow traffic x{res['jobs_per_class']}/class, "
+                  f"{res['rounds']} rounds (devget-honest; steady = "
+                  f"median of post-cold rounds)")
+            print(f"  shallow w{res['shallow_width']:<3d} routed "
+                  f"{res['routed_shallow_steady_wall_s'] * 1e3:9.1f} ms "
+                  f"({res['shallow_jobs_per_s']:>8.2f} jobs/s) | "
+                  f"forced dense: "
+                  f"{'refused (' + res['forced_dense']['error'] + ')' if res['forced_dense']['refused'] else 'NOT REFUSED'}")
+            print(f"  dense   w{res['dense_width']:<3d} routed "
+                  f"{res['routed_dense_steady_wall_s'] * 1e3:9.1f} ms "
+                  f"({res['dense_jobs_per_s']:>8.2f} jobs/s) | "
+                  f"forced dense: "
+                  f"{'served' if res['forced_dense']['dense_w22_served'] else 'FAILED'}")
+            print(f"  probe max |served - sin^2(theta/2)| = "
+                  f"{res['probe_max_abs_err']:.2e} over qubits "
+                  f"{[p['qubit'] for p in res['probe']]}")
+            print(f"  routed jobs by stack: {res['routed_jobs_by_stack']} "
+                  f"| lightcone reads: {res['lightcone_reads']:.0f}")
+            print(f"  acceptance (lightcone-routed, analytic-exact, "
+                  f"forced-dense refused): "
+                  f"{'PASS' if res['pass_shallow'] else 'FAIL'}")
+        # campaign evidence: one flat metric line + the OK marker
+        # (scripts/tpu_campaign.sh greps ^{"metric" and _OK$;
+        # perf_sentinel stamps the line into docs/tpu_results.jsonl)
+        print(json.dumps({
+            "metric": f"lightcone_w{res['shallow_width']}_serve",
+            "value": res["shallow_jobs_per_s"], "unit": "jobs/s",
+            "probe_max_abs_err": res["probe_max_abs_err"],
+            "forced_dense_refused": res["forced_dense"]["refused"],
+            "routed_jobs_by_stack": res["routed_jobs_by_stack"]}))
+        if res["pass_shallow"]:
+            print("LIGHTCONE_SHALLOW_OK")
+        return 0 if res["pass_shallow"] else 1
 
     if args.mixed:
         res = run_mixed(args)

@@ -35,6 +35,11 @@ sum; spans merge).  Sections:
     decompress/recompress sweeps vs the single-pass fused-window
     savings (sweeps_saved_share, ops_per_window), and drift replay
     repairs vs giveups on the quantized rung — docs/PERFORMANCE.md
+  * lightcone: the buffered-circuit rung (docs/LIGHTCONE.md) — cone-
+    width percentiles (the register the reads actually built vs the
+    declared width), the share of buffered gates each read elided,
+    cone-cache hit rate, and which ladder rung served the cone reads
+    (lightcone.reads.<stack> shares)
   * checkpoint: save/restore counts + bytes, spill-store footprint,
     warm-start programs recorded/prewarmed, recovery-lease traffic
   * elasticity: repage shrink/expand traffic, failed expansions,
@@ -154,6 +159,7 @@ def report(snap: dict, top: int) -> dict:
         "route": {},
         "compression": {},
         "noise": {},
+        "lightcone": {},
         "roofline": {},
         "checkpoint": {},
         "elastic": {},
@@ -173,6 +179,9 @@ def report(snap: dict, top: int) -> dict:
     for name, d in sorted((snap.get("hists") or {}).items()):
         if name.startswith("roofline."):
             continue  # GB/s distributions, not latencies — == roofline ==
+        if name.startswith("lightcone."):
+            continue  # cone-width distribution, not a latency — its
+            #           percentiles print in == lightcone ==
         h = Histogram.from_dict(d)
         if not h.count:
             continue
@@ -199,6 +208,8 @@ def report(snap: dict, top: int) -> dict:
             out["route"][k] = v
         elif k.startswith("noise."):
             out["noise"][k] = v
+        elif k.startswith("lightcone."):
+            out["lightcone"][k] = v
         elif k.startswith("checkpoint."):
             out["checkpoint"][k] = v
         elif k.startswith("elastic."):
@@ -308,6 +319,32 @@ def report(snap: dict, top: int) -> dict:
     for g in ("noise.traj.rate", "noise.traj.chunk_size"):
         if g in gauges:
             nz[g] = gauges[g]
+    # lightcone: the buffered-circuit rung — cone-width percentiles
+    # (the register each read actually built), the share of buffered
+    # gates the cone slicing elided, cone-cache hit rate, and the
+    # ladder rung mix that served the cone reads (docs/LIGHTCONE.md)
+    lc = out["lightcone"]
+    cw = (snap.get("hists") or {}).get("lightcone.cone_width")
+    if cw:
+        h = Histogram.from_dict(cw)
+        if h.count:
+            lc["cone_width"] = {
+                "count": h.count, "p50": round(h.percentile(50), 1),
+                "p95": round(h.percentile(95), 1),
+                "max": round(h.max, 1)}
+    cone_gates = lc.get("lightcone.gates.cone", 0)
+    elided = lc.get("lightcone.gates.elided", 0)
+    if cone_gates + elided:
+        lc["elided_share"] = round(elided / (cone_gates + elided), 4)
+    hits = lc.get("lightcone.cache.hit", 0)
+    misses = lc.get("lightcone.cache.miss", 0)
+    if hits + misses:
+        lc["cache_hit_rate"] = round(hits / (hits + misses), 4)
+    lc_reads = lc.get("lightcone.reads", 0)
+    if lc_reads:
+        for k in [k for k in lc if k.startswith("lightcone.reads.")]:
+            lc[f"rung_share.{k[len('lightcone.reads.'):]}"] = round(
+                lc[k] / lc_reads, 4)
     # roofline: achieved bandwidth per guarded dispatch site — GB/s
     # percentiles from the implied-bandwidth histograms (merged hists
     # under --all/--fleet report merged percentiles, same as SLO),
@@ -426,6 +463,16 @@ def main(argv=None) -> int:
             else:
                 shown = f"{v:.4f}"
             print(f"  {name:<40s} {shown:>12s}")
+    if rep["lightcone"]:
+        print("== lightcone ==")
+        for name, v in sorted(rep["lightcone"].items()):
+            if isinstance(v, dict):
+                print(f"  {name:<40s} n={v['count']:<6d} "
+                      f"p50={v['p50']:.1f} p95={v['p95']:.1f} "
+                      f"max={v['max']:.1f} qubits")
+            else:
+                shown = f"{v:.0f}" if float(v).is_integer() else f"{v:.4f}"
+                print(f"  {name:<40s} {shown:>12s}")
     if rep["roofline"]:
         print("== roofline ==")
         for name, v in sorted(rep["roofline"].items()):

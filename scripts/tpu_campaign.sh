@@ -218,6 +218,14 @@ run_stage noise_traj_w16_seq 420 env QRACK_BENCH=noise_traj \
   QRACK_BENCH_SUFFIX=_seq QRACK_BENCH_SAMPLES=3 QRACK_BENCH_TPU_ONLY=1 \
   QRACK_BENCH_BUDGET=390 python bench.py
 
+# ---- lightcone rung at width no ket can hold: w50 depth-4 brickwork
+#      tenants next to dense w22 QFT tenants through ONE routed service —
+#      cone-width sub-circuits dispatch on-chip while the w50 register
+#      never materializes, the analytic probe pins exactness, and the
+#      forced-dense MisrouteError refusal is recorded in the same line
+#      (docs/LIGHTCONE.md).
+run_stage lightcone_w50 700 python scripts/serve_bench.py --shallow
+
 # ---- per-gate microbench + hbm-limit width ------------------------------
 run_stage microbench_w22 480 python scripts/microbench.py 22 8
 run_stage turboquant_w28 600 python scripts/turboquant_bench.py 28 8 4 3

@@ -163,6 +163,7 @@ def test_scores_wide_general_circuit_falls_to_turboquant():
     f40 = extract_features(qft_qcircuit(8), 40)
     f40.width = 40
     f40.max_component = 40
+    f40.max_cone_width = 40  # full-width cone: lightcone rung out too
     scores40 = score_stacks(f40, RouteKnobs())
     assert scores40["turboquant"] == INFEASIBLE
     stack40, _ = choose_stack(f40, RouteKnobs(), mode="auto")
