@@ -62,6 +62,7 @@ from .registry import load_state, save_state
 
 MANIFEST_VERSION = 1
 CIRCUIT_KIND = "qrack-circuit"
+PREFIX_KIND = "qrack-prefix"
 DEFAULT_LEASE_TTL_S = 300.0
 DEFAULT_LOCK_TIMEOUT_S = 30.0
 ACKS_MAX_BYTES = 1 << 20  # settled-tag log rotates past this
@@ -154,8 +155,13 @@ class CheckpointStore:
         self.protected_sids: Optional[Callable[[], Iterable[str]]] = None
         self._sessions_dir = os.path.join(self.root, "sessions")
         self._wal_dir = os.path.join(self.root, "wal")
+        # spilled prefix-cache planes (serve/prefix_cache.py): evict-
+        # first under the byte budget — a prefix is always
+        # re-materializable from its circuit, session state is not
+        self._prefix_dir = os.path.join(self.root, "prefix")
         os.makedirs(self._sessions_dir, exist_ok=True)
         os.makedirs(self._wal_dir, exist_ok=True)
+        os.makedirs(self._prefix_dir, exist_ok=True)
         self._manifest_path = os.path.join(self.root, "manifest.json")
         self._lock_path = os.path.join(self.root, ".store.lock")
         self._acks_path = os.path.join(self.root, "acks.log")
@@ -469,18 +475,88 @@ class CheckpointStore:
         live = set(self.protected_sids()) if self.protected_sids else set()
         evicted = []
         while self.total_bytes() > self.max_bytes:
+            # spilled prefixes (rank 0) go before session state (rank 1):
+            # a prefix is always re-materializable from its circuit
             victims = sorted(
-                (os.path.getmtime(p), p) for p in self._state_files()
-                if p != protect
-                and os.path.basename(p)[:-len(".qckpt")] not in live)
+                [(0, os.path.getmtime(p), p) for p in self._prefix_files()
+                 if p != protect]
+                + [(1, os.path.getmtime(p), p) for p in self._state_files()
+                   if p != protect
+                   and os.path.basename(p)[:-len(".qckpt")] not in live])
             if not victims:
                 break
-            _, path = victims[0]
+            _, _, path = victims[0]
             self._unlink(path)
             evicted.append(path)
         if evicted and _tele._ENABLED:
             _tele.inc("checkpoint.store.evicted", len(evicted))
         return evicted
+
+    # -- spilled prefix-cache planes (serve/prefix_cache.py) -----------
+
+    def _prefix_path(self, digest: str, width: int, stack: str) -> str:
+        return os.path.join(self._prefix_dir,
+                            f"{digest}-w{int(width)}-{stack}.qckpt")
+
+    def save_prefix(self, digest: str, width: int, stack: str,
+                    arrays: Dict[str, np.ndarray],
+                    meta: Optional[dict] = None) -> str:
+        """Spill a prefix-cache entry's planes; returns the path.  The
+        container's per-array sha256 gives disk-level integrity; the
+        cache layers its own host fingerprint on top (fault-back-in
+        verifies BOTH before any tenant is seeded from the entry)."""
+        path = self._prefix_path(digest, width, stack)
+        m = dict(meta or {})
+        m.update({"digest": digest, "width": int(width), "stack": stack})
+        save_container(path, arrays, meta=m, kind=PREFIX_KIND)
+        self._enforce_budget(protect=path)
+        self._update_gauge()
+        return path
+
+    def load_prefix(self, digest: str, width: int, stack: str):
+        """(meta, arrays) for a spilled prefix entry; CheckpointError
+        when absent, CheckpointCorrupt on a bad container hash."""
+        path = self._prefix_path(digest, width, stack)
+        if not os.path.exists(path):
+            raise CheckpointError(
+                f"no spilled prefix {digest[:12]}… w{width} {stack}")
+        _, meta, arrays = load_container(path, expect_kind=PREFIX_KIND)
+        return meta, arrays
+
+    def has_prefix(self, digest: str, width: int, stack: str) -> bool:
+        return os.path.exists(self._prefix_path(digest, width, stack))
+
+    def drop_prefix(self, digest: str, width: int, stack: str) -> None:
+        self._unlink(self._prefix_path(digest, width, stack))
+        self._update_gauge()
+
+    def prefix_entries(self) -> List[Tuple[str, int, str]]:
+        """[(digest, width, stack)] for every spilled prefix on disk —
+        a recovered service probes these to rebuild a warm cache."""
+        out = []
+        try:
+            names = os.listdir(self._prefix_dir)
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(".qckpt"):
+                continue
+            stem = name[:-len(".qckpt")]
+            digest, _, rest = stem.partition("-w")
+            width_s, _, stack = rest.partition("-")
+            try:
+                out.append((digest, int(width_s), stack))
+            except ValueError:
+                continue
+        return out
+
+    def _prefix_files(self) -> List[str]:
+        try:
+            return [os.path.join(self._prefix_dir, n)
+                    for n in os.listdir(self._prefix_dir)
+                    if n.endswith(".qckpt")]
+        except OSError:
+            return []
 
     # -- pending-job journal (WAL) -------------------------------------
 
@@ -663,7 +739,7 @@ class CheckpointStore:
 
     def total_bytes(self) -> int:
         total = 0
-        for d in (self._sessions_dir, self._wal_dir):
+        for d in (self._sessions_dir, self._wal_dir, self._prefix_dir):
             try:
                 for name in os.listdir(d):
                     try:
@@ -679,6 +755,7 @@ class CheckpointStore:
             "root": self.root,
             "sessions": len(self._manifest["sessions"]),
             "spilled": len(self._state_files()),
+            "spilled_prefixes": len(self._prefix_files()),
             "wal_entries": len(self._wal_files()),
             "bytes": self.total_bytes(),
             "max_bytes": self.max_bytes,

@@ -107,6 +107,53 @@ _j_sum_sqr_diff = _jit("sum_sqr_diff", gk.sum_sqr_diff)
 _j_sample = _jit("sample", gk.sample)
 _j_multishot = _jit("multishot", gk.multishot_mask_keys)
 _j_uc_2x2 = _jit("uc_2x2", gk.uc_2x2, static_argnums=(2, 3, 4), donate_argnums=(0,))
+# out-of-place device copy for the copy-on-write boundary below — never
+# donates (its whole job is to leave the source buffer alive)
+_j_copy = _jit("copy_planes", jnp.copy)
+
+
+# ---------------------------------------------------------------------------
+# plane pin registry (serve/prefix_cache.py): buffers whose identity is
+# registered here were handed out as SHARED refs (a cache entry plus any
+# number of seeded session engines may alias one buffer) and must NEVER
+# be donated to a jitted program — donation would invalidate every other
+# alias.  Keyed by id() of the jax array object — not by engine — because
+# the executor's failover rollback re-assigns the SAME cached ref back
+# into an engine (serve/executor.py pre_planes), and an engine-level flag
+# would not survive that round trip.  A pin lives exactly as long as the
+# buffer does (weakref finalizer), NOT as long as the cache entry: after
+# an eviction, engines still aliasing the buffer remain protected from
+# each other.  The dict is empty whenever the prefix cache is off, so the
+# hot-path probe in _owned_state is one falsy check.
+# ---------------------------------------------------------------------------
+
+_PLANE_PINS: dict = {}
+
+
+def pin_planes(planes) -> None:
+    """Register `planes` as shared: donation sites copy-on-write."""
+    if planes is None:
+        return
+    k = id(planes)
+    if k in _PLANE_PINS:
+        return
+    import weakref
+
+    try:
+        _PLANE_PINS[k] = weakref.ref(
+            planes, lambda _r, _k=k: _PLANE_PINS.pop(_k, None))
+    except TypeError:
+        _PLANE_PINS[k] = None  # unweakrefable buffer: pinned for life
+
+
+def unpin_planes(planes) -> None:
+    """Force-drop a pin (tests only — live aliases lose protection)."""
+    if planes is not None:
+        _PLANE_PINS.pop(id(planes), None)
+
+
+def planes_pinned(planes) -> bool:
+    return planes is not None and id(planes) in _PLANE_PINS
 
 
 # one-chip dense f32 width ceiling: int32 flat indices + HBM for
@@ -186,6 +233,20 @@ class QEngineTPU(QEngine):
         if f is not None and f.gates and not f._flushing:
             f.drop("overwritten")
         self._state_raw = planes
+
+    def _owned_state(self):
+        """The resident planes as a DONATABLE buffer.  When the serving
+        prefix cache holds the current ref (_PLANE_PINS), return a fresh
+        device copy and make IT resident first — copy-on-write at the
+        donation boundary, so no jitted program ever consumes a buffer a
+        cache entry still aliases.  One falsy dict probe when nothing is
+        pinned."""
+        st = self._state  # property read: flushes any pending window
+        if _PLANE_PINS and id(st) in _PLANE_PINS:
+            st = _j_copy(st)
+            self._state_raw = st
+            _tele.inc("serve.prefix.cow")
+        return st
 
     @property
     def device_planes(self):
@@ -336,17 +397,17 @@ class QEngineTPU(QEngine):
             if op.kind in ("cphase", "diag"):
                 d0, d1 = complex(m[0, 0]), complex(m[1, 1])
                 self._state = _j_apply_diag(
-                    self._state, d0.real, d0.imag, d1.real, d1.imag,
+                    self._owned_state(), d0.real, d0.imag, d1.real, d1.imag,
                     n, 1 << op.target, op.cmask, op.cval)
             elif op.kind == "inv":
                 tr, bl = complex(m[0, 1]), complex(m[1, 0])
                 self._state = _j_apply_invert(
-                    self._state, tr.real, tr.imag, bl.real, bl.imag,
+                    self._owned_state(), tr.real, tr.imag, bl.real, bl.imag,
                     n, op.target, op.cmask, op.cval)
             else:
                 mp = gk.mtrx_planes(m, self.dtype)
                 self._state = _j_apply_2x2(
-                    self._state, mp, n, op.target, op.cmask, op.cval)
+                    self._owned_state(), mp, n, op.target, op.cmask, op.cval)
             return 1
         structure = fu.structure_of(ops)
         operands = fu.dense_operands(ops, self.dtype)
@@ -355,14 +416,14 @@ class QEngineTPU(QEngine):
             prog = fu.kernel_window_program(
                 n, structure, self.dtype, interpret=plan["interpret"],
                 block_pow=plan["block_pow"])
-            self._state = prog(self._state, *operands)
+            self._state = prog(self._owned_state(), *operands)
             fu.record_kernel_flush(self._tele_name, len(ops), plan["sweeps"],
                                    width=n,
                                    esize=jnp.dtype(self.dtype).itemsize)
             return 1
         fu.record_kernel_fallback(why)
         prog = fu.dense_window_program(n, structure, self.dtype)
-        self._state = prog(self._state, *operands)
+        self._state = prog(self._owned_state(), *operands)
         fu.record_xla_flush(self._tele_name, len(ops), width=n,
                             esize=jnp.dtype(self.dtype).itemsize)
         return 1
@@ -372,27 +433,29 @@ class QEngineTPU(QEngine):
         if mat.is_invert(m2):
             tr, bl = m2[0, 1], m2[1, 0]
             self._state = _j_apply_invert(
-                self._state, float(tr.real), float(tr.imag),
+                self._owned_state(), float(tr.real), float(tr.imag),
                 float(bl.real), float(bl.imag),
                 self.qubit_count, target, cmask, cval,
             )
         else:
             mp = gk.mtrx_planes(m2, self.dtype)
-            self._state = _j_apply_2x2(self._state, mp, self.qubit_count, target, cmask, cval)
+            self._state = _j_apply_2x2(self._owned_state(), mp,
+                                       self.qubit_count, target, cmask, cval)
         self._drift_tick()
 
     def _k_apply_diag(self, d0, d1, target, controls, perm) -> None:
         cmask, cval = self._cmask_cval(controls, perm)
         d0, d1 = complex(d0), complex(d1)
         self._state = _j_apply_diag(
-            self._state, d0.real, d0.imag, d1.real, d1.imag,
+            self._owned_state(), d0.real, d0.imag, d1.real, d1.imag,
             self.qubit_count, 1 << target, cmask, cval,
         )
         self._drift_tick()
 
     def _k_apply_4x4(self, m4, q1, q2) -> None:
         mp = gk.mtrx_planes(m4, self.dtype)
-        self._state = _j_apply_4x4(self._state, mp, self.qubit_count, q1, q2)
+        self._state = _j_apply_4x4(self._owned_state(), mp,
+                                   self.qubit_count, q1, q2)
         self._drift_tick()
 
     def UCMtrx(self, controls, mtrxs, target, mtrx_skip_powers=(), mtrx_skip_value_mask=0) -> None:
@@ -405,12 +468,14 @@ class QEngineTPU(QEngine):
             jnp.asarray(stack.real, dtype=self.dtype),
             jnp.asarray(stack.imag, dtype=self.dtype),
         ])
-        self._state = _j_uc_2x2(self._state, mps, self.qubit_count, target, tuple(controls))
+        self._state = _j_uc_2x2(self._owned_state(), mps, self.qubit_count,
+                                target, tuple(controls))
         self._drift_tick()
 
     def _k_gather(self, src_fn, split=None) -> None:
-        src = src_fn(gk.iota_for(self._state))
-        self._state = _j_gather(self._state, src)
+        st = self._owned_state()
+        src = src_fn(gk.iota_for(st))
+        self._state = _j_gather(st, src)
 
     def _k_out_of_place(self, src_idx, dst_idx, passthrough_cmask) -> None:
         src_idx = jnp.asarray(src_idx, dtype=gk.IDX_DTYPE)
@@ -424,8 +489,9 @@ class QEngineTPU(QEngine):
         self._state = new
 
     def _k_phase_fn(self, fn, split=None) -> None:
-        fre, fim = fn(jnp, gk.iota_for(self._state))
-        self._state = _j_phase_apply(self._state, fre, fim)
+        st = self._owned_state()
+        fre, fim = fn(jnp, gk.iota_for(st))
+        self._state = _j_phase_apply(st, fre, fim)
 
     def _k_probs(self) -> np.ndarray:
         return np.asarray(_j_probs(self._state), dtype=np.float64)
@@ -435,7 +501,7 @@ class QEngineTPU(QEngine):
         return min(max(p, 0.0), 1.0)
 
     def _k_collapse(self, mask, val, nrm_sq) -> None:
-        self._state = _j_collapse(self._state, mask, val, nrm_sq)
+        self._state = _j_collapse(self._owned_state(), mask, val, nrm_sq)
 
     def MAll(self) -> int:
         """Device-side categorical sample; no 2^n host transfer
@@ -497,7 +563,7 @@ class QEngineTPU(QEngine):
         self._state = gk.allocate(self._state, self.qubit_count, start, length)
 
     def _k_normalize(self, nrm_sq) -> None:
-        self._state = _j_normalize(self._state, nrm_sq)
+        self._state = _j_normalize(self._owned_state(), nrm_sq)
 
     def _k_sum_sqr_diff(self, other) -> float:
         if isinstance(other, QEngineTPU):
@@ -507,7 +573,8 @@ class QEngineTPU(QEngine):
         return float(_j_sum_sqr_diff(self._state, b))
 
     def _k_swap_bits(self, q1, q2) -> None:
-        self._state = _j_swap_bits(self._state, self.qubit_count, q1, q2)
+        self._state = _j_swap_bits(self._owned_state(),
+                                   self.qubit_count, q1, q2)
 
     def ExpectationBitsAll(self, bits, offset: int = 0) -> float:
         """One device reduction; the distribution never reaches the host."""

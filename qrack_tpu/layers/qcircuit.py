@@ -18,6 +18,13 @@ import numpy as np
 from .. import matrices as mat
 
 
+def _is_unitary_2x2(m: np.ndarray, tol: float = 1e-9) -> bool:
+    """Local unitarity check (route.features has the same predicate, but
+    layers must not import route — route imports layers)."""
+    m = np.asarray(m, dtype=np.complex128).reshape(2, 2)
+    return bool(np.allclose(m.conj().T @ m, np.eye(2), atol=tol))
+
+
 class QCircuitGate:
     __slots__ = ("target", "controls", "payloads")
 
@@ -76,6 +83,11 @@ class QCircuit:
         # circuit once per submit AND once per dispatch, and sha1 over
         # every payload's bytes is milliseconds on ~100-gate circuits
         self._digest_cache: Optional[str] = None
+        # memoized rolling prefix-digest chain (prefix_digest): entry k
+        # hashes gates[:k+1].  AppendGate's peephole merging can mutate
+        # or delete EARLIER gates, so any append invalidates the whole
+        # chain, exactly like _digest_cache.
+        self._prefix_chain: Optional[List[str]] = None
 
     # ------------------------------------------------------------------
 
@@ -85,6 +97,7 @@ class QCircuit:
         commuting past disjoint gates)."""
         self.qubit_count = max(self.qubit_count, max(gate.qubits()) + 1)
         self._digest_cache = None
+        self._prefix_chain = None
         # walk back past gates on disjoint qubits to find a merge partner
         i = len(self.gates) - 1
         gset = set(gate.qubits())
@@ -212,7 +225,9 @@ class QCircuit:
                 prog = fu.kernel_window_program(
                     n, fu.structure_of(ops), qsim.dtype,
                     interpret=jax.default_backend() not in ("tpu", "axon"))
-                qsim._state = prog(qsim._state,
+                # _owned_state: the window program donates its input —
+                # never hand it a plane ref the prefix cache holds
+                qsim._state = prog(qsim._owned_state(),
                                    *fu.dense_operands(ops, qsim.dtype))
                 return
             ops = fu.lower_gates(self.gates)
@@ -220,7 +235,8 @@ class QCircuit:
                 return
             prog = fu.dense_window_program(n, fu.structure_of(ops),
                                            qsim.dtype)
-            qsim._state = prog(qsim._state, *fu.dense_operands(ops, qsim.dtype))
+            qsim._state = prog(qsim._owned_state(),
+                               *fu.dense_operands(ops, qsim.dtype))
             return
         if isinstance(qsim, QPager) and self.gates:
             n = qsim.qubit_count
@@ -287,6 +303,61 @@ class QCircuit:
                 h.update(np.ascontiguousarray(g.payloads[perm]).tobytes())
         self._digest_cache = h.hexdigest()
         return self._digest_cache
+
+    def _prefix_digests(self) -> List[str]:
+        """Rolling digest chain: entry k is the digest of gates[:k+1],
+        built in ONE pass over the gate list (hashlib digests are
+        readable mid-stream).  Entry -1 equals structure_digest() —
+        same per-gate byte encoding, whole-circuit scope."""
+        if self._prefix_chain is None:
+            import hashlib
+
+            chain: List[str] = []
+            h = hashlib.sha1()
+            for g in self.gates:
+                h.update(f"t{g.target};c{g.controls};".encode())
+                for perm in sorted(g.payloads):
+                    h.update(f"p{perm}:".encode())
+                    h.update(np.ascontiguousarray(g.payloads[perm]).tobytes())
+                chain.append(h.hexdigest())
+            self._prefix_chain = chain
+        return self._prefix_chain
+
+    def prefix_digest(self, k: int) -> str:
+        """Digest of the first `k` gates — O(1) per call once the memoized
+        chain builds (invalidated by AppendGate like structure_digest).
+        Two circuits share prefix_digest(k) iff their first k gates are
+        equal (targets, controls, payload bytes).  k=0 is the fixed
+        empty-prefix digest; k=len(gates) equals structure_digest()."""
+        if k <= 0:
+            import hashlib
+
+            return hashlib.sha1().hexdigest()
+        chain = self._prefix_digests()
+        if k > len(chain):
+            raise IndexError(f"prefix length {k} > gate count {len(chain)}")
+        return chain[k - 1]
+
+    def shareable_prefix_len(self) -> int:
+        """Longest gate prefix safe to share across tenants as a cached
+        ket: every payload must be unitary.  A non-unitary payload (a
+        recorded measurement/projection draws rng and collapses — its
+        outcome is per-tenant) terminates the shareable prefix."""
+        for i, g in enumerate(self.gates):
+            for m in g.payloads.values():
+                if not _is_unitary_2x2(m):
+                    return i
+        return len(self.gates)
+
+    def split_at(self, k: int) -> Tuple["QCircuit", "QCircuit"]:
+        """(prefix, suffix) copies split before gate index `k`.  Gates
+        copy verbatim — NOT through AppendGate, whose peephole merging
+        could reshape the sequence the prefix digest hashed."""
+        pre = QCircuit(self.qubit_count)
+        pre.gates = [g.clone() for g in self.gates[:k]]
+        suf = QCircuit(self.qubit_count)
+        suf.gates = [g.clone() for g in self.gates[k:]]
+        return pre, suf
 
     def shape_key(self, n: int) -> Tuple[int, int, str]:
         """Batch-bucket key at engine width `n`: (width, gate-count

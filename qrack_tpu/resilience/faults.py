@@ -83,6 +83,12 @@ SITES = (
     # (lightcone/engine.py _slice; docs/LIGHTCONE.md) — checked
     # directly, the cone walk is host-side with no watchdog wrapper
     "lightcone.slice",
+    # prefix-cache materialization on a popular miss
+    # (serve/executor.py _materialize_prefix; docs/SERVING.md) —
+    # checked directly at entry; amp-corrupt strikes the would-be
+    # cache copy at exit, where the insert-time fingerprint/norm
+    # validation must catch it before any tenant is served from it
+    "prefix.materialize",
     "checkpoint.save", "checkpoint.restore",
     # process-plane sites (fleet/): checked by the supervisor's monitor
     # tick and the worker's heartbeat writer, not by call_guarded —

@@ -191,10 +191,33 @@ def _probed_hbm_bytes() -> int:
     return default
 
 
+_HBM_RESERVATION: Optional[object] = None
+
+
+def set_hbm_reservation(fn) -> None:
+    """Install (``fn`` = zero-arg callable returning bytes) or clear
+    (``fn=None``) a standing HBM reservation the budget subtracts —
+    the serving plane wires the prefix cache's ``resident_bytes`` here
+    so admission prices circuits against the headroom that actually
+    remains, not the raw device size.  The effective budget is floored
+    at 1/16 of the raw budget: a runaway reservation can degrade
+    routing, never starve it."""
+    global _HBM_RESERVATION
+    _HBM_RESERVATION = fn
+
+
 def hbm_budget_bytes(knobs: Optional["RouteKnobs"] = None) -> int:
     """The device HBM budget the memory axis scores against."""
     k = knobs or RouteKnobs.from_env()
-    return k.hbm_bytes if k.hbm_bytes > 0 else _probed_hbm_bytes()
+    budget = k.hbm_bytes if k.hbm_bytes > 0 else _probed_hbm_bytes()
+    if _HBM_RESERVATION is not None:
+        try:
+            reserved = int(_HBM_RESERVATION())
+        except Exception:  # noqa: BLE001 — reservation is best-effort
+            reserved = 0
+        if reserved > 0:
+            budget = max(budget - reserved, budget // 16)
+    return budget
 
 
 def _tq_geometry() -> Tuple[int, int, int]:

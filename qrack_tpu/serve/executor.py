@@ -80,7 +80,7 @@ class Executor:
     def __init__(self, scheduler: Scheduler, sessions: SessionManager,
                  tick_s: float = 0.25, sync: bool = True, canary=None,
                  checkpoint_every_job: bool = False,
-                 pipeline: bool = True):
+                 pipeline: bool = True, prefix_cache=None):
         self.scheduler = scheduler
         self.sessions = sessions
         self.tick_s = tick_s
@@ -92,6 +92,10 @@ class Executor:
         # unless QRACK_SERVE_CANARY_RATE > 0 — the default costs one
         # attribute test per batch
         self.canary = canary
+        # prefix-sharing COW ket cache (serve/prefix_cache.py); None
+        # unless QrackService wired one in — seeding/materialization is
+        # device traffic, so it happens here, on the dispatch owner
+        self.prefix_cache = prefix_cache
         # QRACK_SERVE_CKPT_EVERY_JOB: settle order snapshot → WAL
         # remove, so there is NO instant where a completed job is
         # neither on disk nor in the journal (fleet zero-loss contract)
@@ -287,6 +291,22 @@ class Executor:
                 sess = job.session
                 if sess is not None and sess.engine is not None:
                     _elastic.maybe_reexpand(sess.engine)
+        # realize prefix splits BEFORE canary pre-capture: the session
+        # ket must hold the prefix state so the oracle replays the
+        # suffix (job.circuit) from the base it will actually run on
+        if self.prefix_cache is not None:
+            live = []
+            for job in batch:
+                try:
+                    self._seed_prefix(job)
+                except BaseException as e:  # noqa: BLE001
+                    job.handle._fail(e)
+                    self._account(job, ok=False)
+                else:
+                    live.append(job)
+            if not live:
+                return live
+            batch = live
         # canary sampling decides BEFORE execution: the oracle replay
         # needs the pre-job ket, and the state reads belong to this
         # thread (the replay itself runs on the canary thread)
@@ -296,6 +316,67 @@ class Executor:
                         and self.canary.should_sample()):
                     self.canary.capture_pre(job)
         return batch
+
+    def _seed_prefix(self, job: Job) -> None:
+        """Realize one job's admission-time prefix split on its engine.
+        job.circuit is the SUFFIX only; after this the session ket holds
+        the prefix state, so running the suffix — batched, singleton, or
+        failover-replayed (pre_planes capture the SEEDED state) — is
+        exact.  Seeding from a cached entry is one reference assignment:
+        the buffer is pinned (engines.tpu), so every donating dispatch
+        a seeded tenant runs copies-on-write instead of invalidating the
+        cache (or a sibling tenant seeded from the same entry)."""
+        if job.kind != "circuit" or not getattr(job, "prefix_len", 0):
+            return
+        sess = job.session
+        cache = self.prefix_cache
+        eng = planes_engine(sess.engine)
+        if eng is None:
+            # the session failed over to a non-plane stack after
+            # admission: no planes to seed — replay the prefix
+            # gate-at-a-time so the suffix still lands on the right base
+            job.prefix_circuit.Run(sess.engine)
+            return
+        planes = None
+        entry = job.prefix_entry
+        if entry is not None:
+            planes = cache.acquire(entry)  # faults spills back in;
+            #                                None on loss/corruption
+        if planes is None and job.prefix_insert:
+            # popular miss — but an earlier job (possibly in this very
+            # batch window) may have inserted already; re-probe before
+            # paying the materialization
+            entry = cache.get(job.prefix_digest, sess.width)
+            if entry is not None:
+                planes = cache.acquire(entry)
+        if planes is not None:
+            eng.device_planes = planes
+            return
+        self._materialize_prefix(job, eng, cache)
+
+    def _materialize_prefix(self, job: Job, eng, cache) -> None:
+        """Execute the prefix on the session engine and, for a popular
+        miss, insert a COPY of the resulting planes into the cache.  The
+        copy is what the ``prefix.materialize`` amp-corrupt fault
+        strikes, and what insert() validates on host — a corrupted
+        materialization is refused at the door while the engine's own
+        planes stay clean, so the job (and every future tenant) is
+        unaffected."""
+        from ..resilience import faults as _faults
+
+        directive = _faults.check("prefix.materialize")  # may raise
+        if directive is not None:
+            raise RuntimeError(
+                f"prefix.materialize injected fault: {directive}")
+        job.prefix_circuit.Run(job.session.engine)
+        if not job.prefix_insert:
+            return
+        from ..engines.tpu import _j_copy
+
+        cand = _faults.corrupt_output("prefix.materialize",
+                                      _j_copy(eng.device_planes))
+        cache.insert(job.prefix_digest, job.session.width, "dense",
+                     job.prefix_len, cand)
 
     def _misroute_checks(self, batch: List[Job]) -> None:
         # job-boundary mis-route probe: a stabilizer forced off-tableau

@@ -107,7 +107,8 @@ class JobHandle:
 class Job:
     __slots__ = ("session", "kind", "circuit", "fn", "shape_key",
                  "priority", "seq", "handle", "wal_path", "mutates",
-                 "tag", "trace")
+                 "tag", "trace", "prefix_len", "prefix_digest",
+                 "prefix_circuit", "prefix_entry", "prefix_insert")
 
     def __init__(self, session: Optional[Session], kind: str, *,
                  circuit=None, fn: Optional[Callable] = None,
@@ -123,6 +124,17 @@ class Job:
         self.handle = JobHandle(session.sid if session else "-", kind)
         self.wal_path = None      # journal entry to settle (checkpointing)
         self.tag = None           # fleet dedup tag (durable ack at settle)
+        # prefix-cache split (service.submit / executor._seed_prefixes):
+        # when prefix_len > 0, self.circuit is the SUFFIX only — the
+        # executor seeds the engine from prefix_entry (hit) or
+        # materializes prefix_circuit first (prefix_insert: popular
+        # miss, insert after).  The WAL always journals the FULL
+        # circuit, so recovery replays from |0…0⟩ unchanged.
+        self.prefix_len = 0
+        self.prefix_digest = None
+        self.prefix_circuit = None
+        self.prefix_entry = None
+        self.prefix_insert = False
         # does settling this job advance the session past its on-disk
         # snapshot?  Circuits always do; "call" jobs that collapse state
         # or consume the rng stream (MAll, sampling) do too, while pure

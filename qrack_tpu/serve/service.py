@@ -57,6 +57,25 @@ blocks on a JobHandle.  Env knobs (constructor args override):
                                    lease across serving — it is taken
                                    around recover()/adoption only
                                    (fleet workers; docs/FLEET.md)
+* ``QRACK_SERVE_PREFIX``           "0": disable the prefix-sharing COW
+                                   ket cache (byte-for-byte pre-cache
+                                   behavior).  Default on: submits
+                                   against pristine sessions split at
+                                   the longest cached unitary prefix,
+                                   the engine is seeded from the shared
+                                   planes, and only the per-tenant
+                                   suffix executes
+                                   (serve/prefix_cache.py)
+* ``QRACK_SERVE_PREFIX_BYTES``     resident prefix-cache budget
+                                   (default 256 MiB; evicts by
+                                   bytes×recency, spilling to the
+                                   checkpoint store when one is
+                                   configured)
+* ``QRACK_SERVE_PREFIX_MIN_REFS``  recent lookups before a missed
+                                   prefix is materialized + inserted
+                                   (default 2)
+* ``QRACK_SERVE_PREFIX_MIN_GATES`` shortest prefix worth splitting
+                                   (default 4)
 * ``QRACK_SERVE_CKPT_EVERY_JOB``   "1": snapshot a session's state at
                                    each mutating job's settle — BEFORE
                                    a circuit job's WAL entry is
@@ -184,6 +203,22 @@ class QrackService:
             _batcher_mod.set_manifest(self.program_manifest)
         self.sessions = SessionManager(idle_evict_s=idle_evict_s,
                                        spill_store=self.store)
+        # prefix-sharing COW ket cache (serve/prefix_cache.py): N
+        # tenants submitting circuits with a common state-prep prefix
+        # pay its execution once.  QRACK_SERVE_PREFIX=0 restores
+        # pre-cache behavior byte-for-byte — no cache object exists, no
+        # plane is ever pinned, submit never splits.
+        self.prefix_cache = None
+        if os.environ.get("QRACK_SERVE_PREFIX", "1") != "0":
+            from .prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(store=self.store)
+            # the router's HBM budget must see cached planes as
+            # already-committed bytes, or admission over-commits the
+            # device by exactly the cache's resident set
+            from ..route import cost as _cost
+
+            _cost.set_hbm_reservation(self.prefix_cache.resident_bytes)
         self.scheduler = Scheduler(max_depth=max_depth,
                                    queue_budget_s=queue_budget_ms / 1e3,
                                    batch_window_s=batch_window_ms / 1e3,
@@ -205,7 +240,8 @@ class QrackService:
                                  checkpoint_every_job=(
                                      checkpoint_every_job
                                      and self.store is not None),
-                                 pipeline=pipeline)
+                                 pipeline=pipeline,
+                                 prefix_cache=self.prefix_cache)
         self.executor.start()
         self._closed = False
         if self.store is not None and self._hold_lease:
@@ -290,8 +326,46 @@ class QrackService:
                 from ..lightcone.engine import sliced_shape_key
 
                 shape_key = sliced_shape_key(circuit)
+        # prefix-cache admission split: only a PRISTINE session (engine
+        # still |0…0⟩) can be seeded from a shared prefix, and only a
+        # plane-backed engine can take the seed.  The WAL below always
+        # journals the FULL circuit — recovery replays from |0…0⟩ and
+        # needs no cache to be exact.
+        full_circuit = circuit
+        prefix = None
+        if (self.prefix_cache is not None and circuit.gates
+                and sess.pristine
+                and planes_engine(sess.engine) is not None):
+            prefix = self.prefix_cache.plan(circuit, sess.width)
+        if circuit.gates:
+            # the engine is about to leave |0…0⟩; later submits against
+            # this session must run their circuits in full
+            sess.pristine = False
+        if prefix is not None:
+            kind, k, ref = prefix
+            digest = ref.digest if kind == "hit" else ref
+            pre_circ, circuit = circuit.split_at(k)
+            if circuit.gates:
+                # suffixes co-batch only with same-prefix same-suffix
+                # peers: the digest in the key keeps a split job from
+                # ever joining an unsplit batch of the same shape
+                shape_key = (sess.width, digest,
+                             len(circuit.gates).bit_length(),
+                             circuit.structure_digest())
+            else:
+                # whole circuit is the prefix: run as a singleton (the
+                # seed IS the job; an empty batched program buys nothing)
+                shape_key = None
         job = Job(sess, "circuit", circuit=circuit, shape_key=shape_key,
                   priority=priority)
+        if prefix is not None:
+            job.prefix_len = k
+            job.prefix_digest = digest
+            job.prefix_circuit = pre_circ
+            if kind == "hit":
+                job.prefix_entry = ref
+            else:
+                job.prefix_insert = True
         job.tag = tag
         if self.store is not None:
             # journal BEFORE admission (the executor may settle the job
@@ -299,7 +373,7 @@ class QrackService:
             # at completion, a refusal deletes it below — so entries
             # still on disk at startup are exactly the crash-interrupted
             # jobs recover() re-runs.
-            job.wal_path = self.store.wal_append(sid, circuit, tag=tag)
+            job.wal_path = self.store.wal_append(sid, full_circuit, tag=tag)
         sess.begin_job()
         try:
             return self.scheduler.submit(job)
@@ -325,6 +399,10 @@ class QrackService:
         to the stale-recovery path and drop any journaled-but-pending
         circuit at adoption (docs/FLEET.md).  Default: mutating."""
         sess = self.sessions.get(sid)
+        if mutates:
+            # collapse or rng draw: the engine leaves |0…0⟩ (or its rng
+            # stream moves), so prefix seeding is off for this session
+            sess.pristine = False
         job = Job(sess, "call", fn=fn, priority=priority, mutates=mutates)
         sess.begin_job()
         try:
@@ -553,6 +631,7 @@ class QrackService:
                     sid=sid, **kwargs)
                 if self.store.has_state(sid):
                     sess.engine = self.store.load(sid, into=sess.engine)
+                    sess.pristine = False  # mid-stream, not |0…0⟩
                     self.store.drop_state(sid)
                     # the disk copy was just consumed; the restored
                     # state now lives only in memory
@@ -600,6 +679,7 @@ class QrackService:
                     deduped += 1
                     continue
                 circuit.Run(sess.engine)
+                sess.pristine = False
                 self.store.mark_dirty(sid)
                 replayed += 1
             self.store.clear_wal(sids=scope)
@@ -648,6 +728,11 @@ class QrackService:
                 self.store.disown(sid)
                 self.sessions.release(sid)
                 drained.append(sid)
+            if self.prefix_cache is not None and not busy:
+                # warm handoff: spilled prefix entries land in the
+                # store's prefix/ tier, so the adopter's cache starts
+                # warm (PrefixCache._adopt_spilled)
+                self.prefix_cache.evict_all(spill=True)
             if not busy and self.lease_held and not self.sessions.ids():
                 self.store.release_lease(self._owner)
                 self.lease_held = False
@@ -691,6 +776,8 @@ class QrackService:
             "breaker": _breaker.get_breaker().snapshot(),
             "batch_programs": _batch_stats(),
         }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
         if self.store is not None:
             out["checkpoint_store"] = self.store.stats()
             out["lease"] = {"owner": self._owner,
@@ -704,6 +791,16 @@ class QrackService:
         self._closed = True
         self.scheduler.stop()
         self.executor.stop()
+        if self.prefix_cache is not None:
+            # executor thread is down — this thread is the only jax
+            # client now, so the spill's device_get is safe here
+            try:
+                self.prefix_cache.evict_all(spill=self.store is not None)
+            except Exception:  # noqa: BLE001 — close never raises
+                pass
+            from ..route import cost as _cost
+
+            _cost.set_hbm_reservation(None)
         if self.canary is not None:
             self.canary.stop()
         if self.store is not None and self.lease_held:

@@ -114,6 +114,12 @@ class Session:
         # weight-2 tenant gets twice the lane of a weight-1 one
         self.weight = max(float(weight), 1e-6)
         self.spilled = False       # engine persisted to disk, not resident
+        # True while the engine is still in its freshly-constructed
+        # |0…0⟩ state: only then may service.submit seed it from the
+        # shared prefix cache (prefix_cache.py).  Cleared by the first
+        # state-mutating submit and by checkpoint restore (mid-stream
+        # state is not |0…0⟩).
+        self.pristine = True
         now = time.perf_counter()
         self.created_s = now
         self.last_used_s = now
@@ -332,6 +338,7 @@ class SessionManager:
                 _tele.event("serve.session.restore_lost", sid=sess.sid)
             return
         sess.spilled = False
+        sess.pristine = False  # restored mid-stream state is not |0…0⟩
         sess.restores += 1
         self.spill_store.drop_state(sess.sid)
         # the disk copy is gone; the live state it held is now only in

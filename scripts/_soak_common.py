@@ -42,9 +42,10 @@ if _TESTS not in sys.path:
 from test_fuzz_api import N, _ops  # noqa: E402  (single-source vocabulary)
 
 __all__ = ["REPO", "N", "_ops", "STACKS", "ROUTED_TQ_LANE",
-           "ROUTED_TQ_FLOOR", "LIGHTCONE_LANE", "TRAJECTORY_LANES",
-           "routed_tq_env", "fidelity", "submit_retry", "resilience_up",
-           "resilience_down", "soak_main"]
+           "ROUTED_TQ_FLOOR", "LIGHTCONE_LANE", "PREFIX_LANE",
+           "TRAJECTORY_LANES", "routed_tq_env", "fidelity",
+           "submit_retry", "resilience_up", "resilience_down",
+           "soak_main"]
 
 # stacks that exercise each guarded dispatch family; the second pager
 # lane forces the placement planner on so remapped windows soak too,
@@ -78,6 +79,16 @@ ROUTED_TQ_FLOOR = 1 - 1e-5
 # this lane; the `lightcone.slice` site itself is pinned by
 # tests/test_lightcone.py's typed-error checks)
 LIGHTCONE_LANE = ("lightcone", {})
+
+
+# the serving prefix-cache lane (docs/SERVING.md): full QrackService
+# trials where same-prep tenants share a COW cached ket, with
+# ``amp-corrupt`` armed on the prefix.materialize site and a byte
+# budget small enough to churn evict/spill — a corrupted cached prefix
+# must be detected (serve.prefix.corrupt / .lost) and evicted, never
+# served, while every tenant's state stays oracle-exact
+# (integrity_soak.py consumes this lane)
+PREFIX_LANE = ("prefix", {})
 
 
 # trajectory-batch lanes (noise_soak.py): the batched Monte-Carlo

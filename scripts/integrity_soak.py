@@ -67,9 +67,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _soak_common import (LIGHTCONE_LANE, N, ROUTED_TQ_FLOOR,  # noqa: E402
-                          ROUTED_TQ_LANE, fidelity, resilience_down,
-                          resilience_up, routed_tq_env, soak_main)
+from _soak_common import (LIGHTCONE_LANE, N, PREFIX_LANE,  # noqa: E402
+                          ROUTED_TQ_FLOOR, ROUTED_TQ_LANE, fidelity,
+                          resilience_down, resilience_up, routed_tq_env,
+                          soak_main)
 
 import numpy as np  # noqa: E402
 
@@ -81,7 +82,7 @@ from qrack_tpu.utils.rng import QrackRandom  # noqa: E402
 
 STACKS = [("tpu", {}), ("pager", {"n_pages": 4, "remap": "off"}),
           ("pager", {"n_pages": 4, "remap": "on"}),
-          ROUTED_TQ_LANE, LIGHTCONE_LANE]
+          ROUTED_TQ_LANE, LIGHTCONE_LANE, PREFIX_LANE]
 
 GATES1 = ("H", "X", "Y", "Z", "S", "T")
 _DIAG1 = ("Z", "S", "T")   # phase gates: window-admissible at ANY target
@@ -133,9 +134,123 @@ def _site_for(stack_name: str, kw: dict, window: int) -> str:
     return "tpu.fuse.flush"
 
 
+def _px_circuit(width: int, prep_seed: int, tail_seed: int):
+    """Shared-prep tenant circuit for the prefix lane: H wall + 2 x
+    (CX ring + seeded RY layer) prep, then a per-tenant tail whose
+    leading CX ring is the AppendGate merge barrier (an uncontrolled
+    rotation appended straight after the prep's rotation layer would
+    merge INTO the shared gates and fork every tenant's digest)."""
+    from qrack_tpu import matrices as mat
+    from qrack_tpu.layers.qcircuit import QCircuit
+
+    def ring(c):
+        for q in range(width - 1):
+            c.append_ctrl((q,), q + 1, mat.X2, 1)
+
+    def ry_layer(c, r):
+        for q in range(width):
+            th = r.uniform(0.0, 2.0 * np.pi)
+            co, si = np.cos(th / 2.0), np.sin(th / 2.0)
+            c.append_1q(q, np.array([[co, -si], [si, co]],
+                                    dtype=np.complex128))
+
+    circ = QCircuit()
+    prng = np.random.default_rng(prep_seed)
+    for q in range(width):
+        circ.append_1q(q, mat.H2)
+    for _ in range(2):
+        ring(circ)
+        ry_layer(circ, prng)
+    ring(circ)
+    ry_layer(circ, np.random.default_rng(tail_seed))
+    return circ
+
+
+def _prefix_trial(trial: int, rng, info: dict) -> dict:
+    """Prefix-cache lane: a full QrackService with two same-prep tenant
+    groups, ``amp-corrupt`` armed on prefix.materialize, and (half the
+    trials) a byte budget sized for ONE resident entry so the second
+    group's insert churns evict/spill.  Verdict: every tenant state
+    oracle-exact AND every fired corruption was seen by the insert/
+    fault-in validation (serve.prefix.corrupt / .lost) — a corrupted
+    prefix must never seed a tenant."""
+    import shutil
+    import tempfile
+
+    from qrack_tpu.serve import QrackService
+
+    persistent = bool(rng.integers(0, 2))
+    times = None if persistent else int(rng.integers(1, 3))
+    after_n = int(rng.integers(0, 2))
+    tight = bool(rng.integers(0, 2))
+    plane_bytes = 2 * (2 ** N) * 4
+    info.update({"site": "prefix.materialize", "after_n": after_n,
+                 "persistent": persistent, "times": times,
+                 "tight_budget": tight, "window": None, "page": None})
+    resilience_up()
+    tele.enable()
+    tele.reset()
+    ckdir = tempfile.mkdtemp(prefix="px_soak_")
+    if tight:
+        os.environ["QRACK_SERVE_PREFIX_BYTES"] = str(plane_bytes + 8)
+    try:
+        res.faults.inject("prefix.materialize", "amp-corrupt",
+                          after_n=after_n, times=times)
+        fids = []
+        with QrackService(engine_layers="tpu", checkpoint_dir=ckdir,
+                          batch_window_ms=5.0, tick_s=0.02,
+                          queue_budget_ms=60_000.0) as svc:
+            for t in range(6):
+                prep_seed = 1000 + trial * 2 + (t % 2)  # two prep groups
+                circ = _px_circuit(N, prep_seed, 2000 + trial * 8 + t)
+                sid = svc.create_session(N, seed=t,
+                                         rand_global_phase=False)
+                svc.submit(sid, circ).result(120)
+                served = np.asarray(svc.get_state(sid, timeout=120))
+                o = QEngineCPU(N, rng=QrackRandom(t),
+                               rand_global_phase=False)
+                circ.Run(o)
+                fids.append(fidelity(np.asarray(o.GetQuantumState()),
+                                     served))
+            pstats = svc.stats().get("prefix_cache") or {}
+        snap = tele.snapshot()["counters"]
+        fired = sum(sp.fired for sp in res.faults.specs())
+        detected = (snap.get("serve.prefix.corrupt", 0)
+                    + snap.get("serve.prefix.lost", 0))
+        f = min(fids)
+        info["fired"] = fired
+        info["violations"] = detected
+        info["hits"] = snap.get("serve.prefix.hit", 0)
+        info["inserts"] = snap.get("serve.prefix.insert", 0)
+        info["evicts"] = snap.get("serve.prefix.evict", 0)
+        info["spills"] = snap.get("serve.prefix.spill", 0)
+        info["entries"] = pstats.get("entries")
+        info["fidelity"] = f
+        # zero silent mis-computes: every tenant oracle-exact, every
+        # fired strike detected, and a persistent corrupter means the
+        # cache never admitted (so it can never have served) an entry
+        info["ok"] = bool(f > 1 - 1e-6
+                          and (fired == 0 or detected >= 1)
+                          and (not persistent or fired == 0
+                               or info["hits"] == 0))
+    except Exception as e:  # noqa: BLE001 — a soak records, never dies
+        info["ok"] = False
+        info["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        os.environ.pop("QRACK_SERVE_PREFIX_BYTES", None)
+        shutil.rmtree(ckdir, ignore_errors=True)
+        resilience_down()
+        tele.disable()
+        tele.reset()
+    return info
+
+
 def run_trial(trial: int, seed: int) -> dict:
     rng = np.random.Generator(np.random.PCG64((seed << 20) + trial))
     stack_name, kw = STACKS[trial % len(STACKS)]
+    if stack_name == "prefix":
+        return _prefix_trial(trial, rng,
+                             {"trial": trial, "stack": stack_name})
     routed = stack_name == "route"
     # non-diagonal targets stay on the guarded surface (module doc)
     ndt = min(kw["chunk_qb"], N) if routed else N

@@ -75,6 +75,21 @@ fallback at identical (key, trajectory_id) counters.  The headline is
 the trajectories/s ratio (acceptance: >= 5x batched); docs/SERVING.md
 and docs/NOISE.md record the measured ratio.
 
+PREFIX mode (--prefix, docs/SERVING.md): the prefix-sharing COW ket
+cache's loadgen.  Each round creates FRESH pristine sessions (only
+those may seed from the cache); --px-share of them replay ONE shared
+state-prep (H wall + --px-layers x (CX ring + seeded RY layer)) and
+differ only in a short per-tenant tail, the rest get unique preps and
+can never share.  A 2-tenant warmup populates the cache (min_refs=2:
+miss, then miss+insert at the provably shared boundary), the timed
+pass measures submit->result jobs/s devget-honestly, and a CPU oracle
+re-runs verified sessions' FULL circuits from |0…0> so cached-seeded
+results are checked end to end.  Every run spawns an automatic
+QRACK_SERVE_PREFIX=0 child — byte-identical traffic down the pre-cache
+admission path (acceptance: >= 3x jobs/s at oracle-equal fidelity).
+The --px-solo arm (internal) runs ONE arm for the tpu_campaign.sh
+prefix_cache_w22 / prefix_cache_w22_off single-client stage pair.
+
 Usage:
     python scripts/serve_bench.py [--width 16] [--jobs 8] [--rounds 4]
                                   [--layers tpu] [--window-ms 50] [--json]
@@ -87,13 +102,17 @@ Usage:
     python scripts/serve_bench.py --loadgen [--tenants 1000]
                                   [--lg-requests 2000] [--lg-mode closed]
                                   [--lg-concurrency 40] [--lg-rate 400]
+    python scripts/serve_bench.py --prefix [--px-width 18]
+                                  [--px-tenants 20] [--px-rounds 3]
+                                  [--px-layers 8] [--px-share 0.8]
 
 Exit 0 when the acceptance bar holds (default: cold AND steady-state
 serve rounds < 0.6x the sequential library wall; --mixed: routed
 Clifford class >= 10x faster than dense-forced; --shallow: wide tenant
 auto-routes to lightcone, probe expectations analytic-exact, forced
 dense refuses with MisrouteError; --loadgen: pipelined throughput >=
-1.5x the serial A/B child with p99 no worse), 1 otherwise.
+1.5x the serial A/B child with p99 no worse; --prefix: cache-on >= 3x
+the cache-off child's jobs/s at oracle-equal fidelity), 1 otherwise.
 """
 
 import argparse
@@ -479,6 +498,203 @@ def run_loadgen(args) -> dict:
                res_pipe["throughput_jobs_per_s"])
     if res_pipe["latency_p99_s"] is not None:
         tele.gauge("serve.bench.loadgen_p99_s", res_pipe["latency_p99_s"])
+    return res
+
+
+def _px_circuit(width, prep_layers, prep_seed, tail_seed):
+    """One tenant's full circuit: a deterministic state-prep block
+    (H wall + prep_layers x (CX ring + seeded RY layer)) followed by a
+    short per-tenant tail.  Tenants built with the SAME prep_seed share
+    the prep gate-for-gate, so their prefix digests agree there.
+
+    The tail starts with a CX ring on purpose: AppendGate merges a
+    same-target uncontrolled gate into the previous gate's payload, so
+    a rotation tail appended straight after the prep's rotation layer
+    would MUTATE the shared gates and fork every tenant's digest.  The
+    entangling ring is a merge barrier (and, being identical across
+    tenants, extends the shared prefix by one ring — the divergence
+    point is the seeded tail rotation layer)."""
+    from qrack_tpu import matrices as mat
+    from qrack_tpu.layers.qcircuit import QCircuit
+
+    def ring(circ):
+        for q in range(width - 1):
+            circ.append_ctrl((q,), q + 1, mat.X2, 1)
+
+    def ry_layer(circ, rng):
+        for q in range(width):
+            th = rng.uniform(0.0, 2.0 * np.pi)
+            c, s = np.cos(th / 2.0), np.sin(th / 2.0)
+            circ.append_1q(q, np.array([[c, -s], [s, c]],
+                                       dtype=np.complex128))
+
+    circ = QCircuit()
+    rng = np.random.default_rng(prep_seed)
+    for q in range(width):
+        circ.append_1q(q, mat.H2)
+    for _ in range(prep_layers):
+        ring(circ)
+        ry_layer(circ, rng)
+    ring(circ)
+    ry_layer(circ, np.random.default_rng(tail_seed))
+    return circ
+
+
+def _px_traffic(args):
+    """(prep_seed, tail_seed) per job: px_share of each round's tenants
+    replay the ONE shared prep (seed = lg_seed); the rest get a prep
+    seed unique to (round, tenant) so they can never share — not even
+    with their own earlier rounds."""
+    n = args.px_tenants
+    n_shared = max(1, int(round(n * args.px_share)))
+    plan = []
+    for r in range(args.px_rounds):
+        for i in range(n):
+            shared = i < n_shared
+            prep = args.lg_seed if shared else 77_000 + 1000 * r + i
+            plan.append((shared, prep, 88_000 + 1000 * r + i))
+    return n_shared, plan
+
+
+def measure_prefix(args) -> dict:
+    """One prefix-bench arm in THIS process (the cache obeys
+    QRACK_SERVE_PREFIX from the environment).  Untimed: session
+    creation, circuit construction, a 2-tenant warmup that populates
+    the cache (min_refs=2: miss, then miss+insert at the provably
+    shared boundary), and the per-session CPU-oracle fidelity check.
+    Timed: submit+result of every job on FRESH pristine sessions (only
+    pristine sessions may split, so each round gets its own), closed by
+    a devget read — relay acks on block_until_ready; only a
+    device->host read is proof of completion."""
+    tele.enable()
+    tele.reset()
+    sys.setswitchinterval(5e-4)
+    n_shared, plan = _px_traffic(args)
+    circs = [_px_circuit(args.px_width, args.px_layers, p, t)
+             for _, p, t in plan]
+    warm_circs = [_px_circuit(args.px_width, args.px_layers, args.lg_seed,
+                              99_000 + i) for i in range(2)]
+    # queue budget OFF: the whole timed pass queues at submit time and
+    # the cache-off arm's full-circuit tail can sit queued for many
+    # minutes on this 1-core VM — expiry would break the A/B symmetry
+    svc = QrackService(engine_layers=args.layers,
+                       max_depth=len(plan) + 64,
+                       batch_window_ms=args.lg_window_ms,
+                       max_batch=args.lg_batch,
+                       queue_budget_ms=0.0, tick_s=0.05)
+    cache_on = svc.prefix_cache is not None
+    try:
+        for i, c in enumerate(warm_circs):
+            wsid = svc.create_session(args.px_width, seed=90_000 + i)
+            svc.submit(wsid, c).result(3600)
+        tele.reset()
+        sids = [svc.create_session(args.px_width, seed=10_000 + j)
+                for j in range(len(plan))]
+        t0 = time.perf_counter()
+        handles = [svc.submit(sid, c) for sid, c in zip(sids, circs)]
+        for h in handles:
+            h.result(3600)
+        svc.call(sids[-1], _devget_read, mutates=False).result(3600)
+        wall = time.perf_counter() - t0
+        # untimed: CPU-oracle fidelity on round-0 sessions — the first
+        # px_verify of each class (non-sharers AND the cache-served
+        # sharers; 0 skips, for widths where the 2^w complex128 oracle
+        # is minutes per session)
+        verify = (list(range(n_shared, args.px_tenants))[:args.px_verify]
+                  + list(range(min(args.px_verify, n_shared))))
+        fids = []
+        for j in verify:
+            oracle = create_quantum_interface("cpu", args.px_width)
+            circs[j].Run(oracle)
+            ket = np.asarray(svc.get_state(sids[j]),
+                             dtype=np.complex128).ravel()
+            ref = np.asarray(oracle.GetQuantumState(),
+                             dtype=np.complex128).ravel()
+            fids.append(float(abs(np.vdot(ref, ket)) ** 2))
+        pstats = svc.stats().get("prefix_cache")
+    finally:
+        svc.close()
+    lats = [h.latency_s for h in handles if h.latency_s is not None]
+    cnt = tele.snapshot()["counters"]
+    hits = cnt.get("serve.prefix.hit", 0)
+    misses = cnt.get("serve.prefix.miss", 0)
+    completed = len(lats)
+    return {
+        "cache_on": bool(cache_on),
+        "width": args.px_width, "tenants": args.px_tenants,
+        "rounds": args.px_rounds, "shared_per_round": n_shared,
+        "gates_full": len(circs[0].gates),
+        "wall_s": round(wall, 6), "completed": completed,
+        "throughput_jobs_per_s": round(completed / wall, 2) if wall else 0,
+        "latency_p50_s": _pctl(lats, 50), "latency_p99_s": _pctl(lats, 99),
+        "prefix_hits": hits, "prefix_misses": misses,
+        "hit_rate": round(hits / (hits + misses), 3) if hits + misses
+        else 0.0,
+        "mean_hit_depth": round(cnt.get("serve.prefix.hit_depth", 0)
+                                / hits, 1) if hits else 0.0,
+        "verified_sessions": len(fids),
+        "min_fidelity": round(min(fids), 9) if fids else None,
+        "cache_stats": pstats,
+    }
+
+
+def _px_child_args(args) -> list:
+    """Re-invoke THIS script as the cache-off A/B child: identical
+    fixed-seed traffic, QRACK_SERVE_PREFIX=0 in the child env."""
+    return [sys.executable, os.path.abspath(__file__), "--prefix",
+            "--ab-child", "--json",
+            "--layers", args.layers,
+            "--px-width", str(args.px_width),
+            "--px-tenants", str(args.px_tenants),
+            "--px-rounds", str(args.px_rounds),
+            "--px-layers", str(args.px_layers),
+            "--px-share", str(args.px_share),
+            "--px-verify", str(args.px_verify),
+            "--lg-window-ms", str(args.lg_window_ms),
+            "--lg-batch", str(args.lg_batch),
+            "--lg-seed", str(args.lg_seed)]
+
+
+def run_prefix(args) -> dict:
+    """Cache-on run in-process, then the automatic cache-off A/B child
+    (fresh process, QRACK_SERVE_PREFIX=0: byte-for-byte the pre-cache
+    admission path) with the identical fixed-seed traffic.  Acceptance:
+    >=3x jobs/s at equal per-session fidelity (both arms CPU-oracle
+    verified against the SAME full circuits)."""
+    os.environ.pop("QRACK_SERVE_PREFIX", None)  # on-arm: default-on
+    res_on = measure_prefix(args)
+    env = dict(os.environ, QRACK_SERVE_PREFIX="0")
+    proc = subprocess.run(_px_child_args(args), capture_output=True,
+                          text=True, env=env, timeout=7200)
+    if proc.returncode != 0:
+        raise RuntimeError("cache-off A/B child failed:\n"
+                           + proc.stderr[-2000:])
+    out = proc.stdout
+    res_off = json.loads(out[out.index("{"):])
+    speedup = (res_on["throughput_jobs_per_s"]
+               / max(res_off["throughput_jobs_per_s"], 1e-9))
+    # equal fidelity: both arms sit at the f32-vs-f64 accumulation
+    # floor for ~O(400) gates; the cache must not move it
+    fid_floor = 1.0 - 5e-4
+    fid_ok = (res_on["min_fidelity"] is not None
+              and res_off["min_fidelity"] is not None
+              and res_on["min_fidelity"] >= fid_floor
+              and res_off["min_fidelity"] >= fid_floor)
+    res = {
+        "mode": "prefix", "width": args.px_width,
+        "tenants": args.px_tenants, "rounds": args.px_rounds,
+        "share": args.px_share, "prep_layers": args.px_layers,
+        "seed": args.lg_seed, "cache_on": res_on, "cache_off": res_off,
+        "speedup_jobs_per_s": round(speedup, 3),
+        "fidelity_ok": bool(fid_ok),
+        "pass_3x": bool(speedup >= 3.0 and fid_ok
+                        and res_on["prefix_hits"] > 0),
+    }
+    tele.gauge("serve.bench.prefix_speedup", res["speedup_jobs_per_s"])
+    tele.gauge("serve.bench.prefix_jobs_per_s",
+               res_on["throughput_jobs_per_s"])
+    if res_on["latency_p99_s"] is not None:
+        tele.gauge("serve.bench.prefix_p99_s", res_on["latency_p99_s"])
     return res
 
 
@@ -904,6 +1120,28 @@ def main(argv=None) -> int:
                          "concurrent demand so batches stay partial "
                          "and the serial mode pays the full window")
     ap.add_argument("--lg-seed", type=int, default=42)
+    ap.add_argument("--prefix", action="store_true",
+                    help="prefix-sharing COW ket-cache bench: tenants "
+                         "replaying one shared state-prep vs unique-"
+                         "prep tenants, with an automatic QRACK_SERVE_"
+                         "PREFIX=0 A/B child (docs/SERVING.md)")
+    ap.add_argument("--px-width", type=int, default=18)
+    ap.add_argument("--px-tenants", type=int, default=20,
+                    help="fresh sessions per round (default 20)")
+    ap.add_argument("--px-rounds", type=int, default=3,
+                    help="timed rounds; every round uses fresh "
+                         "pristine sessions (default 3)")
+    ap.add_argument("--px-layers", type=int, default=8,
+                    help="state-prep depth: H wall + N x (CX ring + "
+                         "RY layer) (default 8)")
+    ap.add_argument("--px-share", type=float, default=0.8,
+                    help="fraction of tenants replaying the shared "
+                         "prep (default 0.8)")
+    ap.add_argument("--px-verify", type=int, default=4,
+                    help="sessions CPU-oracle verified per class per "
+                         "arm; 0 skips the oracle (default 4)")
+    ap.add_argument("--px-solo", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one-arm stage
     args = ap.parse_args(argv)
 
     if args.seq_child:
@@ -930,6 +1168,71 @@ def main(argv=None) -> int:
             print(f"  acceptance (>=5x trajectories/s): "
                   f"{'PASS' if res['pass_5x'] else 'FAIL'}")
         return 0 if res["pass_5x"] else 1
+    if args.prefix:
+        if args.ab_child:
+            print(json.dumps(measure_prefix(args), sort_keys=True))
+            return 0
+        if args.px_solo:
+            # single-arm campaign stage: ONE jax process, cache state
+            # taken from QRACK_SERVE_PREFIX (the tpu_campaign.sh pair
+            # runs this twice, on then off — docs/TPU_EVIDENCE.md)
+            r = measure_prefix(args)
+            suffix = "" if r["cache_on"] else "_off"
+            ok = (r["completed"] == args.px_tenants * args.px_rounds
+                  and (not r["cache_on"] or r["prefix_hits"] > 0)
+                  and (r["min_fidelity"] is None
+                       or r["min_fidelity"] >= 1.0 - 5e-4))
+            print(json.dumps({
+                "metric": f"prefix_cache_w{args.px_width}_serve{suffix}",
+                "value": r["throughput_jobs_per_s"], "unit": "jobs/s",
+                "completed": r["completed"],
+                "latency_p99_s": r["latency_p99_s"],
+                "hit_rate": r["hit_rate"],
+                "mean_hit_depth": r["mean_hit_depth"],
+                "min_fidelity": r["min_fidelity"]}))
+            if ok:
+                print("PREFIX_SERVE_SOLO_OK")
+            return 0 if ok else 1
+        res = run_prefix(args)
+        if args.json:
+            print(json.dumps(res, indent=1, sort_keys=True))
+        else:
+            on, off = res["cache_on"], res["cache_off"]
+            print(f"prefix cache w={res['width']}: {res['tenants']} "
+                  f"tenants x {res['rounds']} rounds, share "
+                  f"{res['share']:.0%}, prep {res['prep_layers']} layers "
+                  f"({on['gates_full']} gates full) (devget-honest)")
+            for label, r in (("cache on ", on), ("cache off", off)):
+                fid = (f"{r['min_fidelity']:.7f}"
+                       if r["min_fidelity"] is not None else "n/a")
+                print(f"  {label}: {r['throughput_jobs_per_s']:8.1f} "
+                      f"jobs/s | p50 {r['latency_p50_s'] * 1e3:7.1f} ms "
+                      f"p99 {r['latency_p99_s'] * 1e3:7.1f} ms | "
+                      f"min fidelity {fid} "
+                      f"({r['verified_sessions']} oracled)")
+            print(f"  hits {on['prefix_hits']:.0f} "
+                  f"(rate {on['hit_rate']:.2f}, mean depth "
+                  f"{on['mean_hit_depth']:.1f} gates) | "
+                  f"misses {on['prefix_misses']:.0f}")
+            print(f"  speedup {res['speedup_jobs_per_s']:.2f}x, fidelity "
+                  f"{'equal' if res['fidelity_ok'] else 'DEGRADED'}")
+            print(f"  acceptance (>=3x jobs/s, oracle fidelity intact): "
+                  f"{'PASS' if res['pass_3x'] else 'FAIL'}")
+        # campaign evidence: one flat metric line + the OK marker
+        print(json.dumps({
+            "metric": f"prefix_cache_w{res['width']}_serve",
+            "value": res["cache_on"]["throughput_jobs_per_s"],
+            "unit": "jobs/s",
+            "speedup_vs_cache_off": res["speedup_jobs_per_s"],
+            "cache_off_jobs_per_s":
+                res["cache_off"]["throughput_jobs_per_s"],
+            "mean_hit_depth": res["cache_on"]["mean_hit_depth"],
+            "hit_rate": res["cache_on"]["hit_rate"],
+            "min_fidelity": res["cache_on"]["min_fidelity"]}))
+        if res["pass_3x"]:
+            print("PREFIX_SERVE_OK")
+        return 0 if res["pass_3x"] else 1
+
     if args.ab_child:
         res = measure_loadgen(args, pipeline=args.lg_pipeline != 0)
         print(json.dumps(res, sort_keys=True))

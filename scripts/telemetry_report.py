@@ -156,6 +156,7 @@ def report(snap: dict, top: int) -> dict:
         "exchange": {},
         "remap": {},
         "serve": {},
+        "prefix": {},
         "route": {},
         "compression": {},
         "noise": {},
@@ -270,6 +271,25 @@ def report(snap: dict, top: int) -> dict:
     if batch_jobs:
         out["serve"]["join_rate"] = round(
             out["serve"].get("serve.overlap.join.jobs", 0) / batch_jobs, 4)
+    # prefix cache: the shared-state-prep COW tier (serve/prefix_cache.py,
+    # docs/SERVING.md) — hit economics (rate + mean depth of skipped
+    # gates), lifecycle counters, and the resident-bytes gauge
+    pf = out["prefix"]
+    for k in list(out["serve"]):
+        if k.startswith("serve.prefix."):
+            pf[k] = out["serve"].pop(k)
+    pf_hit = pf.get("serve.prefix.hit", 0)
+    pf_miss = pf.get("serve.prefix.miss", 0)
+    if pf_hit + pf_miss:
+        pf["hit_rate"] = round(pf_hit / (pf_hit + pf_miss), 4)
+    if pf_hit:
+        # hit_depth accumulates the skipped prefix length per hit, so
+        # the mean is gates-not-executed per cache hit
+        pf["mean_hit_depth"] = round(
+            pf.get("serve.prefix.hit_depth", 0) / pf_hit, 2)
+    pf_bytes = snap.get("gauges", {}).get("serve.prefix.bytes")
+    if pf and pf_bytes is not None:
+        pf["serve.prefix.bytes"] = pf_bytes
     # per-stack hit rates: fraction of routed jobs each stack executed
     routed_jobs = sum(v for k, v in out["route"].items()
                       if k.startswith("route.jobs."))
@@ -444,6 +464,16 @@ def main(argv=None) -> int:
         print("== serve ==")
         for name, v in sorted(rep["serve"].items()):
             print(f"  {name:<40s} {v:>12.3f}")
+    if rep["prefix"]:
+        print("== prefix ==")
+        for name, v in sorted(rep["prefix"].items()):
+            if name.endswith("bytes"):
+                shown = _fmt_bytes(v)
+            elif float(v).is_integer():
+                shown = f"{v:.0f}"
+            else:
+                shown = f"{v:.4f}"
+            print(f"  {name:<40s} {shown:>12s}")
     if rep["route"]:
         print("== routing ==")
         for name, v in sorted(rep["route"].items()):

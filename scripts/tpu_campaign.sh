@@ -226,6 +226,18 @@ run_stage noise_traj_w16_seq 420 env QRACK_BENCH=noise_traj \
 #      (docs/LIGHTCONE.md).
 run_stage lightcone_w50 700 python scripts/serve_bench.py --shallow
 
+# ---- prefix-sharing COW ket cache: 10 tenants x 2 rounds at w22, 80%
+#      replaying one shared state-prep — the on/off pair is the on-chip
+#      shared-prep-paid-once evidence (docs/SERVING.md).  Single-arm
+#      stages (--px-solo) so each keeps the one-client-at-a-time tunnel
+#      discipline; the off arm is byte-identical traffic with
+#      QRACK_SERVE_PREFIX=0 (the pre-cache admission path).
+run_stage prefix_cache_w22 900 python scripts/serve_bench.py --prefix \
+  --px-solo --px-width 22 --px-tenants 10 --px-rounds 2 --px-verify 1
+run_stage prefix_cache_w22_off 900 env QRACK_SERVE_PREFIX=0 \
+  python scripts/serve_bench.py --prefix --px-solo --px-width 22 \
+  --px-tenants 10 --px-rounds 2 --px-verify 1
+
 # ---- per-gate microbench + hbm-limit width ------------------------------
 run_stage microbench_w22 480 python scripts/microbench.py 22 8
 run_stage turboquant_w28 600 python scripts/turboquant_bench.py 28 8 4 3

@@ -1,0 +1,162 @@
+"""QCircuit prefix-digest chain: the O(1)-per-length keys the serving
+prefix cache (serve/prefix_cache.py) shares kets by.
+
+Contract under test (layers/qcircuit.py):
+- prefix_digest(k) is stable: appending more gates never changes the
+  digest of an already-hashed prefix (the chain is append-only);
+- two circuits share prefix_digest(k) iff their first k gates are equal
+  (targets, controls, payload bytes);
+- prefix_digest(len(gates)) == structure_digest(), prefix_digest(0) is
+  the fixed empty digest, and lengths past the end raise IndexError;
+- a non-unitary payload (recorded measurement/projection) terminates
+  shareable_prefix_len — projective outcomes are per-tenant;
+- split_at copies gates verbatim, NOT through AppendGate's peephole
+  merging, so prefix+suffix re-trace to the digested sequence.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from qrack_tpu import matrices as mat
+from qrack_tpu.layers.qcircuit import QCircuit
+
+W = 5
+
+
+def _ry(theta: float) -> np.ndarray:
+    c, s = np.cos(theta / 2.0), np.sin(theta / 2.0)
+    return np.array([[c, -s], [s, c]], dtype=np.complex128)
+
+
+def _ring(circ: QCircuit, width: int = W) -> None:
+    for q in range(width - 1):
+        circ.append_ctrl((q,), q + 1, mat.X2, 1)
+
+
+def _prep(width: int = W, layers: int = 2, seed: int = 7) -> QCircuit:
+    """Deterministic shareable state-prep: H wall + layers x (CX ring +
+    seeded RY layer)."""
+    circ = QCircuit()
+    rng = np.random.default_rng(seed)
+    for q in range(width):
+        circ.append_1q(q, mat.H2)
+    for _ in range(layers):
+        _ring(circ, width)
+        for q in range(width):
+            circ.append_1q(q, _ry(rng.uniform(0.0, 2.0 * np.pi)))
+    return circ
+
+
+def _tenant(tail_seed: int, prep_seed: int = 7) -> QCircuit:
+    """Shared prep + per-tenant tail.  The tail STARTS with a CX ring:
+    AppendGate merges a same-target uncontrolled gate into the previous
+    gate's payload, so a rotation appended straight after the prep's
+    rotation layer would mutate the shared gates and fork the digest."""
+    circ = _prep(seed=prep_seed)
+    _ring(circ)
+    rng = np.random.default_rng(tail_seed)
+    for q in range(W):
+        circ.append_1q(q, _ry(rng.uniform(0.0, 2.0 * np.pi)))
+    return circ
+
+
+def _shared_boundary() -> int:
+    """Gate index where two same-prep tenants provably diverge: the
+    prep plus the (identical) tail ring."""
+    return len(_prep().gates) + (W - 1)
+
+
+# ---------------------------------------------------------------------------
+# stability + equality
+# ---------------------------------------------------------------------------
+
+def test_prefix_digests_stable_under_append():
+    circ = _prep()
+    before = [circ.prefix_digest(k) for k in range(len(circ.gates) + 1)]
+    _ring(circ)  # controlled gates cannot merge into the 1q tail
+    for q in range(W):
+        circ.append_1q(q, _ry(0.3 * (q + 1)))
+    after = [circ.prefix_digest(k) for k in range(len(before))]
+    assert after == before
+
+
+def test_prefix_digest_equal_iff_prefix_equal():
+    a, b = _tenant(tail_seed=1), _tenant(tail_seed=2)
+    k_shared = _shared_boundary()
+    for k in (0, 1, k_shared // 2, k_shared):
+        assert a.prefix_digest(k) == b.prefix_digest(k)
+    # first tail rotation differs -> every longer prefix differs
+    for k in range(k_shared + 1, len(a.gates) + 1):
+        assert a.prefix_digest(k) != b.prefix_digest(k)
+    # different prep seed -> divergence from the first seeded gate on
+    c = _tenant(tail_seed=1, prep_seed=8)
+    assert a.prefix_digest(len(a.gates)) != c.prefix_digest(len(c.gates))
+
+
+def test_prefix_digest_endpoints_and_range():
+    circ = _prep()
+    n = len(circ.gates)
+    assert circ.prefix_digest(n) == circ.structure_digest()
+    empty = hashlib.sha1().hexdigest()
+    assert circ.prefix_digest(0) == empty
+    assert QCircuit().prefix_digest(0) == empty
+    with pytest.raises(IndexError):
+        circ.prefix_digest(n + 1)
+
+
+def test_append_merge_hazard_documented():
+    """A same-target uncontrolled append merges into the previous gate:
+    the digest AT the old boundary changes (the boundary gate's payload
+    was rewritten), which is exactly why shared-prefix tenants must
+    start their tails with an entangling barrier."""
+    circ = _prep()
+    n = len(circ.gates)
+    frozen = _prep().structure_digest()
+    last_target = circ.gates[-1].target
+    circ.append_1q(last_target, _ry(0.123))      # merges, no new gate
+    assert len(circ.gates) == n
+    assert circ.prefix_digest(n) != frozen
+
+
+# ---------------------------------------------------------------------------
+# shareable_prefix_len: measurement terminates sharing
+# ---------------------------------------------------------------------------
+
+def test_measurement_terminates_shareable_prefix():
+    circ = _prep()
+    n = len(circ.gates)
+    assert circ.shareable_prefix_len() == n
+    # a projector payload is non-unitary — the recorded collapse draws
+    # per-tenant rng, so nothing at or past it may be shared.  Appended
+    # after a ring so the peephole cannot fold it into a unitary gate.
+    _ring(circ)
+    proj = np.array([[1, 0], [0, 0]], dtype=np.complex128)
+    circ.append_1q(0, proj)
+    _ring(circ)
+    assert circ.shareable_prefix_len() == n + (W - 1)
+    assert len(circ.gates) > circ.shareable_prefix_len()
+
+
+# ---------------------------------------------------------------------------
+# split_at: verbatim copies, no re-merge
+# ---------------------------------------------------------------------------
+
+def test_split_at_copies_verbatim():
+    circ = _tenant(tail_seed=3)
+    k = _shared_boundary()
+    pre, suf = circ.split_at(k)
+    assert len(pre.gates) + len(suf.gates) == len(circ.gates)
+    assert pre.structure_digest() == circ.prefix_digest(k)
+    # the suffix starts with 1q rotations that WOULD merge under
+    # AppendGate — verbatim copy must preserve the gate boundary
+    whole = _tenant(tail_seed=3)
+    assert (pre.structure_digest() != whole.structure_digest()
+            or k == len(whole.gates))
+    recomposed = QCircuit(circ.qubit_count)
+    recomposed.gates = [g.clone() for g in pre.gates + suf.gates]
+    assert recomposed.structure_digest() == circ.structure_digest()
+    # mutating the split halves never touches the original
+    suf.gates[0].payloads[0] = np.asarray(mat.Y2)
+    assert circ.structure_digest() == whole.structure_digest()
